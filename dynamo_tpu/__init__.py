@@ -1,0 +1,11 @@
+"""dynamo_tpu — TPU-native distributed LLM inference-serving framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of NVIDIA Dynamo
+(reference: /root/reference, surveyed in SURVEY.md): OpenAI-compatible frontend,
+distributed runtime with discovery/leases/streaming request plane, KV-cache-aware
+routing, disaggregated prefill/decode with chip-to-chip KV transfer, multi-tier KV
+block management, and a native JAX continuous-batching engine with Pallas paged
+attention (the reference delegates the engine to vLLM/SGLang/TRT-LLM; we supply it).
+"""
+
+__version__ = "0.1.0"

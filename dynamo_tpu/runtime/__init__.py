@@ -1,0 +1,19 @@
+"""Distributed runtime core (capability parity with reference lib/runtime).
+
+Exposes the component addressing model (Namespace -> Component -> Endpoint ->
+Instance), the streaming engine trait, the DistributedRuntime node singleton, and
+the built-in control-plane coordinator that plays the role etcd + NATS play in the
+reference (lib/runtime/src/distributed.rs:54-66).
+"""
+
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import AsyncEngine
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+__all__ = [
+    "AsyncEngine",
+    "Context",
+    "DistributedRuntime",
+    "RuntimeConfig",
+]

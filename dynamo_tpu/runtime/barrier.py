@@ -1,0 +1,74 @@
+"""Leader/worker barrier over the coordinator KV.
+
+Capability parity with reference LeaderBarrier/WorkerBarrier
+(lib/runtime/src/utils/leader_worker_barrier.rs:137,230): the leader publishes
+data under ``{root}/leader`` and waits for N workers to check in under
+``{root}/workers/{id}``; workers post their data and wait for the leader's.
+Used to bootstrap multi-host engine groups and KVBM leader/worker pairs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+from dynamo_tpu.runtime.coordinator_client import CoordinatorClient
+
+BARRIER_ROOT = "barriers/"
+
+
+class LeaderBarrier:
+    def __init__(self, client: CoordinatorClient, barrier_id: str, num_workers: int):
+        self.client = client
+        self.root = f"{BARRIER_ROOT}{barrier_id}/"
+        self.num_workers = num_workers
+
+    async def sync(self, data: Any, timeout: float = 60.0) -> dict[str, Any]:
+        """Publish leader data; return {worker_id: worker_data} once all
+        workers have checked in."""
+        await self.client.kv_put(self.root + "leader", data, use_primary_lease=True)
+        watch = await self.client.watch_prefix(self.root + "workers/")
+        workers: dict[str, Any] = {
+            e["k"].rsplit("/", 1)[-1]: e["v"] for e in watch.snapshot}
+        try:
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + timeout
+            while len(workers) < self.num_workers:
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"barrier {self.root}: {len(workers)}/{self.num_workers} "
+                        "workers after timeout")
+                event = await asyncio.wait_for(watch.events.get(), remaining)
+                if event["event"] == "put":
+                    workers[event["key"].rsplit("/", 1)[-1]] = event["value"]
+            return workers
+        finally:
+            await watch.cancel()
+
+
+class WorkerBarrier:
+    def __init__(self, client: CoordinatorClient, barrier_id: str, worker_id: str):
+        self.client = client
+        self.root = f"{BARRIER_ROOT}{barrier_id}/"
+        self.worker_id = worker_id
+
+    async def sync(self, data: Any, timeout: float = 60.0) -> Any:
+        """Post worker data; return the leader's data once present."""
+        watch = await self.client.watch_prefix(self.root + "leader")
+        try:
+            await self.client.kv_put(self.root + f"workers/{self.worker_id}",
+                                     data, use_primary_lease=True)
+            if watch.snapshot:
+                return watch.snapshot[0]["v"]
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + timeout
+            while True:
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    raise TimeoutError(f"barrier {self.root}: no leader after timeout")
+                event = await asyncio.wait_for(watch.events.get(), remaining)
+                if event["event"] == "put":
+                    return event["value"]
+        finally:
+            await watch.cancel()

@@ -1,0 +1,265 @@
+"""Endpoint client: the egress half of the request/response plane.
+
+Capability parity with reference PushRouter (lib/runtime/src/pipeline/network/
+egress/push_router.rs:29-54 — Random / RoundRobin / Direct routing; the KV mode
+layers on top in dynamo_tpu.llm.kv_router) and component Client/InstanceSource
+(component/client.rs:285): instances are discovered from a prefix watch and the
+live set updates as leases appear/expire. Responses stream back multiplexed on
+one duplex TCP connection per instance (vs the reference's NATS request +
+reverse-TCP response design, egress/addressed_router.rs:69).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import random
+import uuid
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.runtime.component import Endpoint, Instance, instance_prefix
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.errors import EngineError, NoInstancesError, StreamIncompleteError
+from dynamo_tpu.runtime.frame import read_frame, write_frame
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("client")
+
+_SENTINEL = object()
+
+
+class _InstanceConn:
+    """One multiplexed connection to an instance."""
+
+    def __init__(self, instance: Instance):
+        self.instance = instance
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._streams: dict[str, asyncio.Queue] = {}
+        self._reader_task: asyncio.Task | None = None
+        self._send_lock = asyncio.Lock()
+        self.alive = False
+
+    async def connect(self) -> None:
+        self._reader, self._writer = await asyncio.open_connection(
+            self.instance.host, self.instance.port)
+        self.alive = True
+        self._reader_task = asyncio.create_task(self._read_loop())
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                msg = await read_frame(self._reader)
+                q = self._streams.get(msg.get("rid"))
+                if q is None:
+                    continue
+                t = msg.get("t")
+                if t == "data":
+                    q.put_nowait(("data", msg.get("p")))
+                elif t == "final":
+                    q.put_nowait(("final", None))
+                elif t == "err":
+                    q.put_nowait(("err", msg.get("e")))
+        except (asyncio.IncompleteReadError, ConnectionError, ValueError, OSError):
+            pass
+        finally:
+            self.alive = False
+            for q in self._streams.values():
+                q.put_nowait(("lost", None))
+
+    async def send(self, obj: dict) -> None:
+        if not self.alive:
+            raise ConnectionError("instance connection lost")
+        async with self._send_lock:
+            await write_frame(self._writer, obj)
+
+    def open_stream(self, rid: str) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue()
+        self._streams[rid] = q
+        return q
+
+    def close_stream(self, rid: str) -> None:
+        self._streams.pop(rid, None)
+
+    def close(self) -> None:
+        self.alive = False
+        if self._reader_task:
+            self._reader_task.cancel()
+        if self._writer:
+            self._writer.close()
+
+
+class EndpointClient:
+    def __init__(self, runtime, endpoint: Endpoint, router_mode: str = "round_robin"):
+        self._runtime = runtime
+        self._endpoint = endpoint
+        self.router_mode = router_mode
+        self._instances: dict[int, Instance] = {}
+        self._conns: dict[int, _InstanceConn] = {}
+        self._conn_locks: dict[int, asyncio.Lock] = {}
+        self._rr = itertools.count()
+        self._watch = None
+        self._watch_task: asyncio.Task | None = None
+        self._instances_event = asyncio.Event()
+
+    async def start(self) -> None:
+        if self._runtime.has_discovery:
+            prefix = instance_prefix(self._endpoint.component.namespace,
+                                     self._endpoint.component.name,
+                                     self._endpoint.name)
+            self._watch = await self._runtime.coordinator_client.watch_prefix(prefix)
+            for entry in self._watch.snapshot:
+                self._add_instance(Instance.from_wire(entry["v"]))
+            self._watch_task = asyncio.create_task(self._watch_loop())
+
+    def add_static_instance(self, instance: Instance) -> None:
+        """Static mode: directly-addressed instance (reference static mode,
+        distributed.rs:178)."""
+        self._add_instance(instance)
+
+    def _add_instance(self, instance: Instance) -> None:
+        self._instances[instance.instance_id] = instance
+        self._instances_event.set()
+
+    def _remove_instance(self, instance_id: int) -> None:
+        self._instances.pop(instance_id, None)
+        conn = self._conns.pop(instance_id, None)
+        if conn:
+            conn.close()
+        if not self._instances:
+            self._instances_event.clear()
+
+    async def _watch_loop(self) -> None:
+        async for event in self._watch:
+            if event["event"] == "put":
+                self._add_instance(Instance.from_wire(event["value"]))
+            else:
+                # key tail is the hex instance id
+                try:
+                    iid = int(event["key"].rsplit("/", 1)[-1], 16)
+                except ValueError:
+                    continue
+                self._remove_instance(iid)
+
+    # -- instance selection ---------------------------------------------------
+    def instance_ids(self) -> list[int]:
+        return sorted(self._instances)
+
+    async def wait_for_instances(self, timeout: float = 30.0) -> list[int]:
+        try:
+            await asyncio.wait_for(self._instances_event.wait(), timeout)
+        except asyncio.TimeoutError:
+            raise NoInstancesError(
+                f"no instances for {self._endpoint.path} after {timeout}s") from None
+        return self.instance_ids()
+
+    def _select(self, mode: str, instance_id: int | None) -> Instance:
+        ids = self.instance_ids()
+        if not ids:
+            raise NoInstancesError(f"no instances for {self._endpoint.path}")
+        if mode == "direct":
+            if instance_id not in self._instances:
+                raise NoInstancesError(
+                    f"instance {instance_id:x} not found for {self._endpoint.path}")
+            return self._instances[instance_id]
+        if mode == "random":
+            return self._instances[random.choice(ids)]
+        # round_robin
+        return self._instances[ids[next(self._rr) % len(ids)]]
+
+    async def _conn_for(self, instance: Instance) -> _InstanceConn:
+        # Per-instance lock: concurrent first requests share one connection
+        # instead of racing open_connection and leaking the losers.
+        lock = self._conn_locks.setdefault(instance.instance_id, asyncio.Lock())
+        async with lock:
+            conn = self._conns.get(instance.instance_id)
+            if conn is None or not conn.alive:
+                conn = _InstanceConn(instance)
+                await conn.connect()
+                self._conns[instance.instance_id] = conn
+            return conn
+
+    # -- request issue --------------------------------------------------------
+    async def generate(self, request: Any, context: Context | None = None,
+                       mode: str | None = None,
+                       instance_id: int | None = None) -> AsyncIterator[Any]:
+        """Route a request and return its response stream."""
+        ctx = context or Context()
+        mode = mode or self.router_mode
+        if instance_id is not None:
+            mode = "direct"
+        instance = self._select(mode, instance_id)
+        return self._stream(instance, request, ctx)
+
+    async def direct(self, request: Any, instance_id: int,
+                     context: Context | None = None) -> AsyncIterator[Any]:
+        return await self.generate(request, context, mode="direct",
+                                   instance_id=instance_id)
+
+    async def round_robin(self, request: Any, context: Context | None = None
+                          ) -> AsyncIterator[Any]:
+        return await self.generate(request, context, mode="round_robin")
+
+    async def random(self, request: Any, context: Context | None = None
+                     ) -> AsyncIterator[Any]:
+        return await self.generate(request, context, mode="random")
+
+    async def _stream(self, instance: Instance, request: Any, ctx: Context
+                      ) -> AsyncIterator[Any]:
+        rid = uuid.uuid4().hex
+        try:
+            conn = await self._conn_for(instance)
+            q = conn.open_stream(rid)
+            await conn.send({"t": "req", "rid": rid, "ctx": ctx.to_wire(),
+                             "p": request})
+        except (ConnectionError, OSError) as exc:
+            # Don't remove the instance from the routing set: its registration
+            # (and lease) may still be live and discovery is the single source
+            # of truth — removal happens only on a watch delete event. Just
+            # drop the dead connection so the next attempt redials.
+            conn = self._conns.pop(instance.instance_id, None)
+            if conn:
+                conn.close()
+            raise StreamIncompleteError(
+                f"Stream ended before generation completed "
+                f"(connect to {instance.instance_id:x} failed: {exc})") from exc
+        stop_sent = False
+        try:
+            while True:
+                if ctx.is_killed and not stop_sent:
+                    stop_sent = True
+                    try:
+                        await conn.send({"t": "kill", "rid": rid})
+                    except (ConnectionError, OSError):
+                        pass
+                    return
+                if ctx.is_stopped and not stop_sent:
+                    stop_sent = True
+                    try:
+                        await conn.send({"t": "stop", "rid": rid})
+                    except (ConnectionError, OSError):
+                        pass
+                kind, payload = await q.get()
+                if kind == "data":
+                    yield payload
+                elif kind == "final":
+                    return
+                elif kind == "err":
+                    if payload == "incomplete":
+                        raise StreamIncompleteError()
+                    raise EngineError(payload)
+                else:  # lost
+                    raise StreamIncompleteError(
+                        "Stream ended before generation completed "
+                        f"(connection to {instance.instance_id:x} lost)")
+        finally:
+            conn.close_stream(rid)
+
+    async def close(self) -> None:
+        if self._watch_task:
+            self._watch_task.cancel()
+        if self._watch:
+            await self._watch.cancel()
+        for conn in self._conns.values():
+            conn.close()
+        self._conns.clear()

@@ -1,0 +1,114 @@
+"""Component addressing model: Namespace -> Component -> Endpoint -> Instance.
+
+Capability parity with reference lib/runtime/src/component.rs: components are
+addressed ``{namespace}/{component}/{endpoint}``; live instances register
+themselves under the ``instances/`` KV root with their lease so that clients can
+discover and watch them (component.rs:74-98). Transport metadata in the
+registration tells clients how to reach the instance (here: framed TCP host/port
+instead of a NATS subject + reverse TCP — component.rs:82 TransportType).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import TYPE_CHECKING, Any, Awaitable, Callable
+
+if TYPE_CHECKING:
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+INSTANCE_ROOT = "instances/"
+COMPONENT_ROOT = "dynamo://"
+
+
+@dataclasses.dataclass(frozen=True)
+class Instance:
+    """A live endpoint instance (reference component.rs:98)."""
+
+    namespace: str
+    component: str
+    endpoint: str
+    instance_id: int
+    host: str
+    port: int
+
+    @property
+    def path(self) -> str:
+        return (f"{INSTANCE_ROOT}{self.namespace}/{self.component}/"
+                f"{self.endpoint}/{self.instance_id:x}")
+
+    def to_wire(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_wire(cls, data: dict) -> "Instance":
+        return cls(**{f.name: data[f.name] for f in dataclasses.fields(cls)})
+
+
+def instance_prefix(namespace: str, component: str, endpoint: str | None = None) -> str:
+    base = f"{INSTANCE_ROOT}{namespace}/{component}/"
+    return base if endpoint is None else f"{base}{endpoint}/"
+
+
+class Namespace:
+    def __init__(self, runtime: "DistributedRuntime", name: str):
+        self._runtime = runtime
+        self.name = name
+
+    def component(self, name: str) -> "Component":
+        return Component(self._runtime, self.name, name)
+
+
+class Component:
+    def __init__(self, runtime: "DistributedRuntime", namespace: str, name: str):
+        self._runtime = runtime
+        self.namespace = namespace
+        self.name = name
+
+    @property
+    def path(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def endpoint(self, name: str) -> "Endpoint":
+        return Endpoint(self._runtime, self, name)
+
+    # Subjects for this component's event planes (reference kv_router.rs:56-65).
+    def subject(self, plane: str) -> str:
+        return f"ns.{self.namespace}.cp.{self.name}.{plane}"
+
+
+class Endpoint:
+    def __init__(self, runtime: "DistributedRuntime", component: Component, name: str):
+        self._runtime = runtime
+        self.component = component
+        self.name = name
+
+    @property
+    def path(self) -> str:
+        return f"{self.component.path}/{self.name}"
+
+    async def serve_endpoint(
+        self,
+        handler: Callable[..., Any],
+        graceful_shutdown: bool = True,
+        metrics_labels: dict[str, str] | None = None,
+    ):
+        """Serve ``handler`` (async generator fn (request, context) -> yields
+        responses) as a discoverable instance. Reference:
+        endpoint.serve_endpoint (bindings rust/lib.rs:519 -> component/endpoint.rs:65).
+        Returns the EndpointServer (call .wait()/.shutdown())."""
+        from dynamo_tpu.runtime.service import EndpointServer
+
+        server = EndpointServer(self._runtime, self, handler,
+                                graceful_shutdown=graceful_shutdown,
+                                metrics_labels=metrics_labels or {})
+        await server.start()
+        return server
+
+    async def client(self, router_mode: str = "round_robin"):
+        """Create a discovering client for this endpoint (reference
+        component/client.rs:285 Client + InstanceSource)."""
+        from dynamo_tpu.runtime.client import EndpointClient
+
+        client = EndpointClient(self._runtime, self, router_mode=router_mode)
+        await client.start()
+        return client

@@ -1,0 +1,66 @@
+"""Request context: identity, cancellation, tracing.
+
+Capability parity with reference AsyncEngineContext (lib/runtime/src/engine.rs:124)
+and pipeline Context (lib/runtime/src/pipeline/context.rs): every request carries a
+stable id, a two-level cancellation signal (stop = graceful stop issuing final
+response; kill = hard abort), and trace context for distributed tracing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from typing import Any
+
+from dynamo_tpu.runtime.logging import generate_span_id, generate_trace_id
+
+
+class Context:
+    def __init__(self, request_id: str | None = None,
+                 trace_id: str | None = None, parent_span_id: str | None = None):
+        self.id: str = request_id or uuid.uuid4().hex
+        self.trace_id: str = trace_id or generate_trace_id()
+        self.span_id: str = generate_span_id()
+        self.parent_span_id = parent_span_id
+        self._stopped = asyncio.Event()
+        self._killed = asyncio.Event()
+        # Arbitrary cross-operator annotations (reference: context values).
+        self.values: dict[str, Any] = {}
+
+    # -- cancellation (engine.rs:124 stop_generating/kill) --------------------
+    def stop_generating(self) -> None:
+        """Ask the engine to finish up: emit its final usage/finish response
+        then end the stream."""
+        self._stopped.set()
+
+    def kill(self) -> None:
+        """Hard-abort: no further responses should be produced."""
+        self._stopped.set()
+        self._killed.set()
+
+    @property
+    def is_stopped(self) -> bool:
+        return self._stopped.is_set()
+
+    @property
+    def is_killed(self) -> bool:
+        return self._killed.is_set()
+
+    async def wait_stopped(self) -> None:
+        await self._stopped.wait()
+
+    def child(self) -> "Context":
+        """New span in the same trace, sharing cancellation."""
+        ctx = Context(self.id, self.trace_id, self.span_id)
+        ctx._stopped = self._stopped
+        ctx._killed = self._killed
+        ctx.values = self.values
+        return ctx
+
+    def to_wire(self) -> dict:
+        return {"id": self.id, "trace_id": self.trace_id, "span_id": self.span_id}
+
+    @classmethod
+    def from_wire(cls, data: dict | None) -> "Context":
+        data = data or {}
+        return cls(data.get("id"), data.get("trace_id"), data.get("span_id"))

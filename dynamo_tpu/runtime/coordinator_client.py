@@ -1,0 +1,280 @@
+"""Async client for the control-plane coordinator.
+
+Plays the role of the reference's etcd::Client (lib/runtime/src/transports/
+etcd.rs:46-310 — kv_create/kv_put/watch/lease with a primary lease kept alive in
+the background) and nats::Client (transports/nats.rs:58-120 — publish/subscribe/
+queues/object store) in one connection.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.runtime.frame import read_frame, write_frame
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("coordinator_client")
+
+
+class WatchStream:
+    """A prefix watch: initial snapshot + live put/delete events.
+
+    Reference: PrefixWatcher from kv_get_and_watch_prefix (etcd.rs:310)."""
+
+    def __init__(self, client: "CoordinatorClient", watch_id: int,
+                 snapshot: list[dict]):
+        self._client = client
+        self.watch_id = watch_id
+        self.snapshot = snapshot
+        self.events: asyncio.Queue[dict] = asyncio.Queue()
+
+    def __aiter__(self) -> AsyncIterator[dict]:
+        return self._iter()
+
+    async def _iter(self) -> AsyncIterator[dict]:
+        while True:
+            yield await self.events.get()
+
+    async def cancel(self) -> None:
+        self._client._watches.pop(self.watch_id, None)
+        try:
+            await self._client._request({"m": "unwatch", "watch_id": self.watch_id})
+        except ConnectionError:
+            pass
+
+
+class Subscription:
+    """A pub/sub subscription stream (reference: NATS subscribe)."""
+
+    def __init__(self, client: "CoordinatorClient", sub_id: int):
+        self._client = client
+        self.sub_id = sub_id
+        self.messages: asyncio.Queue[dict] = asyncio.Queue()
+
+    def __aiter__(self) -> AsyncIterator[dict]:
+        return self._iter()
+
+    async def _iter(self) -> AsyncIterator[dict]:
+        while True:
+            yield await self.messages.get()
+
+    async def cancel(self) -> None:
+        self._client._subs.pop(self.sub_id, None)
+        try:
+            await self._client._request({"m": "unsubscribe", "sub": self.sub_id})
+        except ConnectionError:
+            pass
+
+
+class CoordinatorClient:
+    def __init__(self, host: str, port: int):
+        self.host, self.port = host, port
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._ids = itertools.count(1)
+        self._pending: dict[int, asyncio.Future] = {}
+        self._watches: dict[int, WatchStream] = {}
+        self._subs: dict[int, Subscription] = {}
+        self._reader_task: asyncio.Task | None = None
+        self._keepalive_task: asyncio.Task | None = None
+        self._send_lock = asyncio.Lock()
+        self.primary_lease_id: int | None = None
+        self._lease_ttl_s = 10.0
+        self._lease_recreated_callbacks: list = []
+        self._closed = False
+
+    @classmethod
+    async def connect(cls, host: str, port: int, lease_ttl_s: float = 10.0,
+                      retries: int = 40, retry_delay: float = 0.25
+                      ) -> "CoordinatorClient":
+        client = cls(host, port)
+        last: Exception | None = None
+        for _ in range(retries):
+            try:
+                client._reader, client._writer = await asyncio.open_connection(host, port)
+                break
+            except OSError as exc:
+                last = exc
+                await asyncio.sleep(retry_delay)
+        else:
+            raise ConnectionError(f"coordinator unreachable at {host}:{port}: {last}")
+        client._reader_task = asyncio.create_task(client._read_loop())
+        # Primary lease: liveness anchor for everything this process registers
+        # (reference: etcd primary lease, transports/etcd/lease.rs).
+        client._lease_ttl_s = lease_ttl_s
+        client.primary_lease_id = await client.lease_grant(lease_ttl_s)
+        client._keepalive_task = asyncio.create_task(
+            client._keepalive_loop(client.primary_lease_id, lease_ttl_s / 3))
+        return client
+
+    async def close(self, revoke_lease: bool = True) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._keepalive_task:
+            self._keepalive_task.cancel()
+        if revoke_lease and self.primary_lease_id is not None:
+            try:
+                await self._request({"m": "lease_revoke", "lease": self.primary_lease_id})
+            except (ConnectionError, asyncio.IncompleteReadError):
+                pass
+        if self._reader_task:
+            self._reader_task.cancel()
+        if self._writer:
+            self._writer.close()
+
+    async def _read_loop(self) -> None:
+        assert self._reader is not None
+        try:
+            while True:
+                msg = await read_frame(self._reader)
+                if "i" in msg and msg["i"] is not None and ("ok" in msg):
+                    fut = self._pending.pop(msg["i"], None)
+                    if fut and not fut.done():
+                        if msg["ok"]:
+                            fut.set_result(msg.get("r"))
+                        else:
+                            fut.set_exception(RuntimeError(msg.get("e")))
+                elif "w" in msg:
+                    watch = self._watches.get(msg["w"])
+                    if watch:
+                        watch.events.put_nowait(
+                            {"event": msg["ev"], "key": msg["k"], "value": msg.get("v")})
+                elif "s" in msg:
+                    sub = self._subs.get(msg["s"])
+                    if sub:
+                        sub.messages.put_nowait(
+                            {"subject": msg["subject"], "payload": msg["payload"]})
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("coordinator connection lost"))
+            self._pending.clear()
+
+    def on_lease_recreated(self, callback) -> None:
+        """Register an async callback invoked (with the new lease id) after the
+        primary lease had to be re-granted — used by endpoint servers to re-put
+        their registrations so a transient stall doesn't silently drain traffic."""
+        self._lease_recreated_callbacks.append(callback)
+
+    async def _keepalive_loop(self, lease_id: int, interval: float) -> None:
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                await self._request({"m": "lease_keepalive", "lease": lease_id})
+            except ConnectionError:
+                log.warning("coordinator connection lost; keepalive stopped")
+                return
+            except RuntimeError as exc:
+                if "not found" not in str(exc):
+                    log.warning("lease keepalive error (will retry): %s", exc)
+                    continue
+                # Lease expired server-side (e.g. event-loop stall past TTL):
+                # re-grant and let registrants re-register.
+                log.error("primary lease %d expired; re-granting", lease_id)
+                try:
+                    lease_id = await self.lease_grant(self._lease_ttl_s)
+                    self.primary_lease_id = lease_id
+                    for cb in list(self._lease_recreated_callbacks):
+                        try:
+                            await cb(lease_id)
+                        except Exception:  # noqa: BLE001
+                            log.exception("lease-recreated callback failed")
+                except (ConnectionError, RuntimeError) as exc2:
+                    log.error("lease re-grant failed: %s", exc2)
+                    return
+
+    async def _request(self, msg: dict) -> Any:
+        if self._writer is None or self._writer.is_closing():
+            raise ConnectionError("not connected")
+        rid = next(self._ids)
+        msg["i"] = rid
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        async with self._send_lock:
+            await write_frame(self._writer, msg)
+        return await fut
+
+    # -- etcd-shaped API ------------------------------------------------------
+    async def lease_grant(self, ttl: float) -> int:
+        return await self._request({"m": "lease_grant", "ttl": ttl})
+
+    async def lease_revoke(self, lease_id: int) -> None:
+        await self._request({"m": "lease_revoke", "lease": lease_id})
+
+    async def kv_put(self, key: str, value: Any, lease_id: int | None = None,
+                     use_primary_lease: bool = False) -> int:
+        if use_primary_lease:
+            lease_id = self.primary_lease_id
+        return await self._request({"m": "kv_put", "k": key, "v": value,
+                                    "lease": lease_id})
+
+    async def kv_create(self, key: str, value: Any, lease_id: int | None = None,
+                        use_primary_lease: bool = False) -> bool:
+        """Atomic create; False if the key already exists (etcd.rs kv_create)."""
+        if use_primary_lease:
+            lease_id = self.primary_lease_id
+        rev = await self._request({"m": "kv_create", "k": key, "v": value,
+                                   "lease": lease_id})
+        return rev is not None
+
+    async def kv_get(self, key: str) -> Any | None:
+        result = await self._request({"m": "kv_get", "k": key})
+        return None if result is None else result["v"]
+
+    async def kv_get_prefix(self, prefix: str) -> list[dict]:
+        return await self._request({"m": "kv_get_prefix", "k": prefix})
+
+    async def kv_delete(self, key: str) -> bool:
+        return await self._request({"m": "kv_delete", "k": key})
+
+    async def kv_delete_prefix(self, prefix: str) -> int:
+        return await self._request({"m": "kv_delete_prefix", "k": prefix})
+
+    async def watch_prefix(self, prefix: str) -> WatchStream:
+        # Client allocates the watch id and registers the stream BEFORE the
+        # request, so events racing the watch response are never dropped.
+        wid = next(self._ids)
+        watch = WatchStream(self, wid, [])
+        self._watches[wid] = watch
+        try:
+            result = await self._request({"m": "watch", "k": prefix, "wid": wid})
+        except BaseException:
+            self._watches.pop(wid, None)
+            raise
+        watch.snapshot = result["snapshot"]
+        return watch
+
+    # -- NATS-shaped API ------------------------------------------------------
+    async def publish(self, subject: str, payload: Any) -> None:
+        await self._request({"m": "publish", "subject": subject, "payload": payload})
+
+    async def subscribe(self, subject: str) -> Subscription:
+        sid = next(self._ids)
+        sub = Subscription(self, sid)
+        self._subs[sid] = sub
+        try:
+            await self._request({"m": "subscribe", "subject": subject, "sid": sid})
+        except BaseException:
+            self._subs.pop(sid, None)
+            raise
+        return sub
+
+    async def queue_push(self, queue: str, item: Any) -> None:
+        await self._request({"m": "queue_push", "queue": queue, "item": item})
+
+    async def queue_pop(self, queue: str, timeout: float = 0.0) -> Any | None:
+        result = await self._request(
+            {"m": "queue_pop", "queue": queue, "timeout": timeout})
+        return None if result is None else result["item"]
+
+    async def queue_len(self, queue: str) -> int:
+        return await self._request({"m": "queue_len", "queue": queue})
+
+    async def object_put(self, key: str, data: bytes) -> None:
+        await self._request({"m": "object_put", "k": key, "v": data})
+
+    async def object_get(self, key: str) -> bytes | None:
+        return await self._request({"m": "object_get", "k": key})

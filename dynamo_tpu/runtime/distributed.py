@@ -1,0 +1,134 @@
+"""DistributedRuntime: the node-level singleton.
+
+Capability parity with reference DistributedRuntime (lib/runtime/src/
+distributed.rs:54-66): owns the control-plane client (coordinator = etcd+NATS),
+the metrics registry root, and the component registry; supports a *static* mode
+with no discovery (distributed.rs:178) used by single-process pipelines and
+tests. Also hosts the system status server when enabled (SURVEY.md §5.5).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import random
+
+from dynamo_tpu.runtime.component import Namespace
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.coordinator import Coordinator
+from dynamo_tpu.runtime.coordinator_client import CoordinatorClient
+from dynamo_tpu.runtime.logging import get_logger, init_logging
+from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+log = get_logger("distributed")
+
+
+class DistributedRuntime:
+    def __init__(self, config: RuntimeConfig):
+        self.config = config
+        self.coordinator_client: CoordinatorClient | None = None
+        self._embedded_coordinator: Coordinator | None = None
+        self.metrics = MetricsRegistry()
+        self.shutdown_event = asyncio.Event()
+        self.instance_id: int = random.getrandbits(63)
+
+    @classmethod
+    async def from_settings(cls, config: RuntimeConfig | None = None
+                            ) -> "DistributedRuntime":
+        """Connect to the coordinator (dynamic mode)."""
+        init_logging()
+        config = config or RuntimeConfig.from_settings()
+        runtime = cls(config)
+        host, port = config.coordinator_addr
+        runtime.coordinator_client = await CoordinatorClient.connect(
+            host, port, lease_ttl_s=config.lease_ttl_s)
+        # Instance ids are the primary lease id, as in the reference where the
+        # etcd lease id identifies the instance (component.rs:98).
+        runtime.instance_id = runtime.coordinator_client.primary_lease_id or runtime.instance_id
+        return runtime
+
+    @classmethod
+    async def detached(cls, config: RuntimeConfig | None = None
+                       ) -> "DistributedRuntime":
+        """Static mode: no control plane (reference
+        from_settings_without_discovery, distributed.rs:178)."""
+        init_logging()
+        config = config or RuntimeConfig.from_settings()
+        config.static_mode = True
+        return cls(config)
+
+    @classmethod
+    async def with_embedded_coordinator(
+            cls, config: RuntimeConfig | None = None) -> "DistributedRuntime":
+        """Single-process deployments (dynamo-run equivalent): start an
+        in-process coordinator, then connect to it."""
+        init_logging()
+        config = config or RuntimeConfig.from_settings()
+        coord = Coordinator("127.0.0.1", 0)
+        await coord.start()
+        config.coordinator_url = coord.url
+        runtime = await cls.from_settings(config)
+        runtime._embedded_coordinator = coord
+        return runtime
+
+    @property
+    def has_discovery(self) -> bool:
+        return self.coordinator_client is not None
+
+    def namespace(self, name: str | None = None) -> Namespace:
+        return Namespace(self, name or self.config.namespace)
+
+    def require_coordinator(self) -> CoordinatorClient:
+        if self.coordinator_client is None:
+            raise RuntimeError("runtime is in static mode (no control plane)")
+        return self.coordinator_client
+
+    def shutdown(self) -> None:
+        self.shutdown_event.set()
+
+    async def wait_for_shutdown(self) -> None:
+        await self.shutdown_event.wait()
+
+    async def close(self) -> None:
+        self.shutdown()
+        if self.coordinator_client is not None:
+            await self.coordinator_client.close()
+            self.coordinator_client = None
+        if self._embedded_coordinator is not None:
+            await self._embedded_coordinator.stop()
+            self._embedded_coordinator = None
+
+    @property
+    def advertise_host(self) -> str:
+        return self.config.advertise_host or self.config.bind_host
+
+
+def dynamo_worker():
+    """Decorator: ``@dynamo_worker()`` wraps ``async def main(runtime)`` into a
+    runnable entrypoint with runtime construction + signal handling (reference
+    Python binding @dynamo_worker, SURVEY.md call stack 3.2)."""
+
+    def wrap(fn):
+        def entry() -> None:
+            async def run() -> None:
+                runtime = await DistributedRuntime.from_settings()
+                import signal
+
+                loop = asyncio.get_running_loop()
+                for sig in (signal.SIGINT, signal.SIGTERM):
+                    try:
+                        loop.add_signal_handler(sig, runtime.shutdown)
+                    except NotImplementedError:  # non-main thread
+                        pass
+                try:
+                    await fn(runtime)
+                finally:
+                    await runtime.close()
+
+            asyncio.run(run())
+
+        entry.__name__ = fn.__name__
+        entry.inner = fn
+        return entry
+
+    return wrap

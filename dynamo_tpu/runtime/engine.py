@@ -1,0 +1,41 @@
+"""Core streaming-engine trait.
+
+Capability parity with reference AsyncEngine (lib/runtime/src/engine.rs:207):
+an engine maps one request to a stream of responses; every stream is associated
+with a Context granting id/stop/kill. The pipeline operators (preprocessor,
+backend/detokenizer, migration, router) all implement this same trait so they
+compose into the frontend-to-worker request path (SURVEY.md call stack 3.1).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.runtime.context import Context
+
+
+class AsyncEngine(abc.ABC):
+    """SingleIn -> ManyOut streaming engine."""
+
+    @abc.abstractmethod
+    def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        """Return an async iterator of responses for ``request``.
+
+        Implementations are async generators; cancellation is cooperative via
+        ``context.is_stopped`` / generator close.
+        """
+        raise NotImplementedError
+
+
+class Operator(AsyncEngine):
+    """An engine stage wrapping a downstream engine (reference pipeline
+    Operator, lib/runtime/src/pipeline/nodes.rs:122 — forward edge transforms
+    the request, backward edge transforms the response stream)."""
+
+    def __init__(self, inner: AsyncEngine | None = None):
+        self.inner = inner
+
+    def link(self, inner: AsyncEngine) -> "Operator":
+        self.inner = inner
+        return self

@@ -1,0 +1,23 @@
+"""Shared runtime error types."""
+
+
+class EngineError(RuntimeError):
+    """Error raised by an engine/handler, propagated through response streams."""
+
+
+class StreamIncompleteError(EngineError):
+    """The response stream ended before generation completed (worker died or
+    connection dropped mid-stream). The Migration operator retries on exactly
+    this condition (reference lib/llm/src/migration.rs:26 — matches on
+    'Stream ended before generation completed')."""
+
+    def __init__(self, message: str = "Stream ended before generation completed"):
+        super().__init__(message)
+
+
+class NoInstancesError(EngineError):
+    """No live instances are registered for the target endpoint."""
+
+
+class OverloadedError(EngineError):
+    """All workers busy (reference: router 503 busy_threshold path)."""

@@ -1,0 +1,42 @@
+"""Length-prefixed msgpack framing over asyncio streams.
+
+This is the wire codec for both the control plane (coordinator) and the request/
+response plane. Capability parity with the reference TwoPartCodec
+(lib/runtime/src/pipeline/network/codec/two_part.rs): a frame is a 4-byte
+big-endian length followed by a msgpack map; request/response payloads embed a
+separate ``header``/``data`` split inside the map, preserving the two-part shape
+without a bespoke binary layout.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Any
+
+import msgpack
+
+MAX_FRAME = 256 * 1024 * 1024  # 256 MiB hard cap
+_LEN = struct.Struct(">I")
+
+
+def encode_frame(obj: Any) -> bytes:
+    body = msgpack.packb(obj, use_bin_type=True)
+    if len(body) > MAX_FRAME:
+        raise ValueError(f"frame too large: {len(body)}")
+    return _LEN.pack(len(body)) + body
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Any:
+    """Read one frame; raises asyncio.IncompleteReadError on clean EOF."""
+    header = await reader.readexactly(4)
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME:
+        raise ValueError(f"frame too large: {length}")
+    body = await reader.readexactly(length)
+    return msgpack.unpackb(body, raw=False)
+
+
+async def write_frame(writer: asyncio.StreamWriter, obj: Any) -> None:
+    writer.write(encode_frame(obj))
+    await writer.drain()

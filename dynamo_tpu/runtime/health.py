@@ -1,0 +1,60 @@
+"""System status server: /health, /live, /metrics.
+
+Capability parity with reference spawn_system_status_server
+(lib/runtime/src/system_status_server.rs:85-121) and SystemHealth
+(lib.rs:90-120): per-process HTTP server exposing liveness, per-endpoint health,
+and Prometheus metrics, gated by config (DTPU_SYSTEM_ENABLED/PORT ~
+DYN_SYSTEM_*, config.rs:85-123).
+"""
+
+from __future__ import annotations
+
+import json
+
+from aiohttp import web
+
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("health")
+
+
+class SystemStatusServer:
+    def __init__(self, runtime, host: str = "0.0.0.0", port: int = 0):
+        self._runtime = runtime
+        self.host, self.port = host, port
+        self._endpoint_health: dict[str, bool] = {}
+        self._runner: web.AppRunner | None = None
+
+    def set_endpoint_health(self, endpoint_path: str, healthy: bool) -> None:
+        self._endpoint_health[endpoint_path] = healthy
+
+    async def start(self) -> None:
+        app = web.Application()
+        app.router.add_get("/health", self._health)
+        app.router.add_get("/live", self._live)
+        app.router.add_get("/metrics", self._metrics)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+        log.info("system status server on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    async def _health(self, _request: web.Request) -> web.Response:
+        healthy = all(self._endpoint_health.values()) if self._endpoint_health else True
+        body = {"status": "healthy" if healthy else "unhealthy",
+                "endpoints": self._endpoint_health}
+        return web.Response(text=json.dumps(body), status=200 if healthy else 503,
+                            content_type="application/json")
+
+    async def _live(self, _request: web.Request) -> web.Response:
+        return web.Response(text=json.dumps({"status": "live"}),
+                            content_type="application/json")
+
+    async def _metrics(self, _request: web.Request) -> web.Response:
+        return web.Response(body=self._runtime.metrics.expose(),
+                            content_type="text/plain")

@@ -1,0 +1,100 @@
+"""Structured logging with distributed trace-context propagation.
+
+Capability parity with reference lib/runtime/src/logging.rs: env-filtered levels
+(DTPU_LOG ~ DYN_LOG, logging.rs:73), optional JSONL output (logging.rs:12), and
+W3C trace-context trace_id/span_id generation + traceparent parse/inject
+(logging.rs:111-175) so a request can be traced frontend -> worker.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import logging
+import os
+import secrets
+import sys
+import time
+
+_configured = False
+
+# Per-task trace context (propagated through request headers / frames).
+current_trace: contextvars.ContextVar[dict | None] = contextvars.ContextVar(
+    "dtpu_trace", default=None
+)
+
+
+def generate_trace_id() -> str:
+    """128-bit lowercase hex trace id (W3C trace-context; logging.rs:111)."""
+    return secrets.token_hex(16)
+
+
+def generate_span_id() -> str:
+    """64-bit lowercase hex span id (logging.rs:119)."""
+    return secrets.token_hex(8)
+
+
+def parse_traceparent(header: str) -> dict | None:
+    """Parse a W3C ``traceparent`` header (logging.rs:127-175)."""
+    parts = header.strip().split("-")
+    if len(parts) != 4:
+        return None
+    version, trace_id, parent_id, flags = parts
+    if len(trace_id) != 32 or len(parent_id) != 16:
+        return None
+    return {"trace_id": trace_id, "parent_id": parent_id, "flags": flags,
+            "version": version}
+
+
+def make_traceparent(trace_id: str, span_id: str, sampled: bool = True) -> str:
+    return f"00-{trace_id}-{span_id}-{'01' if sampled else '00'}"
+
+
+class _JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": time.time(),
+            "level": record.levelname,
+            "target": record.name,
+            "message": record.getMessage(),
+        }
+        trace = current_trace.get()
+        if trace:
+            out["trace_id"] = trace.get("trace_id")
+            out["span_id"] = trace.get("span_id")
+        if record.exc_info:
+            out["exception"] = self.formatException(record.exc_info)
+        return json.dumps(out)
+
+
+class _TextFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        trace = current_trace.get()
+        tid = f" trace={trace['trace_id'][:8]}" if trace else ""
+        ts = time.strftime("%H:%M:%S", time.localtime(record.created))
+        return (f"{ts}.{int(record.msecs):03d} {record.levelname:<5} "
+                f"{record.name}{tid}: {record.getMessage()}"
+                + (f"\n{self.formatException(record.exc_info)}" if record.exc_info else ""))
+
+
+def init_logging(level: str | None = None, jsonl: bool | None = None) -> None:
+    """Idempotent logging init. DTPU_LOG sets the level filter; DTPU_LOG_JSONL=1
+    switches to JSONL (reference logging.rs:8-16)."""
+    global _configured
+    if _configured:
+        return
+    _configured = True
+    level = level or os.environ.get("DTPU_LOG", "info")
+    jsonl = jsonl if jsonl is not None else (
+        os.environ.get("DTPU_LOG_JSONL", "0").lower() in ("1", "true"))
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(_JsonFormatter() if jsonl else _TextFormatter())
+    root = logging.getLogger("dynamo_tpu")
+    root.handlers[:] = [handler]
+    root.setLevel(getattr(logging, level.upper(), logging.INFO))
+    root.propagate = False
+
+
+def get_logger(name: str) -> logging.Logger:
+    init_logging()
+    return logging.getLogger(f"dynamo_tpu.{name}")

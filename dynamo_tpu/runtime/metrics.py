@@ -1,0 +1,123 @@
+"""Hierarchical metrics registry.
+
+Capability parity with reference MetricsRegistry (lib/runtime/src/metrics.rs):
+a tree of registries (runtime -> namespace -> component -> endpoint) whose
+constituents auto-label every metric with its position in the hierarchy
+(metrics.rs auto-labels; names in metrics/prometheus_names.rs). Backed by
+prometheus_client; exposition text is served by the system status server.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Sequence
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+# Metric name prefix (reference: prometheus_names.rs uses "dynamo_*").
+PREFIX = "dynamo_tpu"
+
+HIER_LABELS = ("dynamo_namespace", "dynamo_component", "dynamo_endpoint")
+
+
+class MetricsRegistry:
+    """A node in the metrics hierarchy. Children share the root collector
+    registry; each level fills in one more hierarchy label."""
+
+    def __init__(
+        self,
+        registry: CollectorRegistry | None = None,
+        hierarchy: tuple[str, str, str] = ("", "", ""),
+        _root: "MetricsRegistry | None" = None,
+    ) -> None:
+        self.registry = registry or CollectorRegistry()
+        self._hierarchy = hierarchy
+        self._root = _root or self
+        if _root is None:
+            self._metrics: dict[str, object] = {}
+            self._lock = threading.Lock()
+
+    def child(self, level: int, name: str) -> "MetricsRegistry":
+        hier = list(self._hierarchy)
+        hier[level] = name
+        return MetricsRegistry(self.registry, tuple(hier), self._root)
+
+    def namespace(self, name: str) -> "MetricsRegistry":
+        return self.child(0, name)
+
+    def component(self, name: str) -> "MetricsRegistry":
+        return self.child(1, name)
+
+    def endpoint(self, name: str) -> "MetricsRegistry":
+        return self.child(2, name)
+
+    # -- metric constructors -------------------------------------------------
+    def _get_or_create(self, kind, name: str, desc: str,
+                       extra_labels: Sequence[str], **kwargs):
+        full = f"{PREFIX}_{name}"
+        labelnames = tuple(HIER_LABELS) + tuple(extra_labels)
+        root = self._root
+        with root._lock:
+            found = root._metrics.get(full)
+            if found is None:
+                found = kind(full, desc, labelnames=labelnames,
+                             registry=self.registry, **kwargs)
+                root._metrics[full] = found
+        return found
+
+    def counter(self, name: str, desc: str, labels: Sequence[str] = ()):
+        metric = self._get_or_create(Counter, name, desc, labels)
+        return _Bound(metric, self._hierarchy, labels)
+
+    def gauge(self, name: str, desc: str, labels: Sequence[str] = ()):
+        metric = self._get_or_create(Gauge, name, desc, labels)
+        return _Bound(metric, self._hierarchy, labels)
+
+    def histogram(self, name: str, desc: str, labels: Sequence[str] = (),
+                  buckets: Sequence[float] | None = None):
+        kwargs = {"buckets": tuple(buckets)} if buckets else {}
+        metric = self._get_or_create(Histogram, name, desc, labels, **kwargs)
+        return _Bound(metric, self._hierarchy, labels)
+
+    def expose(self) -> bytes:
+        """Prometheus text exposition for /metrics."""
+        return generate_latest(self.registry)
+
+
+class _Bound:
+    """A metric pre-bound to its hierarchy labels; extra labels at call time."""
+
+    def __init__(self, metric, hierarchy: tuple[str, str, str],
+                 extra_labels: Sequence[str]):
+        self._metric = metric
+        self._hier = hierarchy
+        self._extra = tuple(extra_labels)
+
+    def _resolve(self, **labels):
+        vals = dict(zip(HIER_LABELS, self._hier))
+        for k in self._extra:
+            vals[k] = labels.get(k, "")
+        return self._metric.labels(**vals)
+
+    def inc(self, amount: float = 1.0, **labels):
+        self._resolve(**labels).inc(amount)
+
+    def dec(self, amount: float = 1.0, **labels):
+        self._resolve(**labels).dec(amount)
+
+    def set(self, value: float, **labels):
+        self._resolve(**labels).set(value)
+
+    def observe(self, value: float, **labels):
+        self._resolve(**labels).observe(value)
+
+    def get(self, **labels) -> float:
+        child = self._resolve(**labels)
+        # prometheus_client internals: _value for counter/gauge.
+        return child._value.get()  # type: ignore[attr-defined]

@@ -1,0 +1,200 @@
+"""Endpoint server: the ingress half of the request/response plane.
+
+Capability parity with reference PushEndpoint/push_handler (lib/runtime/src/
+pipeline/network/ingress/push_endpoint.rs:21, push_handler.rs). Differences by
+design: the reference receives requests over NATS and streams responses back on
+a TCP socket the *caller* registered (egress/addressed_router.rs:69,153); on TPU
+pods we run a plain duplex framed-TCP server per endpoint instance — one
+connection carries many concurrent request streams, multiplexed by request id —
+which removes the NATS hop from the hot path. Control messages Stop/Kill mirror
+ControlMessage (network.rs:56-78).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, AsyncIterator, Callable
+
+from dynamo_tpu.runtime.component import Endpoint, Instance
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.frame import read_frame, write_frame
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("service")
+
+
+class EndpointServer:
+    def __init__(self, runtime, endpoint: Endpoint,
+                 handler: Callable[[Any, Context], AsyncIterator[Any]],
+                 graceful_shutdown: bool = True,
+                 metrics_labels: dict[str, str] | None = None):
+        self._runtime = runtime
+        self._endpoint = endpoint
+        self._handler = handler
+        self._graceful = graceful_shutdown
+        self._server: asyncio.AbstractServer | None = None
+        self._inflight: dict[str, tuple[asyncio.Task, Context]] = {}
+        self._stopping = asyncio.Event()
+        self.metrics_labels = metrics_labels or {}
+        self.instance: Instance | None = None
+        comp = endpoint.component
+        metrics = (runtime.metrics.namespace(comp.namespace)
+                   .component(comp.name).endpoint(endpoint.name))
+        # Reference metric names: work-handler request counters/latency
+        # (lib/runtime/src/pipeline/network/ingress/push_handler.rs).
+        self._m_requests = metrics.counter(
+            "requests_total", "Requests received by this endpoint")
+        self._m_errors = metrics.counter(
+            "request_errors_total", "Requests that ended in error")
+        self._m_inflight = metrics.gauge(
+            "inflight_requests", "Currently executing requests")
+        self._m_duration = metrics.histogram(
+            "request_duration_seconds", "Request handling latency")
+
+    async def start(self) -> None:
+        cfg = self._runtime.config
+        self._server = await asyncio.start_server(
+            self._handle_conn, cfg.bind_host, 0)
+        port = self._server.sockets[0].getsockname()[1]
+        self.instance = Instance(
+            namespace=self._endpoint.component.namespace,
+            component=self._endpoint.component.name,
+            endpoint=self._endpoint.name,
+            instance_id=self._runtime.instance_id,
+            host=self._runtime.advertise_host,
+            port=port,
+        )
+        if self._runtime.has_discovery:
+            # Registration rides the primary lease: process death => lease
+            # expiry => delete event => clients drop us (SURVEY.md §5.3).
+            # metrics_labels travel with the registration for scrapers/planner.
+            await self._register()
+            self._runtime.coordinator_client.on_lease_recreated(
+                self._on_lease_recreated)
+        log.info("endpoint %s serving as instance %x on %s:%d",
+                 self._endpoint.path, self.instance.instance_id,
+                 self.instance.host, port)
+
+    async def _register(self) -> None:
+        data = self.instance.to_wire()
+        if self.metrics_labels:
+            data["labels"] = self.metrics_labels
+        await self._runtime.coordinator_client.kv_put(
+            self.instance.path, data, use_primary_lease=True)
+
+    async def _on_lease_recreated(self, _new_lease_id: int) -> None:
+        """Primary lease was lost and re-granted: re-register so traffic
+        doesn't silently drain away."""
+        if not self._stopping.is_set():
+            await self._register()
+
+    @property
+    def port(self) -> int:
+        assert self.instance is not None
+        return self.instance.port
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        send_lock = asyncio.Lock()
+
+        async def send(obj: dict) -> None:
+            async with send_lock:
+                await write_frame(writer, obj)
+
+        conn_tasks: set[asyncio.Task] = set()
+        try:
+            while True:
+                msg = await read_frame(reader)
+                t = msg.get("t")
+                if t == "req":
+                    rid = msg["rid"]
+                    if self._stopping.is_set():
+                        # Draining: refuse new work so callers retry elsewhere.
+                        await send({"t": "err", "rid": rid, "e": "incomplete"})
+                        continue
+                    ctx = Context.from_wire(msg.get("ctx"))
+                    ctx.values["request_id"] = rid
+                    task = asyncio.create_task(
+                        self._run_request(rid, msg.get("p"), ctx, send))
+                    self._inflight[rid] = (task, ctx)
+                    conn_tasks.add(task)
+                    task.add_done_callback(conn_tasks.discard)
+                elif t == "stop":
+                    entry = self._inflight.get(msg["rid"])
+                    if entry:
+                        entry[1].stop_generating()
+                elif t == "kill":
+                    entry = self._inflight.get(msg["rid"])
+                    if entry:
+                        entry[1].kill()
+                        entry[0].cancel()
+        except (asyncio.IncompleteReadError, ConnectionError, ValueError):
+            pass
+        finally:
+            # Caller vanished: kill its in-flight work.
+            for task in conn_tasks:
+                task.cancel()
+            writer.close()
+
+    async def _run_request(self, rid: str, request: Any, ctx: Context,
+                           send) -> None:
+        self._m_requests.inc()
+        self._m_inflight.inc()
+        started = time.monotonic()
+        try:
+            async for response in self._handler(request, ctx):
+                if ctx.is_killed:
+                    break
+                await send({"t": "data", "rid": rid, "p": response})
+            if ctx.is_killed:
+                await send({"t": "err", "rid": rid, "e": "killed"})
+            else:
+                await send({"t": "final", "rid": rid})
+        except asyncio.CancelledError:
+            raise
+        except GeneratorExit:
+            # Handler signals an incomplete stream (migration trigger;
+            # reference docs/guides/backend.md §Migrate).
+            self._m_errors.inc()
+            try:
+                await send({"t": "err", "rid": rid, "e": "incomplete"})
+            except (ConnectionError, OSError):
+                pass
+        except Exception as exc:  # noqa: BLE001 — ship to caller
+            self._m_errors.inc()
+            log.warning("handler error for %s: %s", rid, exc, exc_info=True)
+            try:
+                await send({"t": "err", "rid": rid,
+                            "e": f"{type(exc).__name__}: {exc}"})
+            except (ConnectionError, OSError):
+                pass
+        finally:
+            self._m_inflight.dec()
+            self._m_duration.observe(time.monotonic() - started)
+            self._inflight.pop(rid, None)
+
+    async def shutdown(self) -> None:
+        """Deregister, then drain (graceful) or cancel (fast) in-flight work.
+        Reference: serve_endpoint(graceful_shutdown=...) — decode workers exit
+        fast so streams migrate (vllm main.py:151-161)."""
+        self._stopping.set()
+        if self._runtime.has_discovery and self.instance is not None:
+            try:
+                await self._runtime.coordinator_client.kv_delete(self.instance.path)
+            except (ConnectionError, RuntimeError):
+                pass
+        if self._graceful:
+            deadline = time.monotonic() + self._runtime.config.shutdown_timeout_s
+            while self._inflight and time.monotonic() < deadline:
+                await asyncio.sleep(0.05)
+        for task, ctx in list(self._inflight.values()):
+            ctx.kill()
+            task.cancel()
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def wait(self) -> None:
+        if self._server:
+            await self._server.serve_forever()

@@ -1,0 +1,36 @@
+"""Test configuration.
+
+Distributed/sharding tests run on a virtual 8-device CPU mesh (no TPUs needed),
+mirroring the reference's strategy of testing the distributed stack with local
+processes + simulators (SURVEY.md §4). Set env BEFORE jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("DTPU_LOG", "warning")
+
+import asyncio
+import functools
+
+import pytest
+
+
+def async_test(fn):
+    """Run an async test function to completion on a fresh event loop
+    (pytest-asyncio is not available in this environment)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        return asyncio.run(asyncio.wait_for(fn(*args, **kwargs), timeout=120))
+
+    return wrapper
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"

@@ -1,0 +1,180 @@
+"""Control-plane coordinator tests: KV/lease/watch/pubsub/queue semantics.
+
+Mirrors the reference's etcd/NATS transport tests (lib/runtime/src/transports/*)
+run against a real local server (tests/conftest.py:176-220 EtcdServer/NatsServer
+fixtures) — here the server is in-process.
+"""
+
+import asyncio
+
+from conftest import async_test
+
+from dynamo_tpu.runtime.coordinator import Coordinator, subject_matches
+from dynamo_tpu.runtime.coordinator_client import CoordinatorClient
+
+
+async def start_pair(ttl=1.0):
+    coord = Coordinator()
+    await coord.start()
+    client = await CoordinatorClient.connect("127.0.0.1", coord.port, lease_ttl_s=ttl)
+    return coord, client
+
+
+@async_test
+async def test_kv_put_get_delete():
+    coord, client = await start_pair()
+    try:
+        await client.kv_put("a/b", {"x": 1})
+        assert await client.kv_get("a/b") == {"x": 1}
+        await client.kv_put("a/c", [1, 2])
+        entries = await client.kv_get_prefix("a/")
+        assert [e["k"] for e in entries] == ["a/b", "a/c"]
+        assert await client.kv_delete("a/b") is True
+        assert await client.kv_get("a/b") is None
+    finally:
+        await client.close()
+        await coord.stop()
+
+
+@async_test
+async def test_kv_create_atomic():
+    coord, client = await start_pair()
+    try:
+        assert await client.kv_create("k", 1) is True
+        assert await client.kv_create("k", 2) is False
+        assert await client.kv_get("k") == 1
+    finally:
+        await client.close()
+        await coord.stop()
+
+
+@async_test
+async def test_lease_expiry_deletes_keys_and_fires_watch():
+    coord, client = await start_pair()
+    watcher = await CoordinatorClient.connect("127.0.0.1", coord.port)
+    try:
+        lease = await client.lease_grant(0.5)
+        await client.kv_put("instances/ns/c/e/1", {"id": 1}, lease_id=lease)
+        watch = await watcher.watch_prefix("instances/")
+        assert len(watch.snapshot) == 1
+        # No keepalives: lease expires and the key delete propagates to watch.
+        event = await asyncio.wait_for(watch.events.get(), 5)
+        assert event["event"] == "delete"
+        assert event["key"] == "instances/ns/c/e/1"
+        assert await client.kv_get("instances/ns/c/e/1") is None
+    finally:
+        await watcher.close()
+        await client.close()
+        await coord.stop()
+
+
+@async_test
+async def test_primary_lease_keepalive_keeps_keys():
+    coord, client = await start_pair(ttl=0.6)
+    try:
+        await client.kv_put("reg/one", "v", use_primary_lease=True)
+        await asyncio.sleep(1.5)  # > ttl; keepalive task must be refreshing
+        assert await client.kv_get("reg/one") == "v"
+    finally:
+        await client.close()
+        await coord.stop()
+
+
+@async_test
+async def test_watch_snapshot_plus_events():
+    coord, client = await start_pair()
+    try:
+        await client.kv_put("p/1", "a")
+        watch = await client.watch_prefix("p/")
+        assert watch.snapshot[0]["v"] == "a"
+        await client.kv_put("p/2", "b")
+        ev = await asyncio.wait_for(watch.events.get(), 5)
+        assert (ev["event"], ev["key"], ev["value"]) == ("put", "p/2", "b")
+        await client.kv_delete("p/1")
+        ev = await asyncio.wait_for(watch.events.get(), 5)
+        assert (ev["event"], ev["key"]) == ("delete", "p/1")
+    finally:
+        await client.close()
+        await coord.stop()
+
+
+@async_test
+async def test_pubsub_wildcards():
+    coord, client = await start_pair()
+    try:
+        sub = await client.subscribe("ns.test.cp.*.kv_events")
+        all_sub = await client.subscribe("ns.test.>")
+        await client.publish("ns.test.cp.worker.kv_events", {"n": 1})
+        await client.publish("ns.other.cp.worker.kv_events", {"n": 2})
+        msg = await asyncio.wait_for(sub.messages.get(), 5)
+        assert msg["payload"] == {"n": 1}
+        msg = await asyncio.wait_for(all_sub.messages.get(), 5)
+        assert msg["payload"] == {"n": 1}
+        assert sub.messages.empty()
+    finally:
+        await client.close()
+        await coord.stop()
+
+
+def test_subject_matching():
+    assert subject_matches("a.b.c", "a.b.c")
+    assert subject_matches("a.*.c", "a.x.c")
+    assert not subject_matches("a.*.c", "a.x.y")
+    assert subject_matches("a.>", "a.b.c.d")
+    assert not subject_matches("a.b", "a.b.c")
+    assert not subject_matches("a.b.c", "a.b")
+
+
+@async_test
+async def test_queue_blocking_pop():
+    """Work-queue semantics (reference NatsQueue, transports/nats.rs:433-600)."""
+    coord, client = await start_pair()
+    try:
+        assert await client.queue_pop("q") is None  # empty, non-blocking
+        task = asyncio.create_task(client.queue_pop("q", timeout=5))
+        await asyncio.sleep(0.05)
+        await client.queue_push("q", {"job": 1})
+        assert (await asyncio.wait_for(task, 5)) == {"job": 1}
+        await client.queue_push("q", "a")
+        await client.queue_push("q", "b")
+        assert await client.queue_len("q") == 2
+        assert await client.queue_pop("q") == "a"
+        assert await client.queue_pop("q") == "b"
+    finally:
+        await client.close()
+        await coord.stop()
+
+
+@async_test
+async def test_object_store():
+    coord, client = await start_pair()
+    try:
+        blob = b"\x00tokenizer-bytes\xff" * 100
+        await client.object_put("models/tok", blob)
+        assert await client.object_get("models/tok") == blob
+        assert await client.object_get("missing") is None
+    finally:
+        await client.close()
+        await coord.stop()
+
+
+@async_test
+async def test_barrier_leader_worker():
+    from dynamo_tpu.runtime.barrier import LeaderBarrier, WorkerBarrier
+
+    coord, leader = await start_pair()
+    w1 = await CoordinatorClient.connect("127.0.0.1", coord.port)
+    w2 = await CoordinatorClient.connect("127.0.0.1", coord.port)
+    try:
+        lb = LeaderBarrier(leader, "boot", num_workers=2)
+        wb1 = WorkerBarrier(w1, "boot", "w1")
+        wb2 = WorkerBarrier(w2, "boot", "w2")
+        leader_task = asyncio.create_task(lb.sync({"layout": "fc"}))
+        r1, r2 = await asyncio.gather(wb1.sync({"rank": 0}), wb2.sync({"rank": 1}))
+        workers = await asyncio.wait_for(leader_task, 5)
+        assert r1 == {"layout": "fc"} and r2 == {"layout": "fc"}
+        assert workers == {"w1": {"rank": 0}, "w2": {"rank": 1}}
+    finally:
+        for c in (leader, w1, w2):
+            await c.close()
+        await coord.stop()

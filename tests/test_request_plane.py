@@ -1,0 +1,214 @@
+"""Request/response plane tests: serve_endpoint + EndpointClient routing.
+
+Mirrors reference lib/runtime/tests/pipeline.rs + lifecycle.rs: streaming
+request/response, router modes, discovery-driven instance add/remove,
+cancellation, and the incomplete-stream signal the Migration operator keys on.
+"""
+
+import asyncio
+
+from conftest import async_test
+
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.coordinator import Coordinator
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.errors import EngineError, NoInstancesError, StreamIncompleteError
+
+
+async def make_runtime(coord, **kwargs):
+    cfg = RuntimeConfig(coordinator_url=coord.url, lease_ttl_s=1.0, **kwargs)
+    return await DistributedRuntime.from_settings(cfg)
+
+
+async def echo_handler(request, context):
+    for tok in request["text"].split():
+        yield {"token": tok}
+
+
+@async_test
+async def test_serve_and_stream():
+    coord = Coordinator()
+    await coord.start()
+    worker = await make_runtime(coord)
+    frontend = await make_runtime(coord)
+    try:
+        ep = worker.namespace("test").component("echo").endpoint("generate")
+        server = await ep.serve_endpoint(echo_handler)
+        client = await frontend.namespace("test").component("echo").endpoint(
+            "generate").client()
+        await client.wait_for_instances(timeout=5)
+        stream = await client.generate({"text": "hello tpu world"})
+        out = [r["token"] async for r in stream]
+        assert out == ["hello", "tpu", "world"]
+        await server.shutdown()
+    finally:
+        await frontend.close()
+        await worker.close()
+        await coord.stop()
+
+
+@async_test
+async def test_round_robin_across_instances():
+    coord = Coordinator()
+    await coord.start()
+    w1 = await make_runtime(coord)
+    w2 = await make_runtime(coord)
+    frontend = await make_runtime(coord)
+    try:
+        async def ident_handler_factory(tag):
+            async def handler(request, context):
+                yield {"worker": tag}
+            return handler
+
+        ep1 = w1.namespace("t").component("c").endpoint("g")
+        ep2 = w2.namespace("t").component("c").endpoint("g")
+        await ep1.serve_endpoint(await ident_handler_factory("w1"))
+        await ep2.serve_endpoint(await ident_handler_factory("w2"))
+        client = await frontend.namespace("t").component("c").endpoint("g").client()
+        ids = await client.wait_for_instances(timeout=5)
+        while len(client.instance_ids()) < 2:
+            await asyncio.sleep(0.02)
+        seen = set()
+        for _ in range(4):
+            stream = await client.generate({}, mode="round_robin")
+            async for r in stream:
+                seen.add(r["worker"])
+        assert seen == {"w1", "w2"}
+        # direct routing
+        ids = client.instance_ids()
+        stream = await client.generate({}, instance_id=ids[0])
+        got = [r async for r in stream]
+        assert len(got) == 1
+    finally:
+        for rt in (frontend, w1, w2):
+            await rt.close()
+        await coord.stop()
+
+
+@async_test
+async def test_worker_death_incomplete_stream_and_deregistration():
+    coord = Coordinator()
+    await coord.start()
+    worker = await make_runtime(coord)
+    frontend = await make_runtime(coord)
+    try:
+        started = asyncio.Event()
+
+        async def hang_handler(request, context):
+            yield {"token": "first"}
+            started.set()
+            await asyncio.sleep(30)
+            yield {"token": "never"}
+
+        ep = worker.namespace("t").component("dying").endpoint("g")
+        server = await ep.serve_endpoint(hang_handler, graceful_shutdown=False)
+        client = await frontend.namespace("t").component("dying").endpoint("g").client()
+        await client.wait_for_instances(timeout=5)
+
+        async def consume():
+            stream = await client.generate({})
+            return [r async for r in stream]
+
+        task = asyncio.create_task(consume())
+        await asyncio.wait_for(started.wait(), 5)
+        # Hard-kill the worker's server (connection drops mid-stream).
+        server._server.close()
+        for conn_task in list(server._inflight.values()):
+            conn_task[0].cancel()
+        await worker.close()  # revokes lease -> delete event -> client drops instance
+        try:
+            await asyncio.wait_for(task, 10)
+            raise AssertionError("expected StreamIncompleteError")
+        except StreamIncompleteError:
+            pass
+        # discovery removed the instance
+        for _ in range(100):
+            if not client.instance_ids():
+                break
+            await asyncio.sleep(0.05)
+        assert client.instance_ids() == []
+    finally:
+        await frontend.close()
+        await coord.stop()
+
+
+@async_test
+async def test_handler_error_propagates():
+    coord = Coordinator()
+    await coord.start()
+    worker = await make_runtime(coord)
+    frontend = await make_runtime(coord)
+    try:
+        async def bad_handler(request, context):
+            yield {"ok": True}
+            raise ValueError("engine exploded")
+
+        ep = worker.namespace("t").component("bad").endpoint("g")
+        await ep.serve_endpoint(bad_handler)
+        client = await frontend.namespace("t").component("bad").endpoint("g").client()
+        await client.wait_for_instances(timeout=5)
+        stream = await client.generate({})
+        got = []
+        try:
+            async for r in stream:
+                got.append(r)
+            raise AssertionError("expected EngineError")
+        except EngineError as exc:
+            assert "engine exploded" in str(exc)
+        assert got == [{"ok": True}]
+    finally:
+        await frontend.close()
+        await worker.close()
+        await coord.stop()
+
+
+@async_test
+async def test_no_instances_error():
+    coord = Coordinator()
+    await coord.start()
+    frontend = await make_runtime(coord)
+    try:
+        client = await frontend.namespace("t").component("ghost").endpoint("g").client()
+        try:
+            await client.generate({})
+            raise AssertionError("expected NoInstancesError")
+        except NoInstancesError:
+            pass
+    finally:
+        await frontend.close()
+        await coord.stop()
+
+
+@async_test
+async def test_context_stop_generating():
+    coord = Coordinator()
+    await coord.start()
+    worker = await make_runtime(coord)
+    frontend = await make_runtime(coord)
+    try:
+        async def infinite_handler(request, context):
+            i = 0
+            while not context.is_stopped:
+                yield {"i": i}
+                i += 1
+                await asyncio.sleep(0.01)
+            yield {"final": True}
+
+        ep = worker.namespace("t").component("inf").endpoint("g")
+        await ep.serve_endpoint(infinite_handler)
+        client = await frontend.namespace("t").component("inf").endpoint("g").client()
+        await client.wait_for_instances(timeout=5)
+        ctx = Context()
+        stream = await client.generate({}, context=ctx)
+        got = []
+        async for r in stream:
+            got.append(r)
+            if len(got) == 3:
+                ctx.stop_generating()
+        assert got[-1] == {"final": True}
+        assert len(got) >= 4
+    finally:
+        await frontend.close()
+        await worker.close()
+        await coord.stop()
