@@ -1,0 +1,3 @@
+"""Worker backends (capability parity with reference components/backends/*):
+echo (pipeline smoke), mocker (TPU-timing simulator), tpu (the real JAX engine).
+"""

@@ -1,0 +1,70 @@
+"""Echo worker: serves the echo engine as a registered model.
+
+``python -m dynamo_tpu.backends.echo --model-name echo`` — the minimum
+end-to-end worker (reference parity: dynamo-run out=echo, engines.rs EchoFull).
+Uses a built-in test tokenizer unless --tokenizer points at a tokenizer.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from dynamo_tpu.llm.engines import EchoEngine
+from dynamo_tpu.llm.model_card import register_llm
+from dynamo_tpu.llm.tokenizer import Tokenizer, make_test_tokenizer
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(description="dynamo-tpu echo worker")
+    parser.add_argument("--model-name", default="echo")
+    parser.add_argument("--namespace", default=None)
+    parser.add_argument("--endpoint", default="generate")
+    parser.add_argument("--component", default="echo")
+    parser.add_argument("--tokenizer", default=None,
+                        help="path to a tokenizer.json (default: built-in test tokenizer)")
+    parser.add_argument("--token-delay", type=float, default=0.0)
+    parser.add_argument("--migration-limit", type=int, default=0)
+    parser.add_argument("--coordinator-url", default=None)
+    return parser.parse_args(argv)
+
+
+async def run(args: argparse.Namespace) -> None:
+    cfg = RuntimeConfig.from_settings()
+    if args.coordinator_url:
+        cfg.coordinator_url = args.coordinator_url
+    if args.namespace:
+        cfg.namespace = args.namespace
+    runtime = await DistributedRuntime.from_settings(cfg)
+    try:
+        tokenizer = (Tokenizer.from_file(args.tokenizer) if args.tokenizer
+                     else make_test_tokenizer())
+        engine = EchoEngine(token_delay_s=args.token_delay)
+        endpoint = (runtime.namespace(None).component(args.component)
+                    .endpoint(args.endpoint))
+        server = await endpoint.serve_endpoint(engine.handler(),
+                                               graceful_shutdown=False)
+        await register_llm(runtime, endpoint, args.model_name, tokenizer,
+                           migration_limit=args.migration_limit)
+        print(f"ECHO_WORKER_READY port={server.port}", flush=True)
+        import signal
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, runtime.shutdown)
+            except NotImplementedError:
+                pass
+        await runtime.wait_for_shutdown()
+        await server.shutdown()
+    finally:
+        await runtime.close()
+
+
+def main() -> None:
+    asyncio.run(run(parse_args()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""Frontend node: OpenAI HTTP ingress + discovery + preprocessor + router.
+
+Capability parity with reference components/frontend (main.py:24-268 —
+``python -m dynamo.frontend``): one process packaging the HTTP service, model
+watcher (auto-discovery of workers via the control plane), tokenization, and
+routing. Run as ``python -m dynamo_tpu.frontend``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+from dynamo_tpu.llm.http_service import HttpService
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("frontend")
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(
+        description="dynamo-tpu OpenAI frontend")
+    parser.add_argument("--http-host", default="0.0.0.0")
+    parser.add_argument("--http-port", type=int, default=8000)
+    parser.add_argument("--namespace", default=None)
+    parser.add_argument("--router-mode", default="round_robin",
+                        choices=["round_robin", "random", "kv"],
+                        help="worker selection policy (kv = KV-cache-aware; "
+                             "requires dynamo_tpu.llm.kv_router)")
+    parser.add_argument("--kv-overlap-score-weight", type=float, default=1.0)
+    parser.add_argument("--kv-router-temperature", type=float, default=0.0)
+    parser.add_argument("--busy-threshold", type=float, default=None,
+                        help="reject (503) when all workers exceed this load")
+    parser.add_argument("--coordinator-url", default=None)
+    return parser.parse_args(argv)
+
+
+async def run(args: argparse.Namespace) -> None:
+    cfg = RuntimeConfig.from_settings()
+    if args.coordinator_url:
+        cfg.coordinator_url = args.coordinator_url
+    if args.namespace:
+        cfg.namespace = args.namespace
+    runtime = await DistributedRuntime.from_settings(cfg)
+
+    kv_router_factory = None
+    if args.router_mode == "kv":
+        from dynamo_tpu.llm.kv_router import make_kv_router_factory
+
+        kv_router_factory = make_kv_router_factory(
+            overlap_score_weight=args.kv_overlap_score_weight,
+            temperature=args.kv_router_temperature,
+            busy_threshold=args.busy_threshold)
+
+    manager = ModelManager()
+    watcher = ModelWatcher(runtime, manager, router_mode=args.router_mode,
+                           kv_router_factory=kv_router_factory)
+    await watcher.start()
+    service = HttpService(runtime, manager, args.http_host, args.http_port)
+    await service.start()
+
+    import signal
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, runtime.shutdown)
+        except NotImplementedError:
+            pass
+    try:
+        await runtime.wait_for_shutdown()
+    finally:
+        await service.stop()
+        await watcher.stop()
+        await runtime.close()
+
+
+def main() -> None:
+    asyncio.run(run(parse_args()))
+
+
+if __name__ == "__main__":
+    main()

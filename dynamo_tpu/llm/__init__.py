@@ -1,0 +1,7 @@
+"""LLM domain layer (capability parity with reference lib/llm).
+
+OpenAI-compatible HTTP service, preprocessor (templating + tokenization),
+detokenizing backend, migration, KV-aware router, model cards/discovery, and
+the simulation ("mocker") engine. The actual TPU engine lives in
+``dynamo_tpu.engine``.
+"""

@@ -1,0 +1,157 @@
+"""Model discovery: watcher + manager + routed pipeline assembly.
+
+Capability parity with reference ModelWatcher/ModelManager (lib/llm/src/
+discovery/watcher.rs:46-93, model_manager.rs) and build_routed_pipeline
+(entrypoint/input/common.rs:216-265): watch the models/ KV prefix; on the first
+instance of a model, fetch its tokenizer from the object store and assemble
+  Preprocessor -> Backend(detokenize) -> Migration -> Router(client)
+; on lease-expiry deletes, drop the model when its last instance vanishes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import AsyncIterator
+
+from dynamo_tpu.llm.backend import Backend
+from dynamo_tpu.llm.migration import Migration
+from dynamo_tpu.llm.model_card import MODEL_ROOT, ModelEntry, fetch_tokenizer
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import AsyncEngine
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("discovery")
+
+
+class RouterEngine(AsyncEngine):
+    """Pipeline sink: pushes the preprocessed request to a worker instance via
+    the request plane (reference ServiceBackend + PushRouter link,
+    common.rs:258-265). router_mode 'kv' is layered in kv_router."""
+
+    def __init__(self, client, router_mode: str = "round_robin"):
+        self.client = client
+        self.router_mode = router_mode
+
+    async def generate(self, request, context: Context) -> AsyncIterator[dict]:
+        stream = await self.client.generate(
+            request if isinstance(request, dict) else request.to_wire(),
+            context=context, mode=self.router_mode)
+        async for item in stream:
+            yield item
+
+
+class ServedModel:
+    """One routable model: its entry, tokenizer-bound pipeline, and client."""
+
+    def __init__(self, entry: ModelEntry, preprocessor: OpenAIPreprocessor,
+                 client, router):
+        self.entry = entry
+        self.preprocessor = preprocessor
+        self.client = client
+        self.router = router
+        self.instances: set[int] = set()
+
+    @property
+    def name(self) -> str:
+        return self.entry.model_name
+
+
+class ModelManager:
+    """Holds the set of currently-servable models (reference
+    discovery/model_manager.rs)."""
+
+    def __init__(self):
+        self.models: dict[str, ServedModel] = {}
+
+    def get(self, name: str) -> ServedModel | None:
+        return self.models.get(name)
+
+    def list_models(self) -> list[dict]:
+        return [{"id": m.name, "object": "model", "created": 0,
+                 "owned_by": "dynamo-tpu"} for m in self.models.values()]
+
+
+class ModelWatcher:
+    def __init__(self, runtime, manager: ModelManager,
+                 router_mode: str = "round_robin",
+                 kv_router_factory=None):
+        self._runtime = runtime
+        self.manager = manager
+        self.router_mode = router_mode
+        self._kv_router_factory = kv_router_factory
+        self._task: asyncio.Task | None = None
+        self._watch = None
+        self._lock = asyncio.Lock()
+
+    async def start(self) -> None:
+        client = self._runtime.require_coordinator()
+        self._watch = await client.watch_prefix(MODEL_ROOT)
+        for item in self._watch.snapshot:
+            await self._on_put(item["k"], item["v"])
+        self._task = asyncio.create_task(self._loop())
+
+    async def _loop(self) -> None:
+        async for event in self._watch:
+            try:
+                if event["event"] == "put":
+                    await self._on_put(event["key"], event["value"])
+                else:
+                    await self._on_delete(event["key"])
+            except Exception:  # noqa: BLE001
+                log.exception("model watch event failed")
+
+    async def _on_put(self, key: str, value: dict) -> None:
+        entry = ModelEntry.from_wire(value)
+        instance_hex = key.rsplit("/", 1)[-1]
+        async with self._lock:
+            served = self.manager.models.get(entry.model_name)
+            if served is None:
+                served = await self._build(entry)
+                self.manager.models[entry.model_name] = served
+                log.info("model %s now served via %s/%s/%s", entry.model_name,
+                         entry.namespace, entry.component, entry.endpoint)
+            try:
+                served.instances.add(int(instance_hex, 16))
+            except ValueError:
+                pass
+
+    async def _on_delete(self, key: str) -> None:
+        parts = key[len(MODEL_ROOT):].split("/")
+        if len(parts) != 2:
+            return
+        slug, instance_hex = parts
+        async with self._lock:
+            for name, served in list(self.manager.models.items()):
+                from dynamo_tpu.llm.model_card import model_slug
+                if model_slug(name) != slug:
+                    continue
+                try:
+                    served.instances.discard(int(instance_hex, 16))
+                except ValueError:
+                    pass
+                if not served.instances:
+                    log.info("model %s: last instance gone; removing", name)
+                    await served.client.close()
+                    del self.manager.models[name]
+
+    async def _build(self, entry: ModelEntry) -> ServedModel:
+        coordinator = self._runtime.require_coordinator()
+        tokenizer = await fetch_tokenizer(coordinator, entry.card)
+        endpoint = (self._runtime.namespace(entry.namespace)
+                    .component(entry.component).endpoint(entry.endpoint))
+        client = await endpoint.client()
+        if self.router_mode == "kv" and self._kv_router_factory is not None:
+            router = await self._kv_router_factory(self._runtime, entry, client)
+        else:
+            router = RouterEngine(client, self.router_mode)
+        chain = Migration(entry.card.migration_limit, inner=router)
+        backend = Backend(tokenizer, inner=chain)
+        preprocessor = OpenAIPreprocessor(entry.card, tokenizer, inner=backend)
+        return ServedModel(entry, preprocessor, client, router)
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+        if self._watch:
+            await self._watch.cancel()

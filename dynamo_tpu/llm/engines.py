@@ -1,0 +1,50 @@
+"""Built-in test engines.
+
+Capability parity with reference EchoFull/EchoCore (lib/llm/src/engines.rs:31-44):
+token-level echo engines used to exercise the full pipeline with no model. The
+TPU-timing simulator lives in dynamo_tpu.llm.mocker.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import AsyncIterator
+
+from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import AsyncEngine
+
+
+class EchoEngine(AsyncEngine):
+    """Echoes the prompt token ids back one token per response, bounded by
+    max_tokens, with a configurable per-token delay (engines.rs EchoFull's
+    DELAY)."""
+
+    def __init__(self, token_delay_s: float = 0.0):
+        self.token_delay_s = token_delay_s
+
+    async def generate(self, request, context: Context
+                       ) -> AsyncIterator[dict]:
+        req = (request if isinstance(request, PreprocessedRequest)
+               else PreprocessedRequest.from_wire(request))
+        budget = req.stop_conditions.max_tokens or len(req.token_ids)
+        tokens = req.token_ids[:budget] or [0]
+        for i, tid in enumerate(tokens):
+            if context.is_stopped:
+                yield LLMEngineOutput(token_ids=[],
+                                      finish_reason=FinishReason.CANCELLED).to_wire()
+                return
+            if self.token_delay_s:
+                await asyncio.sleep(self.token_delay_s)
+            finish = FinishReason.LENGTH if i == len(tokens) - 1 else None
+            yield LLMEngineOutput(token_ids=[tid],
+                                  finish_reason=finish).to_wire()
+
+    def handler(self):
+        """serve_endpoint-compatible async-generator handler."""
+
+        async def handle(request, context):
+            async for out in self.generate(request, context):
+                yield out
+
+        return handle

@@ -1,0 +1,249 @@
+"""OpenAI-compatible HTTP service.
+
+Capability parity with reference HttpService (lib/llm/src/http/service/
+service_v2.rs:125-340, routers in openai.rs:1023-1094): ``/v1/chat/completions``,
+``/v1/completions``, ``/v1/models``, ``/health``, ``/live``, ``/metrics`` with
+SSE streaming, client-disconnect cancellation (disconnect.rs), request
+validation errors in OpenAI error format, and per-route Prometheus metrics
+including TTFT/ITL observations (http/service/metrics.rs).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import AsyncIterator
+
+from aiohttp import web
+from pydantic import ValidationError
+
+from dynamo_tpu.llm.discovery import ModelManager
+from dynamo_tpu.llm.preprocessor import aggregate_chat_stream
+from dynamo_tpu.llm.protocols import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    usage_block,
+)
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.errors import NoInstancesError, OverloadedError
+from dynamo_tpu.runtime.logging import get_logger, parse_traceparent
+
+log = get_logger("http")
+
+
+def _error_body(message: str, err_type: str = "invalid_request_error",
+                code: int = 400) -> web.Response:
+    return web.Response(
+        status=code,
+        content_type="application/json",
+        text=json.dumps({"error": {"message": message, "type": err_type,
+                                   "param": None, "code": None}}))
+
+
+class HttpService:
+    def __init__(self, runtime, manager: ModelManager,
+                 host: str = "0.0.0.0", port: int = 8000):
+        self._runtime = runtime
+        self.manager = manager
+        self.host, self.port = host, port
+        self._runner: web.AppRunner | None = None
+        metrics = runtime.metrics.namespace("http")
+        self._m_requests = metrics.counter(
+            "http_requests_total", "HTTP requests", ["route", "status"])
+        self._m_inflight = metrics.gauge(
+            "http_inflight", "In-flight HTTP requests", ["route"])
+        self._m_ttft = metrics.histogram(
+            "ttft_seconds", "Time to first token", ["model"],
+            buckets=[.01, .025, .05, .1, .25, .5, 1, 2.5, 5, 10])
+        self._m_itl = metrics.histogram(
+            "itl_seconds", "Inter-token latency", ["model"],
+            buckets=[.001, .0025, .005, .01, .025, .05, .1, .25, 1])
+        self._m_duration = metrics.histogram(
+            "http_request_duration_seconds", "Request duration", ["route"])
+
+    # -- lifecycle ------------------------------------------------------------
+    async def start(self) -> None:
+        app = web.Application()
+        app.router.add_post("/v1/chat/completions", self._chat)
+        app.router.add_post("/v1/completions", self._completion)
+        app.router.add_get("/v1/models", self._models)
+        app.router.add_get("/health", self._health)
+        app.router.add_get("/live", self._live)
+        app.router.add_get("/metrics", self._metrics)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+        log.info("OpenAI HTTP service on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    # -- helpers --------------------------------------------------------------
+    def _make_context(self, request: web.Request) -> Context:
+        traceparent = request.headers.get("traceparent")
+        trace = parse_traceparent(traceparent) if traceparent else None
+        ctx = Context(trace_id=trace["trace_id"] if trace else None,
+                      parent_span_id=trace["parent_id"] if trace else None)
+        return ctx
+
+    async def _sse_stream(self, request: web.Request, chunks: AsyncIterator[dict],
+                          ctx: Context, model: str) -> web.StreamResponse:
+        # Pull the first chunk BEFORE sending headers so pipeline errors
+        # (no instances, overload) still surface as proper HTTP statuses.
+        start_t = time.monotonic()
+        aiter = chunks.__aiter__()
+        try:
+            first_chunk = await aiter.__anext__()
+        except StopAsyncIteration:
+            first_chunk = None
+        self._m_ttft.observe(time.monotonic() - start_t, model=model)
+        response = web.StreamResponse(
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache"})
+        await response.prepare(request)
+        last_t = time.monotonic()
+        try:
+            if first_chunk is not None:
+                await response.write(
+                    b"data: " + json.dumps(first_chunk).encode() + b"\n\n")
+            async for chunk in aiter:
+                now = time.monotonic()
+                self._m_itl.observe(now - last_t, model=model)
+                last_t = now
+                await response.write(
+                    b"data: " + json.dumps(chunk).encode() + b"\n\n")
+            await response.write(b"data: [DONE]\n\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            # Client went away: propagate kill so the worker frees the slot
+            # (reference http/service/disconnect.rs).
+            ctx.kill()
+            raise
+        return response
+
+    # -- routes ---------------------------------------------------------------
+    async def _chat(self, request: web.Request) -> web.StreamResponse:
+        route = "chat_completions"
+        started = time.monotonic()
+        self._m_inflight.inc(route=route)
+        try:
+            try:
+                body = await request.json()
+                chat_req = ChatCompletionRequest.model_validate(body)
+            except (json.JSONDecodeError, ValidationError) as exc:
+                self._m_requests.inc(route=route, status="400")
+                return _error_body(str(exc))
+            served = self.manager.get(chat_req.model)
+            if served is None:
+                self._m_requests.inc(route=route, status="404")
+                return _error_body(f"model {chat_req.model!r} not found",
+                                   "model_not_found", 404)
+            ctx = self._make_context(request)
+            try:
+                chunks = served.preprocessor.generate(chat_req, ctx)
+                if chat_req.stream:
+                    resp = await self._sse_stream(request, chunks, ctx,
+                                                  chat_req.model)
+                    self._m_requests.inc(route=route, status="200")
+                    return resp
+                # Non-streaming: force the usage chunk through the delta
+                # stream so the aggregate carries real token counts.
+                chat_req.stream_options = {"include_usage": True}
+                full = await aggregate_chat_stream(chunks, 0)
+                self._m_requests.inc(route=route, status="200")
+                return web.json_response(full)
+            except NoInstancesError as exc:
+                self._m_requests.inc(route=route, status="503")
+                return _error_body(str(exc), "service_unavailable", 503)
+            except OverloadedError as exc:
+                self._m_requests.inc(route=route, status="503")
+                return _error_body(str(exc), "overloaded", 503)
+            except Exception as exc:  # noqa: BLE001
+                log.exception("chat handler failed")
+                self._m_requests.inc(route=route, status="500")
+                return _error_body(f"internal error: {exc}", "internal_error", 500)
+        finally:
+            self._m_inflight.dec(route=route)
+            self._m_duration.observe(time.monotonic() - started, route=route)
+
+    async def _completion(self, request: web.Request) -> web.StreamResponse:
+        route = "completions"
+        started = time.monotonic()
+        self._m_inflight.inc(route=route)
+        try:
+            try:
+                body = await request.json()
+                comp_req = CompletionRequest.model_validate(body)
+            except (json.JSONDecodeError, ValidationError) as exc:
+                self._m_requests.inc(route=route, status="400")
+                return _error_body(str(exc))
+            served = self.manager.get(comp_req.model)
+            if served is None:
+                self._m_requests.inc(route=route, status="404")
+                return _error_body(f"model {comp_req.model!r} not found",
+                                   "model_not_found", 404)
+            ctx = self._make_context(request)
+            try:
+                if not comp_req.stream:
+                    # Force the usage chunk so the folded response has counts.
+                    comp_req.stream_options = {"include_usage": True}
+                chunks = served.preprocessor.generate_completion(comp_req, ctx)
+                if comp_req.stream:
+                    resp = await self._sse_stream(request, chunks, ctx,
+                                                  comp_req.model)
+                    self._m_requests.inc(route=route, status="200")
+                    return resp
+                texts: list[str] = []
+                finish = None
+                meta: dict = {}
+                usage = None
+                async for chunk in chunks:
+                    meta = {k: chunk.get(k, meta.get(k))
+                            for k in ("id", "created")}
+                    if chunk.get("usage"):
+                        usage = chunk["usage"]
+                    for choice in chunk.get("choices", []):
+                        texts.append(choice.get("text") or "")
+                        finish = choice.get("finish_reason") or finish
+                self._m_requests.inc(route=route, status="200")
+                return web.json_response({
+                    "id": meta.get("id"), "object": "text_completion",
+                    "created": meta.get("created"), "model": comp_req.model,
+                    "choices": [{"index": 0, "text": "".join(texts),
+                                 "finish_reason": finish, "logprobs": None}],
+                    "usage": usage or usage_block(0, 0),
+                })
+            except ValueError as exc:
+                self._m_requests.inc(route=route, status="400")
+                return _error_body(str(exc))
+            except NoInstancesError as exc:
+                self._m_requests.inc(route=route, status="503")
+                return _error_body(str(exc), "service_unavailable", 503)
+            except OverloadedError as exc:
+                self._m_requests.inc(route=route, status="503")
+                return _error_body(str(exc), "overloaded", 503)
+            except Exception as exc:  # noqa: BLE001
+                log.exception("completion handler failed")
+                self._m_requests.inc(route=route, status="500")
+                return _error_body(f"internal error: {exc}", "internal_error", 500)
+        finally:
+            self._m_inflight.dec(route=route)
+            self._m_duration.observe(time.monotonic() - started, route=route)
+
+    async def _models(self, _request: web.Request) -> web.Response:
+        return web.json_response({"object": "list",
+                                  "data": self.manager.list_models()})
+
+    async def _health(self, _request: web.Request) -> web.Response:
+        return web.json_response({"status": "healthy",
+                                  "models": sorted(self.manager.models)})
+
+    async def _live(self, _request: web.Request) -> web.Response:
+        return web.json_response({"status": "live"})
+
+    async def _metrics(self, _request: web.Request) -> web.Response:
+        return web.Response(body=self._runtime.metrics.expose(),
+                            content_type="text/plain")

@@ -1,0 +1,61 @@
+"""Request-migration operator for fault tolerance.
+
+Capability parity with reference Migration (lib/llm/src/migration.rs:26-120
+RetryManager): when a worker dies mid-stream (StreamIncompleteError from the
+request plane), re-issue the request to another instance with the
+already-generated tokens appended to the prompt, up to ``migration_limit``
+retries. Workers signal incompleteness via connection loss or an explicit
+incomplete-stream error (docs/guides/backend.md §Migrate).
+"""
+
+from __future__ import annotations
+
+from typing import AsyncIterator
+
+from dynamo_tpu.llm.protocols import LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import AsyncEngine, Operator
+from dynamo_tpu.runtime.errors import StreamIncompleteError
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("migration")
+
+
+class Migration(Operator):
+    def __init__(self, migration_limit: int = 0, inner: AsyncEngine | None = None):
+        super().__init__(inner)
+        self.migration_limit = migration_limit
+
+    async def generate(self, request: PreprocessedRequest | dict,
+                       context: Context) -> AsyncIterator[LLMEngineOutput]:
+        assert self.inner is not None
+        req = (request if isinstance(request, PreprocessedRequest)
+               else PreprocessedRequest.from_wire(request))
+        retries_left = self.migration_limit
+        accumulated: list[int] = []
+        emitted_tokens = 0
+        while True:
+            try:
+                async for raw in self.inner.generate(req.to_wire(), context):
+                    out = (raw if isinstance(raw, LLMEngineOutput)
+                           else LLMEngineOutput.from_wire(raw))
+                    accumulated.extend(out.token_ids)
+                    emitted_tokens += len(out.token_ids)
+                    yield out
+                return
+            except StreamIncompleteError as exc:
+                if retries_left <= 0 or context.is_stopped:
+                    raise
+                retries_left -= 1
+                log.warning(
+                    "Stream disconnected (%s)... recreating stream "
+                    "(%d retries left, carrying %d generated tokens)",
+                    exc, retries_left, len(accumulated))
+                # Continue generation on another worker: prompt + generated so
+                # far becomes the new prompt; budget shrinks accordingly.
+                new_req = req.model_copy(deep=True)
+                new_req.token_ids = req.token_ids + accumulated
+                if new_req.stop_conditions.max_tokens is not None:
+                    new_req.stop_conditions.max_tokens = max(
+                        1, new_req.stop_conditions.max_tokens - emitted_tokens)
+                req = new_req

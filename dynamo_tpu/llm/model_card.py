@@ -1,0 +1,147 @@
+"""Model deployment cards and registration.
+
+Capability parity with reference ModelDeploymentCard / ModelEntry
+(lib/llm/src/model_card.rs:91-236, discovery MODEL_ROOT_PATH): the card carries
+everything a frontend needs to serve a model — tokenizer artifact (shipped via
+the coordinator object store, model_card.rs:245-351), chat template, context
+length, kv block size, migration limit, runtime config — and the entry maps the
+model name to the worker endpoint that serves it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Any
+
+from dynamo_tpu.llm.tokenizer import Tokenizer
+
+MODEL_ROOT = "models/"
+
+# Default chat template used when a model ships none: a minimal ChatML-style
+# template (reference ships model-specific templates via the card).
+DEFAULT_CHAT_TEMPLATE = (
+    "{% for message in messages %}"
+    "<|im_start|>{{ message['role'] }}\n{{ message['content'] }}<|im_end|>\n"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}<|im_start|>assistant\n{% endif %}"
+)
+
+
+@dataclasses.dataclass
+class ModelRuntimeConfig:
+    """Engine capacity facts published at registration (reference
+    ModelRuntimeConfig, local_model.rs — total_kv_blocks, max_num_seqs...)."""
+
+    total_kv_blocks: int | None = None
+    max_num_seqs: int | None = None
+    max_num_batched_tokens: int | None = None
+    extra: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def to_wire(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_wire(cls, data: dict | None) -> "ModelRuntimeConfig":
+        data = data or {}
+        return cls(**{f.name: data.get(f.name) if f.name != "extra"
+                      else data.get("extra", {}) for f in dataclasses.fields(cls)})
+
+
+@dataclasses.dataclass
+class ModelDeploymentCard:
+    name: str
+    model_type: str = "chat"  # chat | completions | embedding
+    tokenizer_key: str | None = None  # object-store key for tokenizer.json bytes
+    chat_template: str | None = None
+    context_length: int = 8192
+    kv_cache_block_size: int = 16  # reference default (docs/guides/backend.md)
+    migration_limit: int = 0
+    runtime_config: ModelRuntimeConfig = dataclasses.field(
+        default_factory=ModelRuntimeConfig)
+
+    def to_wire(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["runtime_config"] = self.runtime_config.to_wire()
+        return d
+
+    @classmethod
+    def from_wire(cls, data: dict) -> "ModelDeploymentCard":
+        data = dict(data)
+        data["runtime_config"] = ModelRuntimeConfig.from_wire(
+            data.get("runtime_config"))
+        return cls(**{f.name: data[f.name] for f in dataclasses.fields(cls)
+                      if f.name in data})
+
+
+@dataclasses.dataclass
+class ModelEntry:
+    """models/{slug} KV entry (reference discovery/ModelEntry)."""
+
+    model_name: str
+    namespace: str
+    component: str
+    endpoint: str
+    model_type: str
+    card: ModelDeploymentCard
+
+    def to_wire(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["card"] = self.card.to_wire()
+        return d
+
+    @classmethod
+    def from_wire(cls, data: dict) -> "ModelEntry":
+        return cls(model_name=data["model_name"], namespace=data["namespace"],
+                   component=data["component"], endpoint=data["endpoint"],
+                   model_type=data.get("model_type", "chat"),
+                   card=ModelDeploymentCard.from_wire(data["card"]))
+
+
+def model_slug(name: str) -> str:
+    return name.replace("/", "--")
+
+
+async def register_llm(
+    runtime,
+    endpoint,
+    model_name: str,
+    tokenizer: Tokenizer,
+    model_type: str = "chat",
+    chat_template: str | None = None,
+    context_length: int = 8192,
+    kv_cache_block_size: int = 16,
+    migration_limit: int = 0,
+    runtime_config: ModelRuntimeConfig | None = None,
+) -> ModelEntry:
+    """Register a served model: ship the tokenizer to the object store and put
+    the ModelEntry under models/ on the worker's primary lease (reference
+    register_llm, bindings rust/lib.rs:143 -> model_card.rs:374).
+    """
+    client = runtime.require_coordinator()
+    blob = tokenizer.to_bytes()
+    tok_key = f"tokenizers/{model_slug(model_name)}-{hashlib.sha256(blob).hexdigest()[:12]}"
+    await client.object_put(tok_key, blob)
+    card = ModelDeploymentCard(
+        name=model_name, model_type=model_type, tokenizer_key=tok_key,
+        chat_template=chat_template, context_length=context_length,
+        kv_cache_block_size=kv_cache_block_size, migration_limit=migration_limit,
+        runtime_config=runtime_config or ModelRuntimeConfig())
+    entry = ModelEntry(model_name=model_name,
+                       namespace=endpoint.component.namespace,
+                       component=endpoint.component.name,
+                       endpoint=endpoint.name, model_type=model_type, card=card)
+    # Keyed per-instance so N workers of one model coexist; the frontend
+    # dedups by model name (reference keys entries by lease id too).
+    key = f"{MODEL_ROOT}{model_slug(model_name)}/{runtime.instance_id:x}"
+    await client.kv_put(key, entry.to_wire(), use_primary_lease=True)
+    return entry
+
+
+async def fetch_tokenizer(client, card: ModelDeploymentCard) -> Tokenizer:
+    if card.tokenizer_key is None:
+        raise ValueError(f"model card {card.name} has no tokenizer artifact")
+    blob = await client.object_get(card.tokenizer_key)
+    if blob is None:
+        raise KeyError(f"tokenizer object {card.tokenizer_key} missing")
+    return Tokenizer.from_bytes(blob)

@@ -1,0 +1,246 @@
+"""OpenAI preprocessor: requests -> tokens, engine outputs -> SSE deltas.
+
+Capability parity with reference OpenAIPreprocessor (lib/llm/src/
+preprocessor.rs:92-143 preprocess_request; :358 transform_postprocessor_stream):
+forward direction renders the chat template (jinja2, reference uses minijinja),
+tokenizes, and applies sampling/stop defaulting into a PreprocessedRequest;
+backward direction turns LLMEngineOutput streams into OpenAI
+chat.completion.chunk / text_completion deltas with usage and finish reasons.
+Annotations (formatted_prompt, token_ids) mirror preprocessor.rs annotations.
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator
+
+import jinja2
+
+from dynamo_tpu.llm.model_card import DEFAULT_CHAT_TEMPLATE, ModelDeploymentCard
+from dynamo_tpu.llm.protocols import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+    chat_completion_id,
+    completion_id,
+    now_unix,
+    usage_block,
+)
+from dynamo_tpu.llm.tokenizer import Tokenizer
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import AsyncEngine, Operator
+
+
+class OpenAIPreprocessor(Operator):
+    def __init__(self, card: ModelDeploymentCard, tokenizer: Tokenizer,
+                 inner: AsyncEngine | None = None):
+        super().__init__(inner)
+        self.card = card
+        self.tokenizer = tokenizer
+        self._jinja = jinja2.Environment()
+        self._template = self._jinja.from_string(
+            card.chat_template or DEFAULT_CHAT_TEMPLATE)
+        self.eos_ids = tokenizer.eos_token_ids()
+
+    # -- forward: OpenAI -> PreprocessedRequest ------------------------------
+    def apply_chat_template(self, request: ChatCompletionRequest) -> str:
+        messages = [{"role": m.role, "content": m.text_content()}
+                    for m in request.messages]
+        return self._template.render(messages=messages, add_generation_prompt=True)
+
+    def preprocess_chat(self, request: ChatCompletionRequest
+                        ) -> PreprocessedRequest:
+        prompt = self.apply_chat_template(request)
+        token_ids = self.tokenizer.encode(prompt)
+        return self._build(request.model, token_ids, request, prompt)
+
+    def preprocess_completion(self, request: CompletionRequest
+                              ) -> PreprocessedRequest:
+        prompt_in = request.prompt
+        if isinstance(prompt_in, list) and prompt_in and isinstance(
+                prompt_in[0], str):
+            if len(prompt_in) > 1:
+                # Batch prompts need one choice per element; reject loudly
+                # rather than silently concatenating.
+                raise ValueError(
+                    "batch prompts (list of strings) are not supported; send "
+                    "one request per prompt")
+            prompt_in = prompt_in[0]
+        if isinstance(prompt_in, list):
+            token_ids = list(prompt_in)
+            prompt = None
+        else:
+            prompt = prompt_in
+            token_ids = self.tokenizer.encode(prompt)
+        return self._build(request.model, token_ids, request, prompt)
+
+    def _build(self, model: str, token_ids: list[int], request,
+               formatted_prompt: str | None) -> PreprocessedRequest:
+        max_tokens = (getattr(request, "max_completion_tokens", None)
+                      or request.max_tokens)
+        if max_tokens is None:
+            # Default to remaining context (reference defaults from the card).
+            max_tokens = max(1, self.card.context_length - len(token_ids))
+        stop = StopConditions(
+            max_tokens=max_tokens,
+            min_tokens=request.min_tokens,
+            stop=request.stop_list(),
+            ignore_eos=bool(request.ignore_eos),
+        )
+        sampling = SamplingOptions(
+            temperature=request.temperature,
+            top_p=request.top_p,
+            top_k=getattr(request, "top_k", None),
+            frequency_penalty=getattr(request, "frequency_penalty", None),
+            presence_penalty=getattr(request, "presence_penalty", None),
+            seed=request.seed,
+            n=request.n,
+        )
+        annotations: dict[str, Any] = {}
+        if formatted_prompt is not None:
+            annotations["formatted_prompt"] = formatted_prompt
+        return PreprocessedRequest(
+            model=model, token_ids=token_ids, stop_conditions=stop,
+            sampling_options=sampling, eos_token_ids=self.eos_ids,
+            annotations=annotations)
+
+    # -- operator interface ---------------------------------------------------
+    async def generate(self, request: ChatCompletionRequest,
+                       context: Context) -> AsyncIterator[dict]:
+        """Full chat pipeline edge: forward preprocess, stream deltas back."""
+        assert self.inner is not None, "preprocessor not linked to an engine"
+        pre = self.preprocess_chat(request)
+        delta_gen = ChatDeltaGenerator(request, prompt_tokens=len(pre.token_ids))
+        inner_iter = self.inner.generate(pre, context)
+        async for out in inner_iter:
+            engine_out = (out if isinstance(out, LLMEngineOutput)
+                          else LLMEngineOutput.from_wire(out))
+            for chunk in delta_gen.step(engine_out):
+                yield chunk
+
+    async def generate_completion(self, request: CompletionRequest,
+                                  context: Context) -> AsyncIterator[dict]:
+        """Text-completion pipeline edge (mirrors the chat edge so the HTTP
+        layer never reaches into pipeline internals)."""
+        assert self.inner is not None, "preprocessor not linked to an engine"
+        pre = self.preprocess_completion(request)
+        delta_gen = CompletionDeltaGenerator(request,
+                                             prompt_tokens=len(pre.token_ids))
+        async for out in self.inner.generate(pre, context):
+            engine_out = (out if isinstance(out, LLMEngineOutput)
+                          else LLMEngineOutput.from_wire(out))
+            for chunk in delta_gen.step(engine_out):
+                yield chunk
+
+
+class ChatDeltaGenerator:
+    """LLMEngineOutput stream -> OpenAI chat.completion.chunk dicts
+    (reference DeltaGenerator, preprocessor.rs:358-460)."""
+
+    def __init__(self, request: ChatCompletionRequest, prompt_tokens: int):
+        self.id = chat_completion_id()
+        self.model = request.model
+        self.created = now_unix()
+        self.prompt_tokens = prompt_tokens
+        self.completion_tokens = 0
+        self.include_usage = bool(
+            (request.stream_options or {}).get("include_usage"))
+        self._first = True
+
+    def _base(self) -> dict:
+        return {"id": self.id, "object": "chat.completion.chunk",
+                "created": self.created, "model": self.model}
+
+    def step(self, out: LLMEngineOutput) -> list[dict]:
+        chunks: list[dict] = []
+        self.completion_tokens += len(out.token_ids)
+        delta: dict[str, Any] = {}
+        if self._first:
+            delta["role"] = "assistant"
+            self._first = False
+        if out.text:
+            delta["content"] = out.text
+        finish = out.finish_reason.to_openai() if out.finish_reason else None
+        if delta or finish:
+            chunk = self._base()
+            chunk["choices"] = [{"index": 0, "delta": delta,
+                                 "finish_reason": finish}]
+            chunks.append(chunk)
+        if finish and self.include_usage:
+            usage_chunk = self._base()
+            usage_chunk["choices"] = []
+            usage_chunk["usage"] = usage_block(self.prompt_tokens,
+                                              self.completion_tokens)
+            chunks.append(usage_chunk)
+        return chunks
+
+
+class CompletionDeltaGenerator:
+    """LLMEngineOutput stream -> OpenAI text_completion chunks."""
+
+    def __init__(self, request: CompletionRequest, prompt_tokens: int):
+        self.id = completion_id()
+        self.model = request.model
+        self.created = now_unix()
+        self.prompt_tokens = prompt_tokens
+        self.completion_tokens = 0
+        self.include_usage = bool(
+            (request.stream_options or {}).get("include_usage"))
+
+    def step(self, out: LLMEngineOutput) -> list[dict]:
+        self.completion_tokens += len(out.token_ids)
+        finish = out.finish_reason.to_openai() if out.finish_reason else None
+        chunks = []
+        if out.text or finish:
+            chunks.append({
+                "id": self.id, "object": "text_completion",
+                "created": self.created, "model": self.model,
+                "choices": [{"index": 0, "text": out.text or "",
+                             "finish_reason": finish, "logprobs": None}],
+            })
+        if finish and self.include_usage:
+            chunks.append({
+                "id": self.id, "object": "text_completion",
+                "created": self.created, "model": self.model, "choices": [],
+                "usage": usage_block(self.prompt_tokens, self.completion_tokens),
+            })
+        return chunks
+
+
+async def aggregate_chat_stream(chunks: AsyncIterator[dict],
+                                prompt_tokens: int) -> dict:
+    """Fold a chunk stream into a non-streaming chat.completion response
+    (reference protocols/openai/chat_completions/aggregator.rs)."""
+    content: list[str] = []
+    role = "assistant"
+    finish_reason = None
+    cid = None
+    model = None
+    created = None
+    usage = None
+    completion_tokens = 0
+    async for chunk in chunks:
+        cid = chunk.get("id", cid)
+        model = chunk.get("model", model)
+        created = chunk.get("created", created)
+        if chunk.get("usage"):
+            usage = chunk["usage"]
+        for choice in chunk.get("choices", []):
+            delta = choice.get("delta", {})
+            if delta.get("content"):
+                content.append(delta["content"])
+            if delta.get("role"):
+                role = delta["role"]
+            if choice.get("finish_reason"):
+                finish_reason = choice["finish_reason"]
+    return {
+        "id": cid, "object": "chat.completion", "created": created,
+        "model": model,
+        "choices": [{"index": 0,
+                     "message": {"role": role, "content": "".join(content)},
+                     "finish_reason": finish_reason}],
+        "usage": usage or usage_block(prompt_tokens, completion_tokens),
+    }

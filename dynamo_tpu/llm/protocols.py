@@ -1,0 +1,199 @@
+"""Request/response protocol types.
+
+Capability parity with reference lib/llm/src/protocols (OpenAI types +
+``common.rs`` internal types): the OpenAI-facing models are pydantic (request
+validation at the HTTP edge, protocols/openai/*), while the internal
+frontend<->worker contract — PreprocessedRequest and LLMEngineOutput
+(protocols/common.rs:811, common/llm_backend.rs) — travels as plain dicts over
+msgpack frames.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from enum import Enum
+from typing import Any, Literal
+
+from pydantic import BaseModel, ConfigDict, Field
+
+
+# ---------------------------------------------------------------------------
+# Internal types (reference protocols/common.rs)
+# ---------------------------------------------------------------------------
+
+class FinishReason(str, Enum):
+    """Reference FinishReason (protocols/common.rs)."""
+
+    STOP = "stop"            # stop string / stop token matched
+    EOS = "eos"              # model emitted EOS
+    LENGTH = "length"        # max_tokens reached
+    CANCELLED = "cancelled"  # client disconnected / ctx stopped
+    ERROR = "error"
+
+    def to_openai(self) -> str:
+        return {"eos": "stop", "cancelled": "stop"}.get(self.value, self.value)
+
+
+class StopConditions(BaseModel):
+    """Reference common.rs StopConditions."""
+
+    max_tokens: int | None = None
+    min_tokens: int | None = None
+    stop: list[str] = Field(default_factory=list)
+    stop_token_ids: list[int] = Field(default_factory=list)
+    ignore_eos: bool = False
+
+
+class SamplingOptions(BaseModel):
+    """Reference common.rs SamplingOptions."""
+
+    temperature: float | None = None
+    top_p: float | None = None
+    top_k: int | None = None
+    frequency_penalty: float | None = None
+    presence_penalty: float | None = None
+    seed: int | None = None
+    n: int = 1
+    logprobs: int | None = None
+
+
+class PreprocessedRequest(BaseModel):
+    """Tokens-in request: the frontend->worker contract
+    (reference preprocessor.rs:92 output, protocols/common.rs)."""
+
+    model: str
+    token_ids: list[int]
+    stop_conditions: StopConditions = Field(default_factory=StopConditions)
+    sampling_options: SamplingOptions = Field(default_factory=SamplingOptions)
+    eos_token_ids: list[int] = Field(default_factory=list)
+    annotations: dict[str, Any] = Field(default_factory=dict)
+    # Disaggregation: router-to-worker hints (reference kv_transfer_params).
+    disagg_params: dict[str, Any] | None = None
+    # Router-estimated prefix-cache overlap, for engine scheduling.
+    estimated_prefix_hit_blocks: int = 0
+
+    def to_wire(self) -> dict:
+        return self.model_dump(exclude_none=True)
+
+    @classmethod
+    def from_wire(cls, data: dict) -> "PreprocessedRequest":
+        return cls.model_validate(data)
+
+
+class LLMEngineOutput(BaseModel):
+    """One streamed engine response (reference common/llm_backend.rs)."""
+
+    token_ids: list[int] = Field(default_factory=list)
+    text: str | None = None  # filled by the detokenizing Backend operator
+    finish_reason: FinishReason | None = None
+    cum_log_prob: float | None = None
+    log_probs: list[float] | None = None
+    # Per-stream metrics annotation (reference LLMMetricAnnotation,
+    # preprocessor.rs:58): first-token flag etc.
+    metrics: dict[str, Any] | None = None
+    # kv transfer results for disaggregated prefill responses.
+    disagg_params: dict[str, Any] | None = None
+
+    def to_wire(self) -> dict:
+        return self.model_dump(exclude_none=True)
+
+    @classmethod
+    def from_wire(cls, data: dict) -> "LLMEngineOutput":
+        return cls.model_validate(data)
+
+
+# ---------------------------------------------------------------------------
+# OpenAI API types (reference protocols/openai + vendored async-openai)
+# ---------------------------------------------------------------------------
+
+class ChatMessage(BaseModel):
+    model_config = ConfigDict(extra="allow")
+    role: str
+    content: str | list[dict[str, Any]] | None = None
+    name: str | None = None
+    tool_calls: list[dict[str, Any]] | None = None
+
+    def text_content(self) -> str:
+        if self.content is None:
+            return ""
+        if isinstance(self.content, str):
+            return self.content
+        return "".join(p.get("text", "") for p in self.content
+                       if p.get("type") == "text")
+
+
+class ChatCompletionRequest(BaseModel):
+    model_config = ConfigDict(extra="allow")
+    model: str
+    messages: list[ChatMessage]
+    max_tokens: int | None = None
+    max_completion_tokens: int | None = None
+    temperature: float | None = None
+    top_p: float | None = None
+    top_k: int | None = None  # extension (nvext-style)
+    n: int = 1
+    stream: bool = False
+    stream_options: dict[str, Any] | None = None
+    stop: str | list[str] | None = None
+    presence_penalty: float | None = None
+    frequency_penalty: float | None = None
+    seed: int | None = None
+    logprobs: bool | None = None
+    top_logprobs: int | None = None
+    ignore_eos: bool | None = None  # extension
+    min_tokens: int | None = None  # extension
+
+    def stop_list(self) -> list[str]:
+        if self.stop is None:
+            return []
+        return [self.stop] if isinstance(self.stop, str) else list(self.stop)
+
+
+class CompletionRequest(BaseModel):
+    model_config = ConfigDict(extra="allow")
+    model: str
+    prompt: str | list[str] | list[int]
+    max_tokens: int | None = 16
+    temperature: float | None = None
+    top_p: float | None = None
+    n: int = 1
+    stream: bool = False
+    stream_options: dict[str, Any] | None = None
+    stop: str | list[str] | None = None
+    seed: int | None = None
+    echo: bool = False
+    ignore_eos: bool | None = None
+    min_tokens: int | None = None
+
+    def stop_list(self) -> list[str]:
+        if self.stop is None:
+            return []
+        return [self.stop] if isinstance(self.stop, str) else list(self.stop)
+
+
+class EmbeddingRequest(BaseModel):
+    model_config = ConfigDict(extra="allow")
+    model: str
+    input: str | list[str] | list[int] | list[list[int]]
+    encoding_format: Literal["float", "base64"] = "float"
+
+
+def completion_id() -> str:
+    return "cmpl-" + uuid.uuid4().hex[:24]
+
+
+def chat_completion_id() -> str:
+    return "chatcmpl-" + uuid.uuid4().hex[:24]
+
+
+def now_unix() -> int:
+    return int(time.time())
+
+
+def usage_block(prompt_tokens: int, completion_tokens: int) -> dict:
+    return {
+        "prompt_tokens": prompt_tokens,
+        "completion_tokens": completion_tokens,
+        "total_tokens": prompt_tokens + completion_tokens,
+    }

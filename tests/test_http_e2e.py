@@ -1,0 +1,168 @@
+"""End-to-end HTTP slice test: OpenAI HTTP -> preprocessor -> router -> echo
+worker -> detokenized SSE back. Parity with reference `dynamo-run in=http
+out=echo` + lib/llm/tests/http-service.rs, all in one process/event loop.
+"""
+
+import asyncio
+import json
+
+import aiohttp
+from conftest import async_test
+
+from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+from dynamo_tpu.llm.engines import EchoEngine
+from dynamo_tpu.llm.http_service import HttpService
+from dynamo_tpu.llm.model_card import register_llm
+from dynamo_tpu.llm.tokenizer import make_test_tokenizer
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.coordinator import Coordinator
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+
+async def start_stack(migration_limit=0):
+    coord = Coordinator()
+    await coord.start()
+    cfg = lambda: RuntimeConfig(coordinator_url=coord.url, lease_ttl_s=1.0)  # noqa: E731
+    worker_rt = await DistributedRuntime.from_settings(cfg())
+    frontend_rt = await DistributedRuntime.from_settings(cfg())
+
+    tokenizer = make_test_tokenizer()
+    engine = EchoEngine()
+    endpoint = worker_rt.namespace("test").component("echo").endpoint("generate")
+    server = await endpoint.serve_endpoint(engine.handler())
+    await register_llm(worker_rt, endpoint, "echo-model", tokenizer,
+                       migration_limit=migration_limit)
+
+    manager = ModelManager()
+    watcher = ModelWatcher(frontend_rt, manager)
+    await watcher.start()
+    service = HttpService(frontend_rt, manager, host="127.0.0.1", port=0)
+    await service.start()
+    # Wait until the model is discovered.
+    for _ in range(100):
+        if manager.get("echo-model"):
+            break
+        await asyncio.sleep(0.02)
+    assert manager.get("echo-model") is not None
+    return coord, worker_rt, frontend_rt, server, watcher, service
+
+
+async def stop_stack(coord, worker_rt, frontend_rt, server, watcher, service):
+    await service.stop()
+    await watcher.stop()
+    await server.shutdown()
+    await frontend_rt.close()
+    await worker_rt.close()
+    await coord.stop()
+
+
+@async_test
+async def test_chat_completion_streaming():
+    stack = await start_stack()
+    coord, worker_rt, frontend_rt, server, watcher, service = stack
+    try:
+        url = f"http://127.0.0.1:{service.port}/v1/chat/completions"
+        async with aiohttp.ClientSession() as session:
+            async with session.post(url, json={
+                "model": "echo-model",
+                "messages": [{"role": "user", "content": "hello world test"}],
+                "stream": True,
+                "stream_options": {"include_usage": True},
+            }) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"].startswith("text/event-stream")
+                chunks = []
+                async for line in resp.content:
+                    line = line.decode().strip()
+                    if line.startswith("data: "):
+                        payload = line[len("data: "):]
+                        if payload == "[DONE]":
+                            break
+                        chunks.append(json.loads(payload))
+        # Echo returns the templated prompt text back.
+        text = "".join(c["choices"][0]["delta"].get("content", "")
+                       for c in chunks if c.get("choices"))
+        assert "hello world test" in text
+        finishes = [c["choices"][0].get("finish_reason")
+                    for c in chunks if c.get("choices")]
+        assert finishes[-1] == "length"
+        usage = [c for c in chunks if c.get("usage")]
+        assert usage and usage[0]["usage"]["completion_tokens"] > 0
+    finally:
+        await stop_stack(*stack)
+
+
+@async_test
+async def test_chat_completion_non_streaming_and_models_and_errors():
+    stack = await start_stack()
+    coord, worker_rt, frontend_rt, server, watcher, service = stack
+    try:
+        base = f"http://127.0.0.1:{service.port}"
+        async with aiohttp.ClientSession() as session:
+            # /v1/models
+            async with session.get(f"{base}/v1/models") as resp:
+                data = await resp.json()
+                assert [m["id"] for m in data["data"]] == ["echo-model"]
+            # non-streaming chat
+            async with session.post(f"{base}/v1/chat/completions", json={
+                "model": "echo-model",
+                "messages": [{"role": "user", "content": "abc def"}],
+            }) as resp:
+                assert resp.status == 200
+                data = await resp.json()
+                assert "abc def" in data["choices"][0]["message"]["content"]
+            # unknown model -> 404
+            async with session.post(f"{base}/v1/chat/completions", json={
+                "model": "nope", "messages": [{"role": "user", "content": "x"}],
+            }) as resp:
+                assert resp.status == 404
+                err = await resp.json()
+                assert err["error"]["type"] == "model_not_found"
+            # malformed body -> 400
+            async with session.post(f"{base}/v1/chat/completions", json={
+                "model": "echo-model"}) as resp:
+                assert resp.status == 400
+            # completions endpoint
+            async with session.post(f"{base}/v1/completions", json={
+                "model": "echo-model", "prompt": "one two three",
+                "max_tokens": 2}) as resp:
+                assert resp.status == 200
+                data = await resp.json()
+                assert data["object"] == "text_completion"
+                assert data["usage"]["completion_tokens"] == 2
+            # health + metrics
+            async with session.get(f"{base}/health") as resp:
+                assert (await resp.json())["models"] == ["echo-model"]
+            async with session.get(f"{base}/metrics") as resp:
+                body = await resp.text()
+                assert "dynamo_tpu_http_requests_total" in body
+    finally:
+        await stop_stack(*stack)
+
+
+@async_test
+async def test_model_removed_when_worker_dies():
+    stack = await start_stack()
+    coord, worker_rt, frontend_rt, server, watcher, service = stack
+    try:
+        manager = service.manager
+        assert manager.get("echo-model") is not None
+        await server.shutdown()
+        await worker_rt.close()
+        for _ in range(150):
+            if manager.get("echo-model") is None:
+                break
+            await asyncio.sleep(0.02)
+        assert manager.get("echo-model") is None
+        # HTTP now 404s for it
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                json={"model": "echo-model",
+                      "messages": [{"role": "user", "content": "x"}]}) as resp:
+                assert resp.status == 404
+    finally:
+        await service.stop()
+        await watcher.stop()
+        await frontend_rt.close()
+        await coord.stop()

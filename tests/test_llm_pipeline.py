@@ -1,0 +1,169 @@
+"""LLM pipeline unit tests: tokenizer streaming, preprocessor, stop sequences,
+migration. Mirrors reference lib/llm/tests/{preprocessor.rs,tokenizers.rs}."""
+
+import asyncio
+
+import pytest
+from conftest import async_test
+
+from dynamo_tpu.llm.backend import Backend
+from dynamo_tpu.llm.migration import Migration
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor, aggregate_chat_stream
+from dynamo_tpu.llm.protocols import (
+    ChatCompletionRequest,
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+)
+from dynamo_tpu.llm.tokenizer import (
+    DecodeStream,
+    StopSequenceChecker,
+    make_test_tokenizer,
+)
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import AsyncEngine
+from dynamo_tpu.runtime.errors import StreamIncompleteError
+
+
+@pytest.fixture(scope="module")
+def tokenizer():
+    return make_test_tokenizer()
+
+
+def test_roundtrip(tokenizer):
+    text = "hello world this is a test"
+    ids = tokenizer.encode(text)
+    assert ids
+    assert tokenizer.decode(ids) == text
+
+
+def test_decode_stream_matches_full_decode(tokenizer):
+    text = "the quick brown fox jumps over the lazy dog"
+    ids = tokenizer.encode(text)
+    stream = DecodeStream(tokenizer)
+    pieces = [d for tid in ids if (d := stream.step(tid)) is not None]
+    assert "".join(pieces) == tokenizer.decode(ids)
+
+
+def test_decode_stream_unicode_safety(tokenizer):
+    # Byte-level BPE splits multi-byte chars across tokens; the stream must
+    # never emit replacement chars.
+    text = "héllo wörld ünïcode"
+    ids = tokenizer.encode(text)
+    stream = DecodeStream(tokenizer)
+    out = "".join(d for tid in ids if (d := stream.step(tid)) is not None)
+    assert "�" not in out
+    assert out == tokenizer.decode(ids)
+
+
+def test_stop_sequence_checker_split_across_deltas():
+    checker = StopSequenceChecker(["STOP"])
+    emit1, m1 = checker.append("hello ST")
+    assert (emit1, m1) == ("hello ", False)
+    emit2, m2 = checker.append("OP world")
+    assert m2 is True
+    assert emit2 == ""
+
+
+def test_stop_sequence_no_match_flush():
+    checker = StopSequenceChecker(["XYZ"])
+    emit, matched = checker.append("abcX")
+    assert not matched
+    assert emit == "abc"
+    assert checker.flush() == "X"
+
+
+def test_preprocess_chat_defaults(tokenizer):
+    card = ModelDeploymentCard(name="m", context_length=128)
+    pre = OpenAIPreprocessor(card, tokenizer)
+    req = ChatCompletionRequest(model="m", messages=[
+        {"role": "user", "content": "hello world"}])
+    out = pre.preprocess_chat(req)
+    assert out.token_ids
+    assert out.stop_conditions.max_tokens == 128 - len(out.token_ids)
+    assert "formatted_prompt" in out.annotations
+    assert "hello world" in out.annotations["formatted_prompt"]
+
+
+class ScriptedEngine(AsyncEngine):
+    """Yields scripted token batches; can die partway to test migration."""
+
+    def __init__(self, script, die_after=None):
+        self.script = script
+        self.die_after = die_after
+        self.calls = []
+
+    async def generate(self, request, context):
+        req = PreprocessedRequest.from_wire(
+            request if isinstance(request, dict) else request.to_wire())
+        self.calls.append(req)
+        for i, tok_batch in enumerate(self.script[len(self.calls) - 1]):
+            if self.die_after is not None and len(self.calls) == 1 and i == self.die_after:
+                raise StreamIncompleteError()
+            finish = (FinishReason.LENGTH
+                      if i == len(self.script[len(self.calls) - 1]) - 1 else None)
+            yield LLMEngineOutput(token_ids=tok_batch, finish_reason=finish).to_wire()
+
+
+@async_test
+async def test_backend_detokenizes_and_stops(tokenizer):
+    text = "hello world this is a test"
+    ids = tokenizer.encode(text)
+    engine = ScriptedEngine([[[i] for i in ids]])
+    backend = Backend(tokenizer, inner=engine)
+    req = PreprocessedRequest(model="m", token_ids=[1])
+    req.stop_conditions.stop = ["this"]
+    outs = []
+    async for out in backend.generate(req, Context()):
+        outs.append(out)
+    full_text = "".join(o.text or "" for o in outs)
+    assert full_text == "hello world "
+    assert outs[-1].finish_reason == FinishReason.STOP
+
+
+@async_test
+async def test_migration_retries_with_accumulated_tokens():
+    # First attempt dies after 2 batches; retry must carry accumulated tokens.
+    engine = ScriptedEngine([[[1], [2], [3], [4]], [[3], [4]]], die_after=2)
+    migration = Migration(migration_limit=1, inner=engine)
+    req = PreprocessedRequest(model="m", token_ids=[10, 11])
+    req.stop_conditions.max_tokens = 4
+    outs = []
+    async for out in migration.generate(req, Context()):
+        outs.append(out)
+    got = [t for o in outs for t in o.token_ids]
+    assert got == [1, 2, 3, 4]
+    assert len(engine.calls) == 2
+    # Retried prompt = original + generated-so-far; budget shrunk.
+    assert engine.calls[1].token_ids == [10, 11, 1, 2]
+    assert engine.calls[1].stop_conditions.max_tokens == 2
+
+
+@async_test
+async def test_migration_limit_zero_propagates():
+    engine = ScriptedEngine([[[1], [2], [3]]], die_after=1)
+    migration = Migration(migration_limit=0, inner=engine)
+    req = PreprocessedRequest(model="m", token_ids=[1])
+    try:
+        async for _ in migration.generate(req, Context()):
+            pass
+        raise AssertionError("expected StreamIncompleteError")
+    except StreamIncompleteError:
+        pass
+
+
+@async_test
+async def test_aggregate_chat_stream():
+    async def chunks():
+        yield {"id": "c1", "model": "m", "created": 1,
+               "choices": [{"index": 0,
+                            "delta": {"role": "assistant", "content": "hel"},
+                            "finish_reason": None}]}
+        yield {"id": "c1", "model": "m", "created": 1,
+               "choices": [{"index": 0, "delta": {"content": "lo"},
+                            "finish_reason": "stop"}]}
+
+    full = await aggregate_chat_stream(chunks(), prompt_tokens=3)
+    assert full["choices"][0]["message"]["content"] == "hello"
+    assert full["choices"][0]["finish_reason"] == "stop"
