@@ -1,0 +1,89 @@
+"""Mocker worker: serves the engine simulator as a registered model.
+
+``python -m dynamo_tpu.backends.mocker`` (reference parity:
+components/backends/mocker + `dynamo-run out=mocker`): exercises KV-aware
+routing, overload, and disagg logic with zero TPUs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, WorkerMetricsPublisher
+from dynamo_tpu.llm.mocker import MockerConfig, MockerEngine
+from dynamo_tpu.llm.model_card import ModelRuntimeConfig, register_llm
+from dynamo_tpu.llm.tokenizer import Tokenizer, make_test_tokenizer
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(description="dynamo-tpu mocker worker")
+    parser.add_argument("--model-name", default="mock-model")
+    parser.add_argument("--namespace", default=None)
+    parser.add_argument("--component", default="mocker")
+    parser.add_argument("--endpoint", default="generate")
+    parser.add_argument("--tokenizer", default=None)
+    parser.add_argument("--num-kv-blocks", type=int, default=1024)
+    parser.add_argument("--block-size", type=int, default=16)
+    parser.add_argument("--max-num-seqs", type=int, default=64)
+    parser.add_argument("--speedup-ratio", type=float, default=1.0)
+    parser.add_argument("--migration-limit", type=int, default=0)
+    parser.add_argument("--coordinator-url", default=None)
+    return parser.parse_args(argv)
+
+
+async def run(args: argparse.Namespace) -> None:
+    cfg = RuntimeConfig.from_settings()
+    if args.coordinator_url:
+        cfg.coordinator_url = args.coordinator_url
+    if args.namespace:
+        cfg.namespace = args.namespace
+    runtime = await DistributedRuntime.from_settings(cfg)
+    try:
+        tokenizer = (Tokenizer.from_file(args.tokenizer) if args.tokenizer
+                     else make_test_tokenizer())
+        mocker_cfg = MockerConfig(
+            num_kv_blocks=args.num_kv_blocks, block_size=args.block_size,
+            max_num_seqs=args.max_num_seqs, speedup_ratio=args.speedup_ratio)
+        ns = cfg.namespace
+        kv_pub = KvEventPublisher(runtime, ns, args.component,
+                                  runtime.instance_id)
+        metrics_pub = WorkerMetricsPublisher(runtime, ns, args.component,
+                                             runtime.instance_id)
+        engine = MockerEngine(mocker_cfg, kv_pub, metrics_pub)
+        endpoint = (runtime.namespace(None).component(args.component)
+                    .endpoint(args.endpoint))
+        server = await endpoint.serve_endpoint(engine.handler(),
+                                               graceful_shutdown=False)
+        await register_llm(
+            runtime, endpoint, args.model_name, tokenizer,
+            kv_cache_block_size=args.block_size,
+            migration_limit=args.migration_limit,
+            runtime_config=ModelRuntimeConfig(
+                total_kv_blocks=args.num_kv_blocks,
+                max_num_seqs=args.max_num_seqs))
+        engine.start()
+        print(f"MOCKER_READY port={server.port} worker={runtime.instance_id:x}",
+              flush=True)
+        import signal
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, runtime.shutdown)
+            except NotImplementedError:
+                pass
+        await runtime.wait_for_shutdown()
+        await engine.stop()
+        await server.shutdown()
+    finally:
+        await runtime.close()
+
+
+def main() -> None:
+    asyncio.run(run(parse_args()))
+
+
+if __name__ == "__main__":
+    main()

@@ -132,8 +132,16 @@ class ModelWatcher:
                     pass
                 if not served.instances:
                     log.info("model %s: last instance gone; removing", name)
-                    await served.client.close()
+                    await self._close_served(served)
                     del self.manager.models[name]
+
+    @staticmethod
+    async def _close_served(served: ServedModel) -> None:
+        router_close = getattr(served.router, "close", None)
+        if router_close is not None:
+            await router_close()  # also closes the underlying client
+        else:
+            await served.client.close()
 
     async def _build(self, entry: ModelEntry) -> ServedModel:
         coordinator = self._runtime.require_coordinator()
@@ -155,3 +163,6 @@ class ModelWatcher:
             self._task.cancel()
         if self._watch:
             await self._watch.cancel()
+        for served in list(self.manager.models.values()):
+            await self._close_served(served)
+        self.manager.models.clear()

@@ -1,0 +1,44 @@
+"""KV-cache-aware routing.
+
+Capability parity with reference lib/llm/src/kv_router (~7.9K LoC): a global
+radix index of block hashes per worker fed by worker KV events, a scheduler
+costing overlap-weighted prefill work against decode load with softmax
+temperature sampling, optimistic in-flight accounting (ActiveSequences), an
+approximate TTL indexer variant, worker load metrics, and inter-replica router
+sync. The TPU engine and the mocker both emit the same event format.
+"""
+
+from dynamo_tpu.llm.kv_router.protocols import (
+    ForwardPassMetrics,
+    KvCacheEvent,
+    KvStats,
+    RouterEvent,
+    WorkerStats,
+)
+from dynamo_tpu.llm.kv_router.indexer import KvIndexer, OverlapScores, RadixTree
+from dynamo_tpu.llm.kv_router.scheduler import KvRouterConfig, KvScheduler
+from dynamo_tpu.llm.kv_router.router import (
+    KvPushRouter,
+    make_kv_router_factory,
+)
+from dynamo_tpu.llm.kv_router.publisher import (
+    KvEventPublisher,
+    WorkerMetricsPublisher,
+)
+
+__all__ = [
+    "ForwardPassMetrics",
+    "KvCacheEvent",
+    "KvEventPublisher",
+    "KvIndexer",
+    "KvPushRouter",
+    "KvRouterConfig",
+    "KvScheduler",
+    "KvStats",
+    "OverlapScores",
+    "RadixTree",
+    "RouterEvent",
+    "WorkerMetricsPublisher",
+    "WorkerStats",
+    "make_kv_router_factory",
+]

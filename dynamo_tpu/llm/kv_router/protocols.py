@@ -1,0 +1,105 @@
+"""KV-router wire protocols: cache events and worker load metrics.
+
+Capability parity with reference kv_router/protocols.rs: KvCacheEvent
+(stored/removed/cleared, :KvCacheEventData), RouterEvent (worker_id + event),
+and ForwardPassMetrics{WorkerStats, KvStats, SpecDecodeStats} (:32-56) that
+workers publish each engine iteration.
+"""
+
+from __future__ import annotations
+
+from pydantic import BaseModel, Field
+
+
+class KvStoredBlock(BaseModel):
+    block_hash: int
+    # tokens are optional diagnostics; the hash is authoritative.
+    parent_hash: int | None = None
+
+
+class KvCacheEvent(BaseModel):
+    """stored | removed | cleared."""
+
+    event_id: int = 0
+    kind: str  # "stored" | "removed" | "cleared"
+    parent_hash: int | None = None  # for stored: parent of the first block
+    block_hashes: list[int] = Field(default_factory=list)
+
+    @classmethod
+    def stored(cls, block_hashes: list[int], parent_hash: int | None = None,
+               event_id: int = 0) -> "KvCacheEvent":
+        return cls(event_id=event_id, kind="stored", parent_hash=parent_hash,
+                   block_hashes=block_hashes)
+
+    @classmethod
+    def removed(cls, block_hashes: list[int], event_id: int = 0) -> "KvCacheEvent":
+        return cls(event_id=event_id, kind="removed", block_hashes=block_hashes)
+
+    @classmethod
+    def cleared(cls, event_id: int = 0) -> "KvCacheEvent":
+        return cls(event_id=event_id, kind="cleared")
+
+
+class RouterEvent(BaseModel):
+    worker_id: int
+    event: KvCacheEvent
+
+    def to_wire(self) -> dict:
+        return self.model_dump()
+
+    @classmethod
+    def from_wire(cls, data: dict) -> "RouterEvent":
+        return cls.model_validate(data)
+
+
+class WorkerStats(BaseModel):
+    """Reference kv_router/protocols.rs:40-44."""
+
+    request_active_slots: int = 0
+    request_total_slots: int = 0
+    num_requests_waiting: int = 0
+    data_parallel_rank: int | None = None
+
+
+class KvStats(BaseModel):
+    kv_active_blocks: int = 0
+    kv_total_blocks: int = 0
+    gpu_cache_usage_perc: float = 0.0
+    gpu_prefix_cache_hit_rate: float = 0.0
+
+
+class SpecDecodeStats(BaseModel):
+    num_spec_tokens: int = 0
+    num_drafts: int = 0
+    num_accepted_tokens: int = 0
+
+
+class ForwardPassMetrics(BaseModel):
+    """Published by workers every engine iteration (reference
+    kv_router/publisher.rs:483)."""
+
+    worker_id: int = 0
+    worker_stats: WorkerStats = Field(default_factory=WorkerStats)
+    kv_stats: KvStats = Field(default_factory=KvStats)
+    spec_decode_stats: SpecDecodeStats | None = None
+
+    def to_wire(self) -> dict:
+        return self.model_dump(exclude_none=True)
+
+    @classmethod
+    def from_wire(cls, data: dict) -> "ForwardPassMetrics":
+        return cls.model_validate(data)
+
+
+# Subjects on the coordinator pub/sub plane (reference kv_router.rs:56-65).
+def kv_events_subject(namespace: str, component: str) -> str:
+    return f"ns.{namespace}.cp.{component}.kv_events"
+
+
+def load_metrics_subject(namespace: str, component: str) -> str:
+    return f"ns.{namespace}.cp.{component}.load_metrics"
+
+
+def router_sync_subject(namespace: str, component: str) -> str:
+    """Inter-replica router state sync (reference kv_router.rs:64-65)."""
+    return f"ns.{namespace}.cp.{component}.router_sync"

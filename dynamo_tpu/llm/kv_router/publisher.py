@@ -1,0 +1,72 @@
+"""Worker-side publishers: KV cache events and forward-pass load metrics.
+
+Capability parity with reference KvEventPublisher / WorkerMetricsPublisher
+(lib/llm/src/kv_router/publisher.rs:101,483): engines call these each
+iteration; events/metrics ride the coordinator pub/sub plane on the
+component's subjects (reference publishes on NATS, and accepts engine events
+over ZMQ — our engine is in-process so no ZMQ hop is needed).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+
+from dynamo_tpu.llm.kv_router.protocols import (
+    ForwardPassMetrics,
+    KvCacheEvent,
+    RouterEvent,
+    kv_events_subject,
+    load_metrics_subject,
+)
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("kv_publisher")
+
+
+class KvEventPublisher:
+    def __init__(self, runtime, namespace: str, component: str, worker_id: int):
+        self._client = runtime.require_coordinator()
+        self.subject = kv_events_subject(namespace, component)
+        self.worker_id = worker_id
+        self._ids = itertools.count(1)
+
+    async def publish(self, event: KvCacheEvent) -> None:
+        event.event_id = next(self._ids)
+        router_event = RouterEvent(worker_id=self.worker_id, event=event)
+        await self._client.publish(self.subject, router_event.to_wire())
+
+    async def stored(self, block_hashes: list[int],
+                     parent_hash: int | None = None) -> None:
+        await self.publish(KvCacheEvent.stored(block_hashes, parent_hash))
+
+    async def removed(self, block_hashes: list[int]) -> None:
+        await self.publish(KvCacheEvent.removed(block_hashes))
+
+    async def cleared(self) -> None:
+        await self.publish(KvCacheEvent.cleared())
+
+
+class WorkerMetricsPublisher:
+    """Publishes ForwardPassMetrics; throttled to at most one message per
+    ``min_interval_s`` unless forced (engine iterations can be sub-ms)."""
+
+    def __init__(self, runtime, namespace: str, component: str, worker_id: int,
+                 min_interval_s: float = 0.1):
+        self._client = runtime.require_coordinator()
+        self.subject = load_metrics_subject(namespace, component)
+        self.worker_id = worker_id
+        self.min_interval_s = min_interval_s
+        self._last = 0.0
+        self.latest: ForwardPassMetrics | None = None
+
+    async def publish(self, metrics: ForwardPassMetrics,
+                      force: bool = False) -> None:
+        metrics.worker_id = self.worker_id
+        self.latest = metrics
+        loop = asyncio.get_running_loop()
+        now = loop.time()
+        if not force and now - self._last < self.min_interval_s:
+            return
+        self._last = now
+        await self._client.publish(self.subject, metrics.to_wire())

@@ -1,0 +1,183 @@
+"""The KV-aware router engine.
+
+Capability parity with reference KvRouter/KvPushRouter (lib/llm/src/
+kv_router.rs, scheduler.rs, SURVEY.md call stack 3.4): subscribes to the
+component's kv_events and load_metrics subjects, maintains the radix index and
+per-worker load, and routes each preprocessed request directly to the worker
+with the best overlap/load cost. Router replicas stay consistent by
+re-publishing their add/free decisions on the router_sync subject
+(kv_router.rs:64-65) and by dropping workers when discovery removes them.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from typing import AsyncIterator
+
+from dynamo_tpu.llm.kv_router.indexer import KvIndexer
+from dynamo_tpu.llm.kv_router.protocols import (
+    ForwardPassMetrics,
+    RouterEvent,
+    kv_events_subject,
+    load_metrics_subject,
+    router_sync_subject,
+)
+from dynamo_tpu.llm.kv_router.scheduler import KvRouterConfig, KvScheduler
+from dynamo_tpu.llm.kv_router.sequence import ActiveSequencesMultiWorker
+from dynamo_tpu.llm.protocols import PreprocessedRequest
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import AsyncEngine
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("kv_router")
+
+
+class KvPushRouter(AsyncEngine):
+    def __init__(self, runtime, namespace: str, component: str, client,
+                 config: KvRouterConfig):
+        self._runtime = runtime
+        self.namespace = namespace
+        self.component = component
+        self.client = client  # EndpointClient
+        self.config = config
+        self.indexer = KvIndexer(config.block_size)
+        self.sequences = ActiveSequencesMultiWorker()
+        self.scheduler = KvScheduler(config, self.sequences)
+        self.replica_id = uuid.uuid4().hex[:8]
+        self._tasks: list[asyncio.Task] = []
+        self._subs = []
+
+    async def start(self) -> None:
+        coord = self._runtime.require_coordinator()
+        ev_sub = await coord.subscribe(
+            kv_events_subject(self.namespace, self.component))
+        load_sub = await coord.subscribe(
+            load_metrics_subject(self.namespace, self.component))
+        sync_sub = await coord.subscribe(
+            router_sync_subject(self.namespace, self.component))
+        self._subs = [ev_sub, load_sub, sync_sub]
+        self._tasks = [
+            asyncio.create_task(self._event_loop(ev_sub)),
+            asyncio.create_task(self._load_loop(load_sub)),
+            asyncio.create_task(self._sync_loop(sync_sub)),
+            asyncio.create_task(self._prune_loop()),
+        ]
+
+    async def close(self) -> None:
+        for task in self._tasks:
+            task.cancel()
+        for sub in self._subs:
+            await sub.cancel()
+        await self.client.close()
+
+    # -- background state maintenance ----------------------------------------
+    async def _event_loop(self, sub) -> None:
+        async for msg in sub:
+            try:
+                self.indexer.apply(RouterEvent.from_wire(msg["payload"]))
+            except Exception:  # noqa: BLE001
+                log.exception("bad kv event")
+
+    async def _load_loop(self, sub) -> None:
+        async for msg in sub:
+            try:
+                self.scheduler.update_metrics(
+                    ForwardPassMetrics.from_wire(msg["payload"]))
+            except Exception:  # noqa: BLE001
+                log.exception("bad load metrics")
+
+    async def _sync_loop(self, sub) -> None:
+        """Apply other replicas' optimistic add/free events."""
+        async for msg in sub:
+            payload = msg["payload"]
+            if payload.get("replica") == self.replica_id:
+                continue
+            kind = payload.get("kind")
+            if kind == "add":
+                self.sequences.add_request(
+                    payload["worker_id"], payload["request_id"],
+                    payload["blocks"], payload["prefill_tokens"])
+            elif kind == "free":
+                self.sequences.free(payload["worker_id"], payload["request_id"])
+
+    async def _prune_loop(self) -> None:
+        """Drop state for workers that discovery no longer lists. Requires a
+        few consecutive absent ticks before wiping: KV events are incremental,
+        so wiping on a transient blip (lease hiccup, watch reconnect) would
+        lose a live worker's index forever."""
+        absent_ticks: dict[int, int] = {}
+        while True:
+            await asyncio.sleep(1.0)
+            live = set(self.client.instance_ids())
+            for worker in self.indexer.tree.workers() - live:
+                absent_ticks[worker] = absent_ticks.get(worker, 0) + 1
+                if absent_ticks[worker] >= 3:
+                    log.info("worker %x gone; dropping its indexed blocks",
+                             worker)
+                    self.indexer.tree.remove_worker(worker)
+                    self.scheduler.remove_worker(worker)
+                    absent_ticks.pop(worker, None)
+            for worker in list(absent_ticks):
+                if worker in live:
+                    absent_ticks.pop(worker)
+
+    async def _publish_sync(self, payload: dict) -> None:
+        payload["replica"] = self.replica_id
+        try:
+            await self._runtime.require_coordinator().publish(
+                router_sync_subject(self.namespace, self.component), payload)
+        except (ConnectionError, RuntimeError):
+            pass
+
+    # -- engine interface -----------------------------------------------------
+    async def generate(self, request, context: Context) -> AsyncIterator[dict]:
+        req = (request if isinstance(request, PreprocessedRequest)
+               else PreprocessedRequest.from_wire(request))
+        from dynamo_tpu.llm.tokens import compute_block_hashes
+
+        block_hashes = compute_block_hashes(req.token_ids, self.config.block_size)
+        request_blocks = max(1, len(block_hashes))
+        overlaps = self.indexer.tree.find_matches(block_hashes)
+        workers = self.client.instance_ids()
+        worker_id, overlap = self.scheduler.select(
+            workers, request_blocks, overlaps)
+        new_blocks = request_blocks - overlap
+        request_id = context.id
+        prefill_tokens = max(0, len(req.token_ids)
+                             - overlap * self.config.block_size)
+        self.sequences.add_request(worker_id, request_id, new_blocks,
+                                   prefill_tokens)
+        await self._publish_sync({
+            "kind": "add", "worker_id": worker_id, "request_id": request_id,
+            "blocks": new_blocks, "prefill_tokens": prefill_tokens})
+        req.estimated_prefix_hit_blocks = overlap
+        try:
+            stream = await self.client.generate(
+                req.to_wire(), context=context, instance_id=worker_id)
+            async for item in stream:
+                yield item
+        finally:
+            self.sequences.free(worker_id, request_id)
+            await self._publish_sync({
+                "kind": "free", "worker_id": worker_id,
+                "request_id": request_id})
+
+
+def make_kv_router_factory(overlap_score_weight: float = 1.0,
+                           temperature: float = 0.0,
+                           busy_threshold: float | None = None):
+    """Factory used by ModelWatcher when --router-mode kv is selected."""
+
+    async def factory(runtime, entry, client) -> KvPushRouter:
+        config = KvRouterConfig(
+            overlap_score_weight=overlap_score_weight,
+            temperature=temperature,
+            busy_threshold=busy_threshold,
+            block_size=entry.card.kv_cache_block_size)
+        router = KvPushRouter(runtime, entry.namespace, entry.component,
+                              client, config)
+        await router.start()
+        return router
+
+    return factory

@@ -1,0 +1,102 @@
+"""Worker selection: overlap-aware cost with softmax temperature sampling.
+
+Capability parity with reference KvScheduler/DefaultWorkerSelector
+(lib/llm/src/kv_router/scheduler.rs:76,361) and KvRouterConfig
+(kv_router.rs:88-100): for each candidate worker,
+
+  potential_prefill_blocks = request_blocks - overlap_blocks(worker)
+  potential_active_blocks  = worker_active_blocks + request_blocks
+  logit = overlap_score_weight * potential_prefill_blocks
+          + potential_active_blocks        (lower is better)
+
+With temperature == 0 pick the argmin (ties -> fewest active blocks); with
+temperature > 0 sample softmax(-logit / T). busy_threshold rejects when every
+worker's KV usage exceeds it (reference WorkerMonitor busy detection + the
+router 503 path, tested in test_router_e2e_with_mockers.py:381).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import random
+
+from dynamo_tpu.llm.kv_router.indexer import OverlapScores
+from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+from dynamo_tpu.llm.kv_router.sequence import ActiveSequencesMultiWorker
+from dynamo_tpu.runtime.errors import OverloadedError
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("kv_scheduler")
+
+
+@dataclasses.dataclass
+class KvRouterConfig:
+    overlap_score_weight: float = 1.0
+    temperature: float = 0.0
+    busy_threshold: float | None = None  # fraction of KV blocks in use
+    block_size: int = 16
+
+
+class KvScheduler:
+    def __init__(self, config: KvRouterConfig,
+                 sequences: ActiveSequencesMultiWorker):
+        self.config = config
+        self.sequences = sequences
+        # Latest ForwardPassMetrics per worker.
+        self.metrics: dict[int, ForwardPassMetrics] = {}
+
+    def update_metrics(self, metrics: ForwardPassMetrics) -> None:
+        self.metrics[metrics.worker_id] = metrics
+
+    def remove_worker(self, worker_id: int) -> None:
+        self.metrics.pop(worker_id, None)
+        self.sequences.remove_worker(worker_id)
+
+    def _predicted_blocks(self, worker_id: int) -> int:
+        """Reconciled in-flight block estimate. Worker metrics already include
+        requests we dispatched once the engine admits them, so summing metrics
+        and our optimistic ledger double-counts; take the max of the two views
+        (metrics lag by the publish interval, the ledger lags by completion)."""
+        m = self.metrics.get(worker_id)
+        observed = m.kv_stats.kv_active_blocks if m else 0
+        return max(observed, self.sequences.active_blocks(worker_id))
+
+    def _usage(self, worker_id: int) -> float:
+        m = self.metrics.get(worker_id)
+        if m is None or m.kv_stats.kv_total_blocks == 0:
+            return 0.0
+        return min(1.0, self._predicted_blocks(worker_id)
+                   / m.kv_stats.kv_total_blocks)
+
+    def select(self, workers: list[int], request_blocks: int,
+               overlaps: OverlapScores) -> tuple[int, int]:
+        """Pick a worker; returns (worker_id, overlap_blocks). Raises
+        OverloadedError when busy_threshold is set and all workers are busy."""
+        if not workers:
+            raise OverloadedError("no candidate workers")
+        if self.config.busy_threshold is not None:
+            free = [w for w in workers
+                    if self._usage(w) < self.config.busy_threshold]
+            if not free:
+                raise OverloadedError(
+                    f"all {len(workers)} workers above busy threshold "
+                    f"{self.config.busy_threshold}")
+            workers = free
+        logits: list[float] = []
+        for w in workers:
+            overlap = overlaps.get(w, 0)
+            potential_prefill = max(0, request_blocks - overlap)
+            potential_active = self._predicted_blocks(w) + request_blocks
+            logit = (self.config.overlap_score_weight * potential_prefill
+                     + potential_active)
+            logits.append(logit)
+        if self.config.temperature <= 0.0:
+            best = min(range(len(workers)), key=lambda i: logits[i])
+        else:
+            t = self.config.temperature
+            mx = max(-l / t for l in logits)
+            weights = [math.exp(-l / t - mx) for l in logits]
+            best = random.choices(range(len(workers)), weights=weights, k=1)[0]
+        chosen = workers[best]
+        return chosen, overlaps.get(chosen, 0)

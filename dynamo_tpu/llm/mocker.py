@@ -1,0 +1,301 @@
+"""Mocker: a TPU-engine simulator with real KV events and metrics.
+
+Capability parity with reference lib/llm/src/mocker (~3.3K LoC): a faithful
+continuous-batching simulation — waiting/prefill/decode scheduling with token
+budgets (mocker/scheduler.rs), a paged KV cache with prefix reuse and LRU
+eviction that emits real stored/removed KV events (mocker/kv_manager.rs), and
+ForwardPassMetrics publishing — so KV-aware routing, overload, replica sync,
+and migration are testable with zero TPUs (mocker/protocols.rs:79-104
+speedup_ratio/num_gpu_blocks args). The timing model approximates a TPU chip:
+prefill at a fixed tok/s, decode steps at a fixed latency per batch iteration.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import time
+from collections import OrderedDict
+from typing import AsyncIterator
+
+from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics, KvStats, WorkerStats
+from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.llm.tokens import TokenBlockSequence
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import AsyncEngine
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("mocker")
+
+
+@dataclasses.dataclass
+class MockerConfig:
+    num_kv_blocks: int = 1024
+    block_size: int = 16
+    max_num_seqs: int = 64
+    max_batched_tokens: int = 8192
+    prefill_tokens_per_s: float = 100_000.0
+    decode_step_s: float = 0.005
+    speedup_ratio: float = 1.0  # reference mocker/protocols.rs:79
+
+    def prefill_time(self, tokens: int) -> float:
+        return tokens / self.prefill_tokens_per_s / self.speedup_ratio
+
+    def decode_time(self) -> float:
+        return self.decode_step_s / self.speedup_ratio
+
+
+class KvCacheSim:
+    """Paged KV cache simulation with prefix reuse + LRU eviction
+    (reference mocker/kv_manager.rs). Emits stored/removed hashes via the
+    events lists drained by the engine loop."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        # block_hash -> refcount; insertion order refreshed on use = LRU.
+        self._blocks: OrderedDict[int, int] = OrderedDict()
+        self.stored_events: list[int] = []
+        self.removed_events: list[int] = []
+
+    def lookup_prefix(self, hashes: list[int]) -> int:
+        """Longest cached prefix (cache hit blocks) for a new sequence.
+        Refreshes recency of the hits."""
+        n = 0
+        for h in hashes:
+            if h in self._blocks:
+                self._blocks.move_to_end(h)
+                n += 1
+            else:
+                break
+        return n
+
+    def allocate(self, hashes: list[int]) -> bool:
+        """Pin all blocks of ``hashes`` (allocating misses). False if the pool
+        can't fit even after evicting unpinned blocks."""
+        wanted = set(hashes)
+        misses = [h for h in hashes if h not in self._blocks]
+        free_needed = len(self._blocks) + len(misses) - self.capacity
+        if free_needed > 0 and not self._evict(free_needed, protect=wanted):
+            return False
+        for h in hashes:
+            if h in self._blocks:
+                self._blocks[h] += 1
+                self._blocks.move_to_end(h)
+            else:
+                self._blocks[h] = 1
+                self.stored_events.append(h)
+        return True
+
+    def _evict(self, count: int, protect: set[int] = frozenset()) -> bool:
+        """Evict ``count`` unpinned LRU blocks, never touching ``protect``
+        (the request being allocated — evicting its own reusable blocks would
+        overflow capacity and emit bogus removed+stored event pairs)."""
+        victims = [h for h, ref in self._blocks.items()
+                   if ref == 0 and h not in protect]
+        if len(victims) < count:
+            return False
+        for h in victims[:count]:
+            del self._blocks[h]
+            self.removed_events.append(h)
+        return True
+
+    def append_block(self, h: int) -> bool:
+        """Allocate one new pinned block for a decoding sequence."""
+        return self.allocate([h]) if h not in self._blocks else self._pin(h)
+
+    def _pin(self, h: int) -> bool:
+        self._blocks[h] += 1
+        self._blocks.move_to_end(h)
+        return True
+
+    def release(self, hashes: list[int]) -> None:
+        """Unpin (blocks stay cached for prefix reuse until evicted)."""
+        for h in hashes:
+            if h in self._blocks and self._blocks[h] > 0:
+                self._blocks[h] -= 1
+
+    @property
+    def active_blocks(self) -> int:
+        return sum(1 for ref in self._blocks.values() if ref > 0)
+
+    @property
+    def cached_blocks(self) -> int:
+        return len(self._blocks)
+
+
+class _Seq:
+    def __init__(self, req: PreprocessedRequest, ctx: Context, block_size: int):
+        self.req = req
+        self.ctx = ctx
+        self.out_q: asyncio.Queue = asyncio.Queue()
+        self.blocks = TokenBlockSequence(block_size, req.token_ids)
+        self.generated = 0
+        self.prefill_done_at: float | None = None
+        self.cached_prefix_blocks = 0
+
+
+class MockerEngine(AsyncEngine):
+    def __init__(self, config: MockerConfig | None = None,
+                 kv_publisher=None, metrics_publisher=None):
+        self.config = config or MockerConfig()
+        self.kv = KvCacheSim(self.config.num_kv_blocks)
+        self.kv_publisher = kv_publisher
+        self.metrics_publisher = metrics_publisher
+        self.waiting: list[_Seq] = []
+        self.prefilling: list[_Seq] = []
+        self.decoding: list[_Seq] = []
+        self._loop_task: asyncio.Task | None = None
+        self._wake = asyncio.Event()
+        self.prefix_hits = 0
+        self.prefix_lookups = 0
+
+    def start(self) -> None:
+        if self._loop_task is None:
+            self._loop_task = asyncio.create_task(self._engine_loop())
+
+    async def stop(self) -> None:
+        if self._loop_task:
+            self._loop_task.cancel()
+            self._loop_task = None
+
+    # -- engine interface -----------------------------------------------------
+    async def generate(self, request, context: Context) -> AsyncIterator[dict]:
+        self.start()
+        req = (request if isinstance(request, PreprocessedRequest)
+               else PreprocessedRequest.from_wire(request))
+        seq = _Seq(req, context, self.config.block_size)
+        self.waiting.append(seq)
+        self._wake.set()
+        while True:
+            item = await seq.out_q.get()
+            if item is None:
+                return
+            yield item
+            if item.get("finish_reason"):
+                return
+
+    def handler(self):
+        async def handle(request, context):
+            async for out in self.generate(request, context):
+                yield out
+
+        return handle
+
+    # -- simulation loop ------------------------------------------------------
+    async def _engine_loop(self) -> None:
+        cfg = self.config
+        while True:
+            if not (self.waiting or self.prefilling or self.decoding):
+                self._wake.clear()
+                await self._wake.wait()
+            now = time.monotonic()
+            self._admit(now)
+            # Complete prefills whose simulated time has elapsed.
+            for seq in list(self.prefilling):
+                if now >= seq.prefill_done_at:
+                    self.prefilling.remove(seq)
+                    self.decoding.append(seq)
+                    # First token is produced by the prefill itself.
+                    self._emit_token(seq)
+            # One decode iteration for the whole batch.
+            if self.decoding:
+                await asyncio.sleep(cfg.decode_time())
+                for seq in list(self.decoding):
+                    self._emit_token(seq)
+            else:
+                await asyncio.sleep(cfg.decode_time())
+            try:
+                await self._flush_events()
+                await self._publish_metrics()
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001 — publishing must not
+                # kill the simulation loop (requests would hang forever).
+                log.warning("mocker publish failed: %s", exc)
+
+    def _admit(self, now: float) -> None:
+        cfg = self.config
+        while self.waiting and (len(self.prefilling) + len(self.decoding)
+                                < cfg.max_num_seqs):
+            seq = self.waiting[0]
+            if seq.ctx.is_killed:
+                self.waiting.pop(0)
+                seq.out_q.put_nowait(None)
+                continue
+            hashes = seq.blocks.block_hashes
+            self.prefix_lookups += 1
+            cached = self.kv.lookup_prefix(hashes)
+            if not self.kv.allocate(hashes):
+                break  # no KV room: stays waiting
+            if cached:
+                self.prefix_hits += 1
+            seq.cached_prefix_blocks = cached
+            new_tokens = len(seq.req.token_ids) - cached * cfg.block_size
+            self.waiting.pop(0)
+            seq.prefill_done_at = now + cfg.prefill_time(max(0, new_tokens))
+            self.prefilling.append(seq)
+
+    def _emit_token(self, seq: _Seq) -> None:
+        cfg = self.config
+        if seq.ctx.is_killed:
+            self._finish(seq, None)
+            return
+        if seq.ctx.is_stopped:
+            self._finish(seq, FinishReason.CANCELLED)
+            return
+        # Deterministic "generation": echo prompt tokens cyclically.
+        prompt = seq.req.token_ids or [0]
+        token = prompt[seq.generated % len(prompt)]
+        new_block = seq.blocks.append(token)
+        if new_block is not None:
+            self.kv.append_block(new_block)
+        seq.generated += 1
+        budget = seq.req.stop_conditions.max_tokens or 16
+        finish = FinishReason.LENGTH if seq.generated >= budget else None
+        seq.out_q.put_nowait(LLMEngineOutput(
+            token_ids=[token], finish_reason=finish).to_wire())
+        if finish:
+            self._finish(seq, None)
+
+    def _finish(self, seq: _Seq, reason: FinishReason | None) -> None:
+        if seq in self.decoding:
+            self.decoding.remove(seq)
+        self.kv.release(seq.blocks.block_hashes)
+        if reason is not None:
+            seq.out_q.put_nowait(LLMEngineOutput(
+                token_ids=[], finish_reason=reason).to_wire())
+        else:
+            seq.out_q.put_nowait(None)
+
+    async def _flush_events(self) -> None:
+        if self.kv_publisher is None:
+            self.kv.stored_events.clear()
+            self.kv.removed_events.clear()
+            return
+        if self.kv.stored_events:
+            stored, self.kv.stored_events = self.kv.stored_events, []
+            await self.kv_publisher.stored(stored)
+        if self.kv.removed_events:
+            removed, self.kv.removed_events = self.kv.removed_events, []
+            await self.kv_publisher.removed(removed)
+
+    async def _publish_metrics(self) -> None:
+        if self.metrics_publisher is None:
+            return
+        cfg = self.config
+        active = len(self.prefilling) + len(self.decoding)
+        hit_rate = (self.prefix_hits / self.prefix_lookups
+                    if self.prefix_lookups else 0.0)
+        # Force the transition-to-idle publish past the throttle, otherwise
+        # routers keep seeing the last busy snapshot forever.
+        force = active == 0 and not self.waiting
+        await self.metrics_publisher.publish(ForwardPassMetrics(
+            worker_stats=WorkerStats(
+                request_active_slots=active,
+                request_total_slots=cfg.max_num_seqs,
+                num_requests_waiting=len(self.waiting)),
+            kv_stats=KvStats(
+                kv_active_blocks=self.kv.active_blocks,
+                kv_total_blocks=cfg.num_kv_blocks,
+                gpu_cache_usage_perc=self.kv.active_blocks / cfg.num_kv_blocks,
+                gpu_prefix_cache_hit_rate=hit_rate)), force=force)

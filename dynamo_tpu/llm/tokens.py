@@ -1,0 +1,77 @@
+"""Token-block hashing: the canonical block-hash math.
+
+Capability parity with reference dynamo-tokens (lib/tokens/src/lib.rs:29-370)
+and the router's hashing (lib/llm/src/kv_router/indexer.rs:87-150): token
+sequences are split into fixed-size blocks; each block's hash chains its
+parent's hash (xxh3-64 with a salt), so a block hash uniquely identifies the
+entire prefix up to and including that block. Shared by the KV router, the KV
+block manager, and engines emitting KV events — all three MUST agree.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterable, Sequence
+
+import xxhash
+
+# Salt for hash chaining (reference indexer.rs uses a fixed seed so all
+# processes agree).
+HASH_SEED = 1337
+
+
+def hash_block(parent_hash: int | None, token_ids: Sequence[int]) -> int:
+    """xxh3_64 over parent hash (8 bytes LE, 0 for the root) + token ids
+    (u32 LE each)."""
+    h = xxhash.xxh3_64(seed=HASH_SEED)
+    h.update(struct.pack("<Q", parent_hash if parent_hash is not None else 0))
+    h.update(struct.pack(f"<{len(token_ids)}I", *token_ids))
+    return h.intdigest()
+
+
+def compute_block_hashes(token_ids: Sequence[int], block_size: int
+                         ) -> list[int]:
+    """Hashes for all COMPLETE blocks of the sequence (partial tail block is
+    excluded — it can't be cache-shared; reference
+    compute_block_hash_for_seq, indexer.rs:123)."""
+    hashes: list[int] = []
+    parent: int | None = None
+    for start in range(0, len(token_ids) - block_size + 1, block_size):
+        parent = hash_block(parent, token_ids[start:start + block_size])
+        hashes.append(parent)
+    return hashes
+
+
+class TokenBlockSequence:
+    """A token sequence maintained as hashed complete blocks + a partial tail
+    (reference TokenBlockSequence/PartialTokenBlock, lib/tokens lib.rs)."""
+
+    def __init__(self, block_size: int, token_ids: Iterable[int] = ()):
+        self.block_size = block_size
+        self.tokens: list[int] = []
+        self.block_hashes: list[int] = []
+        self.extend(token_ids)
+
+    def extend(self, token_ids: Iterable[int]) -> list[int]:
+        """Append tokens; return hashes of any newly completed blocks."""
+        self.tokens.extend(token_ids)
+        new: list[int] = []
+        while len(self.tokens) // self.block_size > len(self.block_hashes):
+            idx = len(self.block_hashes)
+            block = self.tokens[idx * self.block_size:(idx + 1) * self.block_size]
+            parent = self.block_hashes[-1] if self.block_hashes else None
+            h = hash_block(parent, block)
+            self.block_hashes.append(h)
+            new.append(h)
+        return new
+
+    def append(self, token_id: int) -> int | None:
+        new = self.extend([token_id])
+        return new[0] if new else None
+
+    @property
+    def num_complete_blocks(self) -> int:
+        return len(self.block_hashes)
+
+    def __len__(self) -> int:
+        return len(self.tokens)

@@ -137,6 +137,10 @@ class Coordinator:
             self._expiry_task.cancel()
         if self._server:
             self._server.close()
+            # Close live client connections so wait_closed() (which waits for
+            # all handlers on Python 3.12+) can complete.
+            for conn in list(self._conns):
+                conn.close()
             await self._server.wait_closed()
 
     @property

@@ -34,6 +34,7 @@ class EndpointServer:
         self._handler = handler
         self._graceful = graceful_shutdown
         self._server: asyncio.AbstractServer | None = None
+        self._conn_writers: set[asyncio.StreamWriter] = set()
         self._inflight: dict[str, tuple[asyncio.Task, Context]] = {}
         self._stopping = asyncio.Event()
         self.metrics_labels = metrics_labels or {}
@@ -103,6 +104,7 @@ class EndpointServer:
                 await write_frame(writer, obj)
 
         conn_tasks: set[asyncio.Task] = set()
+        self._conn_writers.add(writer)
         try:
             while True:
                 msg = await read_frame(reader)
@@ -135,6 +137,7 @@ class EndpointServer:
             # Caller vanished: kill its in-flight work.
             for task in conn_tasks:
                 task.cancel()
+            self._conn_writers.discard(writer)
             writer.close()
 
     async def _run_request(self, rid: str, request: Any, ctx: Context,
@@ -193,6 +196,10 @@ class EndpointServer:
             task.cancel()
         if self._server:
             self._server.close()
+            # Python 3.12 wait_closed() blocks until every connection handler
+            # finishes; close peer connections so it can.
+            for writer in list(self._conn_writers):
+                writer.close()
             await self._server.wait_closed()
 
     async def wait(self) -> None:

@@ -1,0 +1,151 @@
+"""KV router unit tests: hashing, radix indexer, scheduler cost, sequences.
+
+Mirrors reference inline tests in lib/llm/src/kv_router/indexer.rs and
+lib/tokens hashing tests.
+"""
+
+from dynamo_tpu.llm.kv_router.indexer import ApproxKvIndexer, RadixTree
+from dynamo_tpu.llm.kv_router.protocols import (
+    ForwardPassMetrics,
+    KvCacheEvent,
+    KvStats,
+    RouterEvent,
+    WorkerStats,
+)
+from dynamo_tpu.llm.kv_router.scheduler import KvRouterConfig, KvScheduler
+from dynamo_tpu.llm.kv_router.sequence import ActiveSequencesMultiWorker
+from dynamo_tpu.llm.tokens import TokenBlockSequence, compute_block_hashes, hash_block
+from dynamo_tpu.runtime.errors import OverloadedError
+
+
+def test_block_hash_chaining():
+    toks = list(range(64))
+    hashes = compute_block_hashes(toks, 16)
+    assert len(hashes) == 4
+    # Chained: same block content under different parents differs.
+    assert hash_block(None, toks[:16]) == hashes[0]
+    assert hash_block(hashes[0], toks[16:32]) == hashes[1]
+    assert hash_block(None, toks[16:32]) != hashes[1]
+    # Partial tail block excluded.
+    assert len(compute_block_hashes(toks[:63], 16)) == 3
+    # Deterministic across calls.
+    assert compute_block_hashes(toks, 16) == hashes
+
+
+def test_token_block_sequence_incremental():
+    seq = TokenBlockSequence(4, [1, 2, 3])
+    assert seq.num_complete_blocks == 0
+    assert seq.append(4) is not None  # completes block 0
+    assert seq.append(5) is None
+    seq.extend([6, 7, 8])
+    assert seq.num_complete_blocks == 2
+    assert seq.block_hashes == compute_block_hashes([1, 2, 3, 4, 5, 6, 7, 8], 4)
+
+
+def make_event(worker, hashes, kind="stored"):
+    ev = (KvCacheEvent.stored(hashes) if kind == "stored"
+          else KvCacheEvent.removed(hashes))
+    return RouterEvent(worker_id=worker, event=ev)
+
+
+def test_radix_tree_longest_prefix_matching():
+    tree = RadixTree()
+    toks = list(range(64))
+    hashes = compute_block_hashes(toks, 16)  # 4 blocks
+    tree.apply_event(make_event(1, hashes))        # worker 1 holds all 4
+    tree.apply_event(make_event(2, hashes[:2]))    # worker 2 holds first 2
+    scores = tree.find_matches(hashes)
+    assert scores == {1: 4, 2: 2}
+    # Worker holding later blocks but NOT the first scores zero.
+    tree.apply_event(make_event(3, hashes[2:]))
+    scores = tree.find_matches(hashes)
+    assert 3 not in scores
+    # Removal shrinks the match.
+    tree.apply_event(make_event(1, hashes[1:], kind="removed"))
+    scores = tree.find_matches(hashes)
+    assert scores == {1: 1, 2: 2}
+
+
+def test_radix_tree_remove_worker():
+    tree = RadixTree()
+    hashes = compute_block_hashes(list(range(32)), 16)
+    tree.apply_event(make_event(1, hashes))
+    tree.apply_event(make_event(2, hashes))
+    tree.remove_worker(1)
+    assert tree.find_matches(hashes) == {2: 2}
+    assert tree.workers() == {2}
+    tree.remove_worker(2)
+    assert tree.num_blocks == 0
+
+
+def test_radix_tree_dump_as_events_rebuilds():
+    tree = RadixTree()
+    h1 = compute_block_hashes(list(range(32)), 16)
+    h2 = compute_block_hashes(list(range(100, 148)), 16)
+    tree.apply_event(make_event(1, h1))
+    tree.apply_event(make_event(2, h2))
+    rebuilt = RadixTree()
+    for ev in tree.dump_as_events():
+        rebuilt.apply_event(ev)
+    assert rebuilt.find_matches(h1) == tree.find_matches(h1)
+    assert rebuilt.find_matches(h2) == tree.find_matches(h2)
+
+
+def test_scheduler_prefers_overlap_then_load():
+    seqs = ActiveSequencesMultiWorker()
+    sched = KvScheduler(KvRouterConfig(overlap_score_weight=1.0), seqs)
+    # Two idle workers; worker 2 has 8 blocks of overlap for a 10-block req.
+    chosen, overlap = sched.select([1, 2], request_blocks=10, overlaps={2: 8})
+    assert (chosen, overlap) == (2, 8)
+    # Pile synthetic load on worker 2; eventually worker 1 wins despite overlap.
+    for i in range(30):
+        seqs.add_request(2, f"r{i}", new_blocks=10, prefill_tokens=0)
+    chosen, _ = sched.select([1, 2], request_blocks=10, overlaps={2: 8})
+    assert chosen == 1
+
+
+def test_scheduler_busy_threshold_503():
+    seqs = ActiveSequencesMultiWorker()
+    sched = KvScheduler(KvRouterConfig(busy_threshold=0.8), seqs)
+    full = ForwardPassMetrics(
+        worker_id=1, worker_stats=WorkerStats(),
+        kv_stats=KvStats(kv_active_blocks=95, kv_total_blocks=100))
+    sched.update_metrics(full)
+    try:
+        sched.select([1], request_blocks=2, overlaps={})
+        raise AssertionError("expected OverloadedError")
+    except OverloadedError:
+        pass
+    # A second, free worker absorbs the request.
+    free = ForwardPassMetrics(
+        worker_id=2, worker_stats=WorkerStats(),
+        kv_stats=KvStats(kv_active_blocks=5, kv_total_blocks=100))
+    sched.update_metrics(free)
+    chosen, _ = sched.select([1, 2], request_blocks=2, overlaps={})
+    assert chosen == 2
+
+
+def test_active_sequences_accounting():
+    seqs = ActiveSequencesMultiWorker()
+    seqs.add_request(7, "a", new_blocks=5, prefill_tokens=80)
+    seqs.add_request(7, "b", new_blocks=3, prefill_tokens=48)
+    assert seqs.active_blocks(7) == 8
+    assert seqs.prefill_tokens(7) == 128
+    seqs.mark_prefill_complete(7, "a")
+    assert seqs.prefill_tokens(7) == 48
+    seqs.free(7, "a")
+    assert seqs.active_blocks(7) == 3
+    assert seqs.active_seqs(7) == 1
+    seqs.free(7, "b")
+    assert seqs.active_blocks(7) == 0
+
+
+def test_approx_indexer_ttl():
+    idx = ApproxKvIndexer(block_size=16, ttl_s=0.0)  # instant expiry
+    toks = list(range(32))
+    idx.touch(5, toks)
+    # ttl 0 -> purge drops it on next lookup
+    assert idx.find_matches_for_tokens(toks) == {}
+    idx2 = ApproxKvIndexer(block_size=16, ttl_s=60.0)
+    idx2.touch(5, toks)
+    assert idx2.find_matches_for_tokens(toks) == {5: 2}

@@ -1,0 +1,210 @@
+"""Router e2e with mocker engines — parity with reference
+tests/router/test_router_e2e_with_mockers.py: KV-aware routing steers
+same-prefix requests to the same worker, busy-threshold overload returns 503,
+and two router replicas stay consistent via sync events. All in-process.
+"""
+
+import asyncio
+
+import aiohttp
+from conftest import async_test
+
+from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+from dynamo_tpu.llm.http_service import HttpService
+from dynamo_tpu.llm.kv_router import make_kv_router_factory
+from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, WorkerMetricsPublisher
+from dynamo_tpu.llm.mocker import MockerConfig, MockerEngine
+from dynamo_tpu.llm.model_card import register_llm
+from dynamo_tpu.llm.tokenizer import make_test_tokenizer
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.coordinator import Coordinator
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+NS = "test"
+FAST = dict(prefill_tokens_per_s=1e7, decode_step_s=0.0005)
+
+
+async def start_mocker(coord, name="mock-model", **cfg_kwargs):
+    rt = await DistributedRuntime.from_settings(
+        RuntimeConfig(coordinator_url=coord.url, lease_ttl_s=1.0, namespace=NS))
+    config = MockerConfig(**{**FAST, **cfg_kwargs})
+    kv_pub = KvEventPublisher(rt, NS, "mocker", rt.instance_id)
+    m_pub = WorkerMetricsPublisher(rt, NS, "mocker", rt.instance_id,
+                                   min_interval_s=0.01)
+    engine = MockerEngine(config, kv_pub, m_pub)
+    endpoint = rt.namespace(NS).component("mocker").endpoint("generate")
+    server = await endpoint.serve_endpoint(engine.handler(),
+                                           graceful_shutdown=False)
+    await register_llm(rt, endpoint, name, make_test_tokenizer(),
+                       kv_cache_block_size=config.block_size)
+    engine.start()
+    return rt, engine, server
+
+
+async def start_frontend(coord, busy_threshold=None, temperature=0.0):
+    rt = await DistributedRuntime.from_settings(
+        RuntimeConfig(coordinator_url=coord.url, lease_ttl_s=1.0, namespace=NS))
+    manager = ModelManager()
+    watcher = ModelWatcher(
+        rt, manager, router_mode="kv",
+        kv_router_factory=make_kv_router_factory(
+            temperature=temperature, busy_threshold=busy_threshold))
+    await watcher.start()
+    service = HttpService(rt, manager, host="127.0.0.1", port=0)
+    await service.start()
+    return rt, manager, watcher, service
+
+
+async def wait_model(manager, name="mock-model", timeout=5.0):
+    for _ in range(int(timeout / 0.02)):
+        if manager.get(name):
+            return
+        await asyncio.sleep(0.02)
+    raise AssertionError(f"model {name} never discovered")
+
+
+async def post_chat(port, content, max_tokens=8):
+    async with aiohttp.ClientSession() as session:
+        async with session.post(
+            f"http://127.0.0.1:{port}/v1/chat/completions",
+            json={"model": "mock-model", "max_tokens": max_tokens,
+                  "messages": [{"role": "user", "content": content}]}) as resp:
+            return resp.status, await resp.json()
+
+
+@async_test
+async def test_kv_routing_same_prefix_sticks_to_one_worker():
+    coord = Coordinator()
+    await coord.start()
+    m1 = await start_mocker(coord)
+    m2 = await start_mocker(coord)
+    f = await start_frontend(coord)
+    rt, manager, watcher, service = f
+    try:
+        await wait_model(manager)
+        served = manager.get("mock-model")
+        while len(served.client.instance_ids()) < 2:
+            await asyncio.sleep(0.02)
+        # Spy on routing decisions.
+        router = served.router
+        decisions: list[tuple[int, int]] = []
+        orig_select = router.scheduler.select
+
+        def spying_select(*args, **kwargs):
+            result = orig_select(*args, **kwargs)
+            decisions.append(result)
+            return result
+
+        router.scheduler.select = spying_select
+        # Long shared prefix so block hashes overlap strongly.
+        prefix = "the quick brown fox jumps over the lazy dog " * 20
+        status, _ = await post_chat(service.port, prefix + "first")
+        assert status == 200
+        # Poll until the first worker's KV events have landed in the indexer.
+        for _ in range(200):
+            if router.indexer.tree.num_blocks > 0:
+                break
+            await asyncio.sleep(0.05)
+        assert router.indexer.tree.num_blocks > 0
+        await asyncio.sleep(0.2)
+        for i in range(4):
+            status, _ = await post_chat(service.port, prefix + f"req{i}")
+            assert status == 200
+            await asyncio.sleep(0.2)
+        # Later same-prefix requests saw overlap and stuck to the first worker.
+        workers_chosen = {w for w, _ in decisions}
+        assert len(workers_chosen) == 1, decisions
+        assert any(overlap > 0 for _, overlap in decisions[1:]), decisions
+    finally:
+        await service.stop()
+        await watcher.stop()
+        for mrt, engine, server in (m1, m2):
+            await engine.stop()
+            await server.shutdown()
+            await mrt.close()
+        await rt.close()
+        await coord.stop()
+
+
+@async_test
+async def test_busy_threshold_returns_503():
+    coord = Coordinator()
+    await coord.start()
+    # Tiny KV pool + slow decode so blocks stay pinned.
+    m1 = await start_mocker(coord, num_kv_blocks=8, decode_step_s=0.05)
+    f = await start_frontend(coord, busy_threshold=0.5)
+    rt, manager, watcher, service = f
+    try:
+        await wait_model(manager)
+        served = manager.get("mock-model")
+        while len(served.client.instance_ids()) < 1:
+            await asyncio.sleep(0.02)
+        # Occupy the pool with a long-running request (long prompt = many blocks).
+        long_prompt = "tok " * 400
+        hog = asyncio.create_task(
+            post_chat(service.port, long_prompt, max_tokens=200))
+        # Wait for metrics showing usage above threshold.
+        router = served.router
+        for _ in range(200):
+            m = router.scheduler.metrics.get(
+                next(iter(served.client.instance_ids()), 0))
+            if m and m.kv_stats.kv_active_blocks / max(1, m.kv_stats.kv_total_blocks) >= 0.5:
+                break
+            await asyncio.sleep(0.02)
+        status, body = await post_chat(service.port, "another " * 50,
+                                       max_tokens=5)
+        assert status == 503, body
+        assert body["error"]["type"] == "overloaded"
+        hog.cancel()
+    finally:
+        await service.stop()
+        await watcher.stop()
+        mrt, engine, server = m1
+        await engine.stop()
+        await server.shutdown()
+        await mrt.close()
+        await rt.close()
+        await coord.stop()
+
+
+@async_test
+async def test_two_router_replicas_share_load_state():
+    coord = Coordinator()
+    await coord.start()
+    m1 = await start_mocker(coord)
+    f1 = await start_frontend(coord)
+    f2 = await start_frontend(coord)
+    try:
+        for f in (f1, f2):
+            await wait_model(f[1])
+        served1, served2 = f1[1].get("mock-model"), f2[1].get("mock-model")
+        while not (served1.client.instance_ids() and served2.client.instance_ids()):
+            await asyncio.sleep(0.02)
+        worker = served1.client.instance_ids()[0]
+        # Issue a request through replica 1; replica 2 must see the optimistic
+        # load via router_sync while it is in flight.
+        slow_task = asyncio.create_task(
+            post_chat(f1[3].port, "hello " * 100, max_tokens=150))
+        seen = False
+        for _ in range(300):
+            if served2.router.sequences.active_seqs(worker) > 0:
+                seen = True
+                break
+            await asyncio.sleep(0.01)
+        assert seen, "replica 2 never saw replica 1's in-flight request"
+        await slow_task
+        for _ in range(200):
+            if served2.router.sequences.active_seqs(worker) == 0:
+                break
+            await asyncio.sleep(0.01)
+        assert served2.router.sequences.active_seqs(worker) == 0
+    finally:
+        for f in (f1, f2):
+            await f[3].stop()
+            await f[2].stop()
+            await f[0].close()
+        mrt, engine, server = m1
+        await engine.stop()
+        await server.shutdown()
+        await mrt.close()
+        await coord.stop()
