@@ -1,0 +1,98 @@
+"""Benchmark: steady-state decode throughput of the TPU engine on one chip.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Workload: qwen2.5-0.5b-shaped model (random bf16 weights), full 32-sequence
+continuous-batching decode with paged attention, ISL 128 / steady decode.
+``vs_baseline`` compares per-chip decode token throughput against the
+reference's published per-GPU decode example (BASELINE.md: 51.22 tok/s/GPU
+per-request ITL at TP4 on an unspecified NVIDIA node — the only absolute
+number the reference publishes; config ladder step 1-2 equivalent).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    from dynamo_tpu.engine.config import EngineConfig, PRESETS
+    from dynamo_tpu.engine.runner import ModelRunner
+
+    spec = PRESETS["qwen2.5-0.5b"]
+    batch = 32
+    isl = 128
+    page = 16
+    maxp = 64  # up to 1024 tokens/seq
+    config = EngineConfig(
+        model=spec, page_size=page, num_pages=batch * maxp + 16,
+        max_pages_per_seq=maxp, max_num_seqs=batch,
+        prefill_buckets=(128, 256, 512, 1024),
+        max_prefill_tokens=1024, attention_backend="auto")
+    runner = ModelRunner(config)
+    rng = np.random.default_rng(0)
+
+    # Prefill all sequences (measures TTFT path; timed separately).
+    pages_per_seq = isl // page
+    t0 = time.monotonic()
+    for b in range(batch):
+        prompt = rng.integers(0, spec.vocab_size, size=isl).astype(np.int32)
+        pages = np.arange(1 + b * maxp, 1 + b * maxp + pages_per_seq,
+                          dtype=np.int32)
+        runner.prefill(prompt, 0, pages, None, (0.0, 0, 1.0))
+    prefill_s = time.monotonic() - t0
+
+    # Decode state.
+    tokens = rng.integers(0, spec.vocab_size, size=batch).astype(np.int32)
+    positions = np.full(batch, isl, np.int32)
+    page_table = np.zeros((batch, maxp), np.int32)
+    for b in range(batch):
+        page_table[b] = np.arange(1 + b * maxp, 1 + (b + 1) * maxp)
+    seq_lens = np.full(batch, isl + 1, np.int32)
+    temp = np.zeros(batch, np.float32)
+    top_k = np.zeros(batch, np.int32)
+    top_p = np.ones(batch, np.float32)
+
+    def step():
+        nonlocal tokens, positions, seq_lens
+        sampled = runner.decode(tokens, positions, page_table, seq_lens,
+                                temp, top_k, top_p)
+        tokens = sampled
+        positions = positions + 1
+        seq_lens = seq_lens + 1
+        return sampled
+
+    # Warmup (compile) + steady-state measurement.
+    for _ in range(3):
+        step()
+    steps = 64
+    t0 = time.monotonic()
+    for _ in range(steps):
+        step()
+    elapsed = time.monotonic() - t0
+    tok_s = batch * steps / elapsed
+    itl_ms = 1e3 * elapsed / steps
+    baseline_decode_tok_s = 51.22  # BASELINE.md profiler example, tok/s/GPU
+    print(json.dumps({
+        "metric": "decode_tok_s_per_chip_qwen2.5-0.5b_bs32_isl128",
+        "value": round(tok_s, 2),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(tok_s / baseline_decode_tok_s, 3),
+        "detail": {
+            "itl_ms_batch": round(itl_ms, 3),
+            "prefill_s_total": round(prefill_s, 3),
+            "prefill_tok_s": round(batch * isl / prefill_s, 1),
+            "platform": jax.devices()[0].platform,
+            "device": str(jax.devices()[0]),
+            "attention": config.attention_backend,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,127 @@
+"""TPU worker: serves the JAX engine as a registered model.
+
+``python -m dynamo_tpu.backends.tpu --model llama-3-8b`` — the TPU-native
+equivalent of the reference's vLLM worker (components/backends/vllm/src/dynamo/
+vllm/main.py, SURVEY.md call stack 3.2): starts the engine, registers the
+model with its runtime config, serves the endpoint, publishes KV events +
+ForwardPassMetrics.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+
+from dynamo_tpu.engine.config import EngineConfig, PRESETS, ModelSpec
+from dynamo_tpu.engine.engine import TPUEngine
+from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, WorkerMetricsPublisher
+from dynamo_tpu.llm.model_card import ModelRuntimeConfig, register_llm
+from dynamo_tpu.llm.tokenizer import Tokenizer, make_test_tokenizer
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("tpu_worker")
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(description="dynamo-tpu TPU engine worker")
+    parser.add_argument("--model", default="tiny-test",
+                        help="preset name or path to a HF model dir")
+    parser.add_argument("--model-name", default=None,
+                        help="served model name (default: preset/dir name)")
+    parser.add_argument("--namespace", default=None)
+    parser.add_argument("--component", default="tpu")
+    parser.add_argument("--endpoint", default="generate")
+    parser.add_argument("--tokenizer", default=None)
+    parser.add_argument("--page-size", type=int, default=16)
+    parser.add_argument("--num-pages", type=int, default=None)
+    parser.add_argument("--max-num-seqs", type=int, default=32)
+    parser.add_argument("--max-pages-per-seq", type=int, default=512)
+    parser.add_argument("--tp", type=int, default=1)
+    parser.add_argument("--dp", type=int, default=1)
+    parser.add_argument("--attention-backend", default="auto",
+                        choices=["auto", "pallas", "xla"])
+    parser.add_argument("--migration-limit", type=int, default=0)
+    parser.add_argument("--coordinator-url", default=None)
+    return parser.parse_args(argv)
+
+
+def build_engine_config(args) -> EngineConfig:
+    if args.model in PRESETS:
+        spec = PRESETS[args.model]
+    elif os.path.isdir(args.model):
+        spec = ModelSpec.from_hf_config(args.model)
+    else:
+        raise SystemExit(f"unknown model {args.model!r}; presets: "
+                         f"{sorted(PRESETS)} or a local HF model dir")
+    return EngineConfig(
+        model=spec, page_size=args.page_size, num_pages=args.num_pages,
+        max_num_seqs=args.max_num_seqs, max_pages_per_seq=args.max_pages_per_seq,
+        tp=args.tp, dp=args.dp, attention_backend=args.attention_backend)
+
+
+async def run(args: argparse.Namespace) -> None:
+    cfg = RuntimeConfig.from_settings()
+    if args.coordinator_url:
+        cfg.coordinator_url = args.coordinator_url
+    if args.namespace:
+        cfg.namespace = args.namespace
+    runtime = await DistributedRuntime.from_settings(cfg)
+    try:
+        engine_cfg = build_engine_config(args)
+        model_name = args.model_name or engine_cfg.model.name
+        if args.tokenizer:
+            tokenizer = Tokenizer.from_file(args.tokenizer)
+        elif os.path.isdir(args.model):
+            tokenizer = Tokenizer.from_pretrained_dir(args.model)
+        else:
+            tokenizer = make_test_tokenizer()
+        ns = cfg.namespace
+        kv_pub = KvEventPublisher(runtime, ns, args.component,
+                                  runtime.instance_id)
+        metrics_pub = WorkerMetricsPublisher(runtime, ns, args.component,
+                                             runtime.instance_id)
+        params = None
+        if os.path.isdir(args.model):
+            from dynamo_tpu.engine.weights import load_hf_weights
+            params = load_hf_weights(engine_cfg.model, args.model)
+        engine = TPUEngine(engine_cfg, params=params, kv_publisher=kv_pub,
+                           metrics_publisher=metrics_pub)
+        endpoint = (runtime.namespace(None).component(args.component)
+                    .endpoint(args.endpoint))
+        server = await endpoint.serve_endpoint(engine.handler(),
+                                               graceful_shutdown=False)
+        await register_llm(
+            runtime, endpoint, model_name, tokenizer,
+            context_length=engine_cfg.max_model_len,
+            kv_cache_block_size=engine_cfg.page_size,
+            migration_limit=args.migration_limit,
+            runtime_config=ModelRuntimeConfig(
+                total_kv_blocks=engine.runner.num_pages,
+                max_num_seqs=engine_cfg.max_num_seqs))
+        engine.start()
+        print(f"TPU_WORKER_READY port={server.port} "
+              f"worker={runtime.instance_id:x} pages={engine.runner.num_pages}",
+              flush=True)
+        import signal
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, runtime.shutdown)
+            except NotImplementedError:
+                pass
+        await runtime.wait_for_shutdown()
+        engine.stop()
+        await server.shutdown()
+    finally:
+        await runtime.close()
+
+
+def main() -> None:
+    asyncio.run(run(parse_args()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+"""The TPU engine: a JAX/Pallas continuous-batching LLM server.
+
+This is the component the reference does NOT provide (it orchestrates vLLM/
+SGLang/TRT-LLM underneath, SURVEY.md §0); a TPU-native framework must supply
+the engine itself. Design (SURVEY.md §7 stage 4):
+
+- decoder-only transformer (Llama/Qwen2 families) in pure functional JAX,
+  bfloat16, parameters sharded over a ``("dp", "tp")`` device mesh;
+- paged KV cache in HBM: [layers, pages, page_size, kv_heads, head_dim],
+  page tables per running sequence, host-side page allocator;
+- prefill: length-bucketed dense causal attention (one compiled program per
+  bucket); decode: single-token step over a fixed slot batch with paged
+  attention (custom Pallas kernel on TPU, gather-based XLA fallback on CPU);
+- continuous batching scheduler admitting prefills between decode steps,
+  emitting KV events + ForwardPassMetrics for the router.
+"""
+
+from dynamo_tpu.engine.config import ModelSpec, EngineConfig
+from dynamo_tpu.engine.engine import TPUEngine
+
+__all__ = ["EngineConfig", "ModelSpec", "TPUEngine"]

@@ -1,0 +1,157 @@
+"""Pallas TPU paged-attention decode kernel.
+
+The hot op of the decode step (the role block_copy.cu + engine attention
+kernels play on the reference's GPUs). One grid program per (sequence,
+kv-head): it walks the sequence's page table (scalar-prefetched into SMEM),
+DMAs K/V pages HBM->VMEM in double-buffered chunks of PAGES_PER_CHUNK pages,
+and accumulates flash-style online softmax for the q_per_kv grouped query
+heads. Only live pages are read — unlike the XLA gather fallback
+(model.paged_decode_attention_xla) which touches max_len for every sequence.
+
+Layout contract: k_pages/v_pages are [Nkv, P, page_size, head_dim] so one
+(head, page) slab [page_size, head_dim] is contiguous for DMA.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+PAGES_PER_CHUNK = 8  # tokens per chunk = 8 * page_size (128 for 16-tok pages)
+NEG_INF = -1e30
+
+
+class _ChunkCopy:
+    """Async copy of PAGES_PER_CHUNK K/V pages for one (head, chunk) into a
+    VMEM slot (idiom after the stock multi-page copy descriptor)."""
+
+    def __init__(self, hbm_ref, buf, sem, page_table_ref, b, h, chunk,
+                 max_pages):
+        self._copies = []
+        for j in range(PAGES_PER_CHUNK):
+            idx = jnp.minimum(chunk * PAGES_PER_CHUNK + j, max_pages - 1)
+            pid = page_table_ref[b, idx]
+            self._copies.append(pltpu.make_async_copy(
+                hbm_ref.at[h].at[pid], buf.at[j], sem))
+
+    def start(self):
+        for c in self._copies:
+            c.start()
+
+    def wait(self):
+        for c in self._copies:
+            c.wait()
+
+
+def _decode_kernel(page_table_ref, seq_lens_ref,  # scalar prefetch (SMEM)
+                   q_ref, k_hbm, v_hbm,  # q VMEM block; k/v full arrays (ANY)
+                   out_ref,  # output VMEM block
+                   k_buf, v_buf, sems,  # scratch
+                   *, page_size: int, max_pages: int):
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    seq_len = seq_lens_ref[b]
+    chunk_tokens = PAGES_PER_CHUNK * page_size
+    num_chunks = jnp.maximum(1, pl.cdiv(seq_len, chunk_tokens))
+
+    qpk = q_ref.shape[2]
+    d = q_ref.shape[3]
+    q = q_ref[0, 0].astype(jnp.float32)  # [qpk, D]
+    scale = 1.0 / (d ** 0.5)
+
+    def make_copies(c, slot):
+        kc = _ChunkCopy(k_hbm, k_buf.at[slot], sems.at[0, slot],
+                        page_table_ref, b, h, c, max_pages)
+        vc = _ChunkCopy(v_hbm, v_buf.at[slot], sems.at[1, slot],
+                        page_table_ref, b, h, c, max_pages)
+        return kc, vc
+
+    kc0, vc0 = make_copies(0, 0)
+    kc0.start()
+    vc0.start()
+
+    def body(c, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(c, 2)
+
+        @pl.when(c + 1 < num_chunks)
+        def _():
+            kc, vc = make_copies(c + 1, jax.lax.rem(c + 1, 2))
+            kc.start()
+            vc.start()
+
+        kc, vc = make_copies(c, slot)
+        kc.wait()
+        vc.wait()
+        k = k_buf[slot].astype(jnp.float32).reshape(chunk_tokens, d)
+        v = v_buf[slot].astype(jnp.float32).reshape(chunk_tokens, d)
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [qpk, chunk]
+        token_idx = (c * chunk_tokens
+                     + jax.lax.broadcasted_iota(jnp.int32,
+                                                (qpk, chunk_tokens), 1))
+        scores = jnp.where(token_idx < seq_len, scores, NEG_INF)
+        # Online softmax update.
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+        p = jnp.exp(scores - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((qpk, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((qpk, 1), jnp.float32)
+    acc0 = jnp.zeros((qpk, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, num_chunks, body, (m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-30)
+    out_ref[0, 0] = out.astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("q_per_kv",))
+def paged_decode_attention_pallas(q: jax.Array, k_pages: jax.Array,
+                                  v_pages: jax.Array, page_table: jax.Array,
+                                  seq_lens: jax.Array, q_per_kv: int
+                                  ) -> jax.Array:
+    """Drop-in replacement for model.paged_decode_attention_xla.
+
+    q [B,Nh,D]; k_pages/v_pages [Nkv,P,page,D]; page_table [B,maxP];
+    seq_lens [B]. Returns [B,Nh,D].
+    """
+    b, nh, d = q.shape
+    nkv, _, page_size, _ = k_pages.shape
+    maxp = page_table.shape[1]
+    qg = q.reshape(b, nkv, q_per_kv, d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nkv),
+        in_specs=[
+            pl.BlockSpec((1, 1, q_per_kv, d), lambda i, j, *_: (i, j, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, 1, q_per_kv, d),
+                               lambda i, j, *_: (i, j, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, PAGES_PER_CHUNK, page_size, d), k_pages.dtype),
+            pltpu.VMEM((2, PAGES_PER_CHUNK, page_size, d), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    kernel = functools.partial(_decode_kernel, page_size=page_size,
+                               max_pages=maxp)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, nkv, q_per_kv, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+    )(page_table, seq_lens, qg, k_pages, v_pages)
+    return out.reshape(b, nh, d)

@@ -1,0 +1,137 @@
+"""Model and engine configuration.
+
+ModelSpec covers the Llama family (Llama-2/3, Qwen2/2.5 via qkv_bias, TinyLlama)
+— the architectures the reference's backends serve most (BASELINE.md config
+ladder). MoE (Mixtral/DeepSeek) lands with the expert-parallel stage.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    name: str = "tiny-test"
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    intermediate_size: int = 5632
+    num_layers: int = 22
+    num_heads: int = 32
+    num_kv_heads: int = 4
+    head_dim: int | None = None  # defaults to hidden_size // num_heads
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    qkv_bias: bool = False  # Qwen2 style
+    tie_word_embeddings: bool = False
+    max_position_embeddings: int = 8192
+
+    def __post_init__(self):
+        if self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_heads
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.num_heads // self.num_kv_heads
+
+    def num_params(self) -> int:
+        """Approximate parameter count."""
+        h, i, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        d = self.head_dim
+        attn = h * (self.num_heads * d) + 2 * h * (self.num_kv_heads * d) \
+            + (self.num_heads * d) * h
+        mlp = 3 * h * i
+        per_layer = attn + mlp + 2 * h
+        embed = v * h * (1 if self.tie_word_embeddings else 2)
+        return self.num_layers * per_layer + embed + h
+
+    def kv_bytes_per_token(self, dtype_bytes: int = 2) -> int:
+        return (2 * self.num_layers * self.num_kv_heads * self.head_dim
+                * dtype_bytes)
+
+    @classmethod
+    def from_hf_config(cls, path: str) -> "ModelSpec":
+        """Build from a HF config.json (local dir or file)."""
+        if os.path.isdir(path):
+            path = os.path.join(path, "config.json")
+        with open(path) as fh:
+            cfg = json.load(fh)
+        return cls(
+            name=cfg.get("_name_or_path", os.path.basename(os.path.dirname(path))),
+            vocab_size=cfg["vocab_size"],
+            hidden_size=cfg["hidden_size"],
+            intermediate_size=cfg["intermediate_size"],
+            num_layers=cfg["num_hidden_layers"],
+            num_heads=cfg["num_attention_heads"],
+            num_kv_heads=cfg.get("num_key_value_heads",
+                                 cfg["num_attention_heads"]),
+            head_dim=cfg.get("head_dim"),
+            rope_theta=cfg.get("rope_theta", 10000.0),
+            rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
+            qkv_bias=cfg.get("model_type") == "qwen2",
+            tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+            max_position_embeddings=cfg.get("max_position_embeddings", 8192),
+        )
+
+
+# Presets (shapes from the public model cards).
+PRESETS: dict[str, ModelSpec] = {
+    "tiny-test": ModelSpec(name="tiny-test", vocab_size=512, hidden_size=128,
+                           intermediate_size=352, num_layers=2, num_heads=4,
+                           num_kv_heads=2, max_position_embeddings=2048),
+    "qwen2.5-0.5b": ModelSpec(name="qwen2.5-0.5b", vocab_size=151936,
+                              hidden_size=896, intermediate_size=4864,
+                              num_layers=24, num_heads=14, num_kv_heads=2,
+                              rope_theta=1000000.0, qkv_bias=True,
+                              tie_word_embeddings=True),
+    # Llama-3-8B per-layer shapes with 8 of 32 layers: fits one v5e chip in
+    # bf16 (~5.6 GiB) for single-chip benchmarking; full-model per-chip
+    # numbers extrapolate by layer count.
+    "llama-3-8b-L8": ModelSpec(name="llama-3-8b-L8", vocab_size=128256,
+                               hidden_size=4096, intermediate_size=14336,
+                               num_layers=8, num_heads=32, num_kv_heads=8,
+                               rope_theta=500000.0),
+    "llama-3-8b": ModelSpec(name="llama-3-8b", vocab_size=128256,
+                            hidden_size=4096, intermediate_size=14336,
+                            num_layers=32, num_heads=32, num_kv_heads=8,
+                            rope_theta=500000.0),
+    "llama-3-70b": ModelSpec(name="llama-3-70b", vocab_size=128256,
+                             hidden_size=8192, intermediate_size=28672,
+                             num_layers=80, num_heads=64, num_kv_heads=8,
+                             rope_theta=500000.0),
+}
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    model: ModelSpec = dataclasses.field(
+        default_factory=lambda: PRESETS["tiny-test"])
+    # KV paging
+    page_size: int = 16  # tokens per page (= kv_cache_block_size)
+    num_pages: int | None = None  # None => size from HBM budget
+    hbm_kv_budget_frac: float = 0.6  # fraction of free HBM for KV after params
+    max_pages_per_seq: int = 512
+    # Batching
+    max_num_seqs: int = 32
+    max_prefill_tokens: int = 8192
+    prefill_buckets: tuple = (128, 256, 512, 1024, 2048, 4096, 8192)
+    # Parallelism
+    tp: int = 1
+    dp: int = 1
+    # Numerics
+    dtype: str = "bfloat16"
+    # Attention backend: "auto" | "pallas" | "xla"
+    attention_backend: str = "auto"
+
+    def bucket_for(self, length: int) -> int:
+        for b in self.prefill_buckets:
+            if length <= b:
+                return b
+        return self.prefill_buckets[-1]
+
+    @property
+    def max_model_len(self) -> int:
+        return self.max_pages_per_seq * self.page_size

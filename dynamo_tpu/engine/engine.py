@@ -1,0 +1,378 @@
+"""TPUEngine: continuous batching over the ModelRunner.
+
+The engine thread owns all device work (JAX calls block): it admits waiting
+requests (prefill, chunked for long prompts, skipping cached prefix pages),
+then runs decode steps over the fixed slot batch, streaming sampled tokens
+back to asyncio-land. Replaces vLLM's scheduler+engine in the reference's
+worker role (SURVEY.md call stack 3.1 "GPU hot loop"); emits the same KV
+events and ForwardPassMetrics the router consumes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import queue
+import threading
+import time
+from typing import AsyncIterator
+
+import numpy as np
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.kv_cache import PageAllocator
+from dynamo_tpu.engine.runner import ModelRunner
+from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics, KvStats, WorkerStats
+from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.llm.tokens import TokenBlockSequence
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import AsyncEngine
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("tpu_engine")
+
+
+@dataclasses.dataclass
+class _Request:
+    req: PreprocessedRequest
+    ctx: Context
+    out_q: asyncio.Queue
+    loop: asyncio.AbstractEventLoop
+    blocks: TokenBlockSequence = None  # type: ignore[assignment]
+    pages: list[int] = dataclasses.field(default_factory=list)
+    generated: int = 0
+    slot: int = -1
+    enqueue_t: float = dataclasses.field(default_factory=time.monotonic)
+
+    def push(self, item) -> None:
+        self.loop.call_soon_threadsafe(self.out_q.put_nowait, item)
+
+
+class TPUEngine(AsyncEngine):
+    def __init__(self, config: EngineConfig, params=None,
+                 devices=None, kv_publisher=None, metrics_publisher=None):
+        self.config = config
+        self.runner = ModelRunner(config, params=params, devices=devices)
+        self.allocator = PageAllocator(self.runner.num_pages, config.page_size)
+        self.kv_publisher = kv_publisher
+        self.metrics_publisher = metrics_publisher
+        b = config.max_num_seqs
+        maxp = config.max_pages_per_seq
+        # Slot state (host).
+        self.slot_req: list[_Request | None] = [None] * b
+        self.tokens = np.zeros(b, np.int32)
+        self.positions = np.zeros(b, np.int32)
+        self.page_table = np.zeros((b, maxp), np.int32)
+        self.seq_lens = np.zeros(b, np.int32)
+        self.temperature = np.zeros(b, np.float32)
+        self.top_k = np.zeros(b, np.int32)
+        self.top_p = np.ones(b, np.float32)
+        self.waiting: queue.Queue[_Request] = queue.Queue()
+        self.num_waiting = 0
+        self._running = False
+        self._thread: threading.Thread | None = None
+        self._publish_loop: asyncio.AbstractEventLoop | None = None
+        self.step_count = 0
+        self.prefix_hit_blocks = 0
+        self.prefix_lookup_blocks = 0
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        try:
+            self._publish_loop = asyncio.get_running_loop()
+        except RuntimeError:
+            self._publish_loop = None
+        self._thread = threading.Thread(target=self._engine_loop,
+                                        name="tpu-engine", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    # -- AsyncEngine ----------------------------------------------------------
+    async def generate(self, request, context: Context) -> AsyncIterator[dict]:
+        self.start()
+        req = (request if isinstance(request, PreprocessedRequest)
+               else PreprocessedRequest.from_wire(request))
+        if not req.token_ids:
+            raise ValueError("empty token_ids")
+        if len(req.token_ids) >= self.config.max_model_len:
+            raise ValueError(
+                f"prompt length {len(req.token_ids)} exceeds max model len "
+                f"{self.config.max_model_len}")
+        r = _Request(req=req, ctx=context, out_q=asyncio.Queue(),
+                     loop=asyncio.get_running_loop())
+        r.blocks = TokenBlockSequence(self.config.page_size, req.token_ids)
+        self.waiting.put(r)
+        self.num_waiting += 1
+        while True:
+            item = await r.out_q.get()
+            if item is None:
+                return
+            if isinstance(item, Exception):
+                raise item
+            yield item
+            if item.get("finish_reason"):
+                return
+
+    def handler(self):
+        async def handle(request, context):
+            async for out in self.generate(request, context):
+                yield out
+
+        return handle
+
+    # -- engine thread --------------------------------------------------------
+    def _engine_loop(self) -> None:
+        log.info("engine loop starting (slots=%d pages=%d)",
+                 self.config.max_num_seqs, self.runner.num_pages)
+        while self._running:
+            admitted = self._admit()
+            active = [i for i, r in enumerate(self.slot_req) if r is not None]
+            if not active:
+                if not admitted:
+                    time.sleep(0.002)
+                continue
+            try:
+                self._decode_step(active)
+            except Exception as exc:  # noqa: BLE001 — fail all, keep serving
+                log.exception("decode step failed")
+                for i in active:
+                    r = self.slot_req[i]
+                    if r is not None:
+                        r.push(RuntimeError(f"engine step failed: {exc}"))
+                        self._free_slot(i, register=False)
+            self.step_count += 1
+            self._publish()
+
+    def _admit(self) -> bool:
+        admitted = False
+        while True:
+            free_slots = [i for i, r in enumerate(self.slot_req) if r is None]
+            if not free_slots:
+                return admitted
+            try:
+                r = self.waiting.get_nowait()
+            except queue.Empty:
+                return admitted
+            self.num_waiting -= 1
+            if r.ctx.is_killed or r.ctx.is_stopped:
+                r.push(LLMEngineOutput(
+                    token_ids=[], finish_reason=FinishReason.CANCELLED).to_wire())
+                continue
+            try:
+                ok = self._prefill_request(r, free_slots[0])
+            except Exception as exc:  # noqa: BLE001
+                log.exception("prefill failed")
+                r.push(RuntimeError(f"prefill failed: {exc}"))
+                continue
+            if not ok:
+                # No KV room: put back and stop admitting.
+                self.waiting.put(r)
+                self.num_waiting += 1
+                return admitted
+            admitted = True
+
+    def _prefill_request(self, r: _Request, slot: int) -> bool:
+        cfg = self.config
+        page = cfg.page_size
+        prompt = r.req.token_ids
+        hashes = r.blocks.block_hashes
+        # Prefix reuse: pin cached pages for the longest cached prefix, but
+        # always recompute at least the last token so we have logits.
+        cached_pages = self.allocator.acquire_cached(hashes)
+        reuse_tokens = len(cached_pages) * page
+        if reuse_tokens >= len(prompt):
+            drop = (reuse_tokens - len(prompt)) // page + 1
+            self.allocator.release(cached_pages[len(cached_pages) - drop:])
+            cached_pages = cached_pages[:len(cached_pages) - drop]
+            reuse_tokens = len(cached_pages) * page
+        self.prefix_lookup_blocks += max(1, len(hashes))
+        self.prefix_hit_blocks += len(cached_pages)
+        # Pages needed for the rest of the prompt + headroom for generation.
+        total_prompt_pages = -(-len(prompt) // page)
+        need = total_prompt_pages - len(cached_pages)
+        new_pages = self.allocator.allocate(need)
+        if new_pages is None:
+            self.allocator.release(cached_pages)
+            return False
+        pages = cached_pages + new_pages
+        r.pages = pages
+        # Chunked prefill over buckets.
+        start = reuse_tokens
+        max_chunk = min(cfg.max_prefill_tokens, cfg.prefill_buckets[-1])
+        first_token = None
+        while start < len(prompt):
+            n = min(max_chunk, len(prompt) - start)
+            # Chunks must start at page boundaries (start is one by
+            # construction); align chunk length to page size unless final.
+            chunk_tokens = np.asarray(prompt[start:start + n], np.int32)
+            first_page = start // page
+            chunk_pages = np.asarray(
+                pages[first_page:first_page + (-(-n // page))], np.int32)
+            hist = np.asarray(pages[:first_page], np.int32)
+            sampling = self._sampling_of(r)
+            token, _ = self.runner.prefill(
+                chunk_tokens, start, chunk_pages,
+                hist if len(hist) else None, sampling)
+            start += n
+            if start >= len(prompt):
+                first_token = token
+        assert first_token is not None
+        self._place_in_slot(r, slot, first_token)
+        return True
+
+    def _sampling_of(self, r: _Request) -> tuple[float, int, float]:
+        s = r.req.sampling_options
+        return (s.temperature or 0.0, s.top_k or 0, s.top_p or 1.0)
+
+    def _place_in_slot(self, r: _Request, slot: int, first_token: int) -> None:
+        prompt_len = len(r.req.token_ids)
+        # The prompt's complete blocks are now resident: register them for
+        # prefix reuse + router events.
+        for idx, h in enumerate(r.blocks.block_hashes):
+            self.allocator.register(r.pages[idx], h)
+        r.generated = 1  # the prefill sampled the first token
+        finish = self._check_finish(r, first_token)
+        self._emit_token(r, first_token, finish)
+        if finish is not None:
+            self.allocator.release(r.pages)
+            r.pages = []
+            return
+        r.slot = slot
+        self.slot_req[slot] = r
+        self.tokens[slot] = first_token
+        self.positions[slot] = prompt_len  # where the new token will be written
+        self.page_table[slot, :len(r.pages)] = r.pages
+        self.seq_lens[slot] = prompt_len + 1
+        temp, tk, tp = self._sampling_of(r)
+        self.temperature[slot] = temp
+        self.top_k[slot] = tk
+        self.top_p[slot] = tp
+
+    def _decode_step(self, active: list[int]) -> None:
+        cfg = self.config
+        page = cfg.page_size
+        # Ensure every active slot has a page for the position being written.
+        for i in active:
+            r = self.slot_req[i]
+            needed_pages = self.positions[i] // page + 1
+            if needed_pages > self.config.max_pages_per_seq:
+                r.push(LLMEngineOutput(
+                    token_ids=[], finish_reason=FinishReason.LENGTH).to_wire())
+                self._free_slot(i, register=True)
+                continue
+            while len(r.pages) < needed_pages:
+                new = self.allocator.allocate(1)
+                if new is None:
+                    # Out of KV: fail this request (preemption lands with the
+                    # KVBM offload tier).
+                    r.push(RuntimeError("KV pool exhausted"))
+                    self._free_slot(i, register=False)
+                    break
+                r.pages.extend(new)
+                self.page_table[i, len(r.pages) - 1] = new[0]
+            if self.slot_req[i] is None:
+                active = [j for j in active if j != i]
+        if not active:
+            return
+        sampled = self.runner.decode(
+            self.tokens, self.positions, self.page_table, self.seq_lens,
+            self.temperature, self.top_k, self.top_p)
+        for i in active:
+            r = self.slot_req[i]
+            if r is None:
+                continue
+            token = int(sampled[i])
+            if r.ctx.is_killed:
+                r.push(None)
+                self._free_slot(i, register=True)
+                continue
+            if r.ctx.is_stopped:
+                r.push(LLMEngineOutput(
+                    token_ids=[], finish_reason=FinishReason.CANCELLED).to_wire())
+                self._free_slot(i, register=True)
+                continue
+            r.generated += 1
+            new_block = r.blocks.append(self.tokens[i])
+            if new_block is not None:
+                # Register the just-completed page under its chained hash.
+                page_idx = (len(r.blocks.tokens) // page) - 1
+                self.allocator.register(r.pages[page_idx], new_block)
+            finish = self._check_finish(r, token)
+            self._emit_token(r, token, finish)
+            if finish is not None:
+                self._free_slot(i, register=True)
+            else:
+                self.tokens[i] = token
+                self.positions[i] += 1
+                self.seq_lens[i] += 1
+
+    def _check_finish(self, r: _Request, token: int) -> FinishReason | None:
+        sc = r.req.stop_conditions
+        if r.generated >= (sc.max_tokens or 2**30):
+            return FinishReason.LENGTH
+        if sc.min_tokens and r.generated < sc.min_tokens:
+            return None
+        if not sc.ignore_eos and token in (r.req.eos_token_ids or []):
+            return FinishReason.EOS
+        if token in (sc.stop_token_ids or []):
+            return FinishReason.STOP
+        return None
+
+    def _emit_token(self, r: _Request, token: int,
+                    finish: FinishReason | None = None) -> None:
+        r.push(LLMEngineOutput(token_ids=[token],
+                               finish_reason=finish).to_wire())
+
+    def _free_slot(self, slot: int, register: bool) -> None:
+        r = self.slot_req[slot]
+        self.slot_req[slot] = None
+        if r is None:
+            return
+        self.allocator.release(r.pages)
+        r.pages = []
+
+    # -- metrics + events -----------------------------------------------------
+    def _publish(self) -> None:
+        loop = self._publish_loop
+        if loop is None or loop.is_closed():
+            self.allocator.drain_events()
+            return
+        stored, removed = self.allocator.drain_events()
+        active = sum(1 for r in self.slot_req if r is not None)
+        hit = (self.prefix_hit_blocks / self.prefix_lookup_blocks
+               if self.prefix_lookup_blocks else 0.0)
+        metrics = ForwardPassMetrics(
+            worker_stats=WorkerStats(
+                request_active_slots=active,
+                request_total_slots=self.config.max_num_seqs,
+                num_requests_waiting=self.num_waiting),
+            kv_stats=KvStats(
+                kv_active_blocks=self.allocator.num_active,
+                kv_total_blocks=self.allocator.num_pages,
+                gpu_cache_usage_perc=(self.allocator.num_active
+                                      / self.allocator.num_pages),
+                gpu_prefix_cache_hit_rate=hit))
+
+        async def do_publish():
+            try:
+                if self.kv_publisher is not None:
+                    if stored:
+                        await self.kv_publisher.stored(stored)
+                    if removed:
+                        await self.kv_publisher.removed(removed)
+                if self.metrics_publisher is not None:
+                    force = active == 0 and self.num_waiting == 0
+                    await self.metrics_publisher.publish(metrics, force=force)
+            except Exception:  # noqa: BLE001
+                log.exception("publish failed")
+
+        if (self.kv_publisher is not None or self.metrics_publisher is not None):
+            asyncio.run_coroutine_threadsafe(do_publish(), loop)

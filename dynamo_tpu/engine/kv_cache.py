@@ -1,0 +1,126 @@
+"""Paged KV cache: device arrays + host-side page allocator with prefix reuse.
+
+The device side is two arrays per model: k/v pages
+[layers, num_pages, page_size, kv_heads, head_dim] sharded over "tp" on the
+kv_heads axis. The host side is the page allocator — the in-HBM (G1) tier of
+the reference's KVBM block lifecycle (lib/llm/src/block_manager: active pool /
+inactive reusable pool / LRU eviction): pages of finished sequences stay
+registered under their chained block hash and are reused on prefix hits until
+evicted. Emits stored/removed block hashes for the router's index.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("kv_cache")
+
+
+class PageAllocator:
+    # Page 0 is RESERVED as the scratch page: inactive decode slots have
+    # all-zero page tables, so their dummy K/V scatters land there instead of
+    # clobbering live data. Never allocated.
+    SCRATCH_PAGE = 0
+
+    def __init__(self, num_pages: int, page_size: int):
+        self.num_pages = num_pages - 1  # page 0 reserved
+        self.page_size = page_size
+        self.free: list[int] = list(range(num_pages - 1, 0, -1))
+        # Reusable (inactive but cached) pages: block_hash -> page id, LRU.
+        self.cached: OrderedDict[int, int] = OrderedDict()
+        self.cached_by_page: dict[int, int] = {}
+        # Active references: page id -> refcount.
+        self.refs: dict[int, int] = {}
+        # Router event buffers.
+        self.stored_events: list[int] = []
+        self.removed_events: list[int] = []
+
+    # -- queries --------------------------------------------------------------
+    @property
+    def num_free(self) -> int:
+        return len(self.free) + len(self.cached)
+
+    @property
+    def num_active(self) -> int:
+        return len(self.refs)
+
+    def lookup(self, block_hashes: list[int]) -> list[int]:
+        """Page ids for the longest cached prefix of ``block_hashes``."""
+        pages = []
+        for h in block_hashes:
+            page = self.cached.get(h)
+            if page is None:
+                break
+            pages.append(page)
+        return pages
+
+    # -- allocation -----------------------------------------------------------
+    def allocate(self, count: int) -> list[int] | None:
+        """Allocate ``count`` fresh pages (evicting LRU cached pages as
+        needed). None if impossible."""
+        if self.num_free < count:
+            return None
+        out = []
+        for _ in range(count):
+            if self.free:
+                page = self.free.pop()
+            else:
+                # Evict least-recently-used cached page.
+                h, page = self.cached.popitem(last=False)
+                del self.cached_by_page[page]
+                self.removed_events.append(h)
+            self.refs[page] = self.refs.get(page, 0) + 1
+            out.append(page)
+        return out
+
+    def acquire_cached(self, block_hashes: list[int]) -> list[int]:
+        """Pin the cached prefix pages for reuse; returns their page ids."""
+        pages = []
+        for h in block_hashes:
+            page = self.cached.get(h)
+            if page is None:
+                break
+            # Move from inactive to active (stays in cached map for other
+            # sequences to share — refcount tracks active users).
+            self.cached.move_to_end(h)
+            self.refs[page] = self.refs.get(page, 0) + 1
+            pages.append(page)
+        return pages
+
+    def register(self, page: int, block_hash: int) -> None:
+        """A page now holds a COMPLETE block: make it reusable by hash
+        (reference block lifecycle Complete->Registered, block_manager
+        block.rs)."""
+        existing = self.cached_by_page.get(page)
+        if existing == block_hash:
+            return
+        if existing is not None:
+            self.cached.pop(existing, None)
+            self.removed_events.append(existing)
+        if block_hash in self.cached:
+            # Another page already holds this block; keep the older one.
+            return
+        self.cached[block_hash] = page
+        self.cached_by_page[page] = block_hash
+        self.stored_events.append(block_hash)
+
+    def release(self, pages: list[int]) -> None:
+        """Drop one active reference; unreferenced unregistered pages return
+        to the free list, registered ones stay cached for reuse."""
+        for page in pages:
+            ref = self.refs.get(page)
+            if ref is None:
+                continue
+            if ref > 1:
+                self.refs[page] = ref - 1
+                continue
+            del self.refs[page]
+            if page not in self.cached_by_page:
+                self.free.append(page)
+
+    def drain_events(self) -> tuple[list[int], list[int]]:
+        stored, self.stored_events = self.stored_events, []
+        removed, self.removed_events = self.removed_events, []
+        return stored, removed

@@ -1,0 +1,330 @@
+"""Functional JAX transformer (Llama/Qwen2 family) with a paged KV cache.
+
+Pure-functional, scan-over-layers (O(1) compile time in depth), bfloat16 on
+the MXU with fp32 softmax/norm accumulations. Parameters and the KV cache are
+sharded over a ("dp", "tp") mesh with XLA inserting the collectives
+(all-reduce after attention-out and MLP-down projections) — the tpu-idiomatic
+replacement for the reference engines' NCCL tensor parallelism (SURVEY.md
+§2.7). RoPE uses HF's rotate-half convention so HF safetensors load directly.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dynamo_tpu.engine.config import ModelSpec
+
+Params = dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Parameter init + sharding specs
+# ---------------------------------------------------------------------------
+
+def param_shapes(spec: ModelSpec) -> dict:
+    h, d = spec.hidden_size, spec.head_dim
+    nh, nkv, L = spec.num_heads, spec.num_kv_heads, spec.num_layers
+    i = spec.intermediate_size
+    shapes = {
+        "embed": (spec.vocab_size, h),
+        "final_norm": (h,),
+        "layers": {
+            "input_norm": (L, h),
+            "post_attn_norm": (L, h),
+            "wq": (L, h, nh * d),
+            "wk": (L, h, nkv * d),
+            "wv": (L, h, nkv * d),
+            "wo": (L, nh * d, h),
+            "w_gate": (L, h, i),
+            "w_up": (L, h, i),
+            "w_down": (L, i, h),
+        },
+    }
+    if spec.qkv_bias:
+        shapes["layers"]["bq"] = (L, nh * d)
+        shapes["layers"]["bk"] = (L, nkv * d)
+        shapes["layers"]["bv"] = (L, nkv * d)
+    if not spec.tie_word_embeddings:
+        shapes["lm_head"] = (h, spec.vocab_size)
+    return shapes
+
+
+def param_specs(spec: ModelSpec) -> dict:
+    """PartitionSpecs: column-parallel qkv/gate/up, row-parallel o/down
+    (Megatron layout — XLA adds the psum at row-parallel outputs)."""
+    specs = {
+        "embed": P(None, "tp"),
+        "final_norm": P(None),
+        "layers": {
+            "input_norm": P(None, None),
+            "post_attn_norm": P(None, None),
+            "wq": P(None, None, "tp"),
+            "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "w_gate": P(None, None, "tp"),
+            "w_up": P(None, None, "tp"),
+            "w_down": P(None, "tp", None),
+        },
+    }
+    if spec.qkv_bias:
+        specs["layers"]["bq"] = P(None, "tp")
+        specs["layers"]["bk"] = P(None, "tp")
+        specs["layers"]["bv"] = P(None, "tp")
+    if not spec.tie_word_embeddings:
+        specs["lm_head"] = P(None, "tp")
+    return specs
+
+
+def init_params(spec: ModelSpec, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    """Random init (bench/smoke). Real weights come from the safetensors
+    loader (dynamo_tpu.engine.weights)."""
+    shapes = param_shapes(spec)
+    leaves, treedef = jax.tree.flatten(shapes, is_leaf=lambda x: isinstance(x, tuple))
+    keys = jax.random.split(key, len(leaves))
+
+    def init_one(shape, k):
+        if len(shape) == 1 or shape[-1] == 1:
+            return jnp.ones(shape, dtype)  # norm scales
+        fan_in = shape[-2] if len(shape) > 1 else shape[-1]
+        return (jax.random.normal(k, shape, dtype)
+                * (1.0 / jnp.sqrt(fan_in)).astype(dtype))
+
+    inited = [init_one(s, k) for s, k in zip(leaves, keys)]
+    params = jax.tree.unflatten(treedef, inited)
+    # Norm scales must be ones.
+    params["final_norm"] = jnp.ones(shapes["final_norm"], dtype)
+    params["layers"]["input_norm"] = jnp.ones(
+        shapes["layers"]["input_norm"], dtype)
+    params["layers"]["post_attn_norm"] = jnp.ones(
+        shapes["layers"]["post_attn_norm"], dtype)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale
+
+
+def rope_tables(positions: jax.Array, head_dim: int, theta: float
+                ) -> tuple[jax.Array, jax.Array]:
+    """cos/sin tables for HF rotate-half RoPE; positions [...]."""
+    half = head_dim // 2
+    freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # [..., half]
+    cos = jnp.cos(angles)
+    sin = jnp.sin(angles)
+    return cos, sin
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x [..., heads, head_dim]; cos/sin [..., half] (broadcast over heads)."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    cos = cos[..., None, :]
+    sin = sin[..., None, :]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    out1 = xf1 * cos - xf2 * sin
+    out2 = xf2 * cos + xf1 * sin
+    return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)
+
+
+def dense_causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                           q_positions: jax.Array, kv_len_mask: jax.Array,
+                           q_per_kv: int) -> jax.Array:
+    """Prefill attention over freshly-computed K/V.
+
+    q [B,S,Nh,D], k/v [B,S,Nkv,D], q_positions [B,S] (absolute), kv_len_mask
+    [B,S] bool (valid kv slots). Causal by position. fp32 accumulation.
+    GQA handled by grouping q heads (no materialized repeat).
+    """
+    b, s, nh, d = q.shape
+    nkv = k.shape[2]
+    qg = q.reshape(b, s, nkv, q_per_kv, d)
+    scores = jnp.einsum("bqngd,bknd->bngqk", qg, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(d))
+    causal = (q_positions[:, None, None, :, None]
+              >= q_positions[:, None, None, None, :])
+    valid = kv_len_mask[:, None, None, None, :]
+    scores = jnp.where(causal & valid, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bngqk,bknd->bqngd", probs, v)
+    return out.reshape(b, s, nh, d)
+
+
+def paged_decode_attention_xla(q: jax.Array, k_pages: jax.Array,
+                               v_pages: jax.Array, page_table: jax.Array,
+                               seq_lens: jax.Array, q_per_kv: int
+                               ) -> jax.Array:
+    """Reference/fallback decode attention (gather-based; CPU tests + any
+    platform). q [B,Nh,D]; k_pages/v_pages [Nkv,P,page,D]; page_table
+    [B,maxP]; seq_lens [B]. The Pallas kernel (attention.py) replaces this on
+    TPU — it reads only live pages from HBM instead of gathering max_len."""
+    b, nh, d = q.shape
+    nkv, _, page, _ = k_pages.shape
+    maxp = page_table.shape[1]
+    k_all = k_pages[:, page_table]  # [Nkv,B,maxP,page,D]
+    v_all = v_pages[:, page_table]
+    k_all = k_all.reshape(nkv, b, maxp * page, d)
+    v_all = v_all.reshape(nkv, b, maxp * page, d)
+    qg = q.reshape(b, nkv, q_per_kv, d)
+    scores = jnp.einsum("bngd,nbld->bngl", qg, k_all,
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(d))
+    positions = jnp.arange(maxp * page)[None, :]
+    mask = (positions < seq_lens[:, None])[:, None, None, :]
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bngl,nbld->bngd", probs, v_all)
+    return out.reshape(b, nh, d)
+
+
+# ---------------------------------------------------------------------------
+# Forward passes
+# ---------------------------------------------------------------------------
+
+def _split_heads(x, n, d):
+    return x.reshape(*x.shape[:-1], n, d)
+
+
+def prefill_forward(params: Params, spec: ModelSpec,
+                    k_cache: jax.Array, v_cache: jax.Array,
+                    tokens: jax.Array, positions: jax.Array,
+                    page_table: jax.Array, seq_lens: jax.Array,
+                    ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Process prompt chunks and write K/V into pages.
+
+    tokens/positions [B,S] (S = bucket, multiple of page_size), page_table
+    [B, S//page_size] (pages covering THIS chunk), seq_lens [B] (valid token
+    counts). Returns (last_token_logits [B,V], k_cache, v_cache).
+    """
+    b, s = tokens.shape
+    d = spec.head_dim
+    page = k_cache.shape[3]
+    x = params["embed"][tokens].astype(jnp.bfloat16)  # [B,S,H]
+    cos, sin = rope_tables(positions, d, spec.rope_theta)
+    valid = jnp.arange(s)[None, :] < seq_lens[:, None]
+
+    def layer_fn(x, scan_in):
+        lp, k_pages_l, v_pages_l = scan_in
+        h = rms_norm(x, lp["input_norm"], spec.rms_norm_eps)
+        q = jnp.einsum("bsh,hd->bsd", h, lp["wq"],
+                       preferred_element_type=jnp.bfloat16)
+        k = jnp.einsum("bsh,hd->bsd", h, lp["wk"],
+                       preferred_element_type=jnp.bfloat16)
+        v = jnp.einsum("bsh,hd->bsd", h, lp["wv"],
+                       preferred_element_type=jnp.bfloat16)
+        if spec.qkv_bias:
+            q = q + lp["bq"]
+            k = k + lp["bk"]
+            v = v + lp["bv"]
+        q = _split_heads(q, spec.num_heads, d)
+        k = _split_heads(k, spec.num_kv_heads, d)
+        v = _split_heads(v, spec.num_kv_heads, d)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        # Write K/V into this chunk's pages: cache is [Nkv, P, page, D].
+        k_blocks = (k.reshape(b * (s // page), page, spec.num_kv_heads, d)
+                    .transpose(2, 0, 1, 3))
+        v_blocks = (v.reshape(b * (s // page), page, spec.num_kv_heads, d)
+                    .transpose(2, 0, 1, 3))
+        flat_pages = page_table.reshape(-1)
+        k_pages_l = k_pages_l.at[:, flat_pages].set(k_blocks)
+        v_pages_l = v_pages_l.at[:, flat_pages].set(v_blocks)
+        attn = dense_causal_attention(q, k, v, positions, valid, spec.q_per_kv)
+        attn = attn.reshape(b, s, -1)
+        x = x + jnp.einsum("bsd,dh->bsh", attn, lp["wo"],
+                           preferred_element_type=jnp.bfloat16)
+        h2 = rms_norm(x, lp["post_attn_norm"], spec.rms_norm_eps)
+        gate = jnp.einsum("bsh,hi->bsi", h2, lp["w_gate"],
+                          preferred_element_type=jnp.bfloat16)
+        up = jnp.einsum("bsh,hi->bsi", h2, lp["w_up"],
+                        preferred_element_type=jnp.bfloat16)
+        ff = jax.nn.silu(gate.astype(jnp.float32)).astype(jnp.bfloat16) * up
+        x = x + jnp.einsum("bsi,ih->bsh", ff, lp["w_down"],
+                           preferred_element_type=jnp.bfloat16)
+        return x, (k_pages_l, v_pages_l)
+
+    x, (k_cache, v_cache) = jax.lax.scan(
+        layer_fn, x, (params["layers"], k_cache, v_cache))
+    x = rms_norm(x, params["final_norm"], spec.rms_norm_eps)
+    # Last valid token per sequence.
+    last_idx = jnp.maximum(seq_lens - 1, 0)
+    x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]
+    head = (params["embed"].T if spec.tie_word_embeddings
+            else params["lm_head"])
+    logits = jnp.einsum("bh,hv->bv", x_last, head,
+                        preferred_element_type=jnp.float32)
+    return logits, k_cache, v_cache
+
+
+def decode_forward(params: Params, spec: ModelSpec,
+                   k_cache: jax.Array, v_cache: jax.Array,
+                   tokens: jax.Array, positions: jax.Array,
+                   page_table: jax.Array, seq_lens: jax.Array,
+                   attention_impl=None,
+                   ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One decode step for the whole slot batch.
+
+    tokens [B], positions [B] (absolute position of the new token), page_table
+    [B, maxP], seq_lens [B] (lengths INCLUDING the new token). Returns
+    (logits [B,V], k_cache, v_cache).
+    """
+    b = tokens.shape[0]
+    d = spec.head_dim
+    page = k_cache.shape[3]
+    x = params["embed"][tokens].astype(jnp.bfloat16)  # [B,H]
+    cos, sin = rope_tables(positions, d, spec.rope_theta)
+    # Target page slot for the new token.
+    page_idx = positions // page
+    page_off = positions % page
+    dest_page = jnp.take_along_axis(page_table, page_idx[:, None], axis=1)[:, 0]
+    attn_fn = attention_impl or paged_decode_attention_xla
+
+    def layer_fn(x, scan_in):
+        lp, k_pages_l, v_pages_l = scan_in
+        h = rms_norm(x, lp["input_norm"], spec.rms_norm_eps)
+        q = h @ lp["wq"]
+        k = h @ lp["wk"]
+        v = h @ lp["wv"]
+        if spec.qkv_bias:
+            q = q + lp["bq"]
+            k = k + lp["bk"]
+            v = v + lp["bv"]
+        q = _split_heads(q, spec.num_heads, d)       # [B,Nh,D]
+        k = _split_heads(k, spec.num_kv_heads, d)    # [B,Nkv,D]
+        v = _split_heads(v, spec.num_kv_heads, d)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        # Scatter the new K/V token into its page (cache [Nkv,P,page,D]).
+        k_pages_l = k_pages_l.at[:, dest_page, page_off].set(k.transpose(1, 0, 2))
+        v_pages_l = v_pages_l.at[:, dest_page, page_off].set(v.transpose(1, 0, 2))
+        attn = attn_fn(q, k_pages_l, v_pages_l, page_table, seq_lens,
+                       spec.q_per_kv)  # [B,Nh,D]
+        attn = attn.reshape(b, -1)
+        x = x + attn @ lp["wo"]
+        h2 = rms_norm(x, lp["post_attn_norm"], spec.rms_norm_eps)
+        ff = (jax.nn.silu((h2 @ lp["w_gate"]).astype(jnp.float32))
+              .astype(jnp.bfloat16) * (h2 @ lp["w_up"]))
+        x = x + ff @ lp["w_down"]
+        return x, (k_pages_l, v_pages_l)
+
+    x, (k_cache, v_cache) = jax.lax.scan(
+        layer_fn, x, (params["layers"], k_cache, v_cache))
+    x = rms_norm(x, params["final_norm"], spec.rms_norm_eps)
+    head = (params["embed"].T if spec.tie_word_embeddings
+            else params["lm_head"])
+    logits = jnp.einsum("bh,hv->bv", x, head,
+                        preferred_element_type=jnp.float32)
+    return logits, k_cache, v_cache

@@ -1,0 +1,316 @@
+"""ModelRunner: compiled, sharded prefill/decode steps over a device mesh.
+
+Owns the mesh (("dp","tp"), reference §2.7 TP delegated-to-engine -> here
+native via jax.sharding), the sharded parameters, the paged KV device arrays,
+and the jit-compiled step functions:
+
+- ``prefill(chunk)``: length-bucketed (one compiled program per bucket);
+  supports history pages so long prompts prefill in chunks (chunked prefill,
+  SURVEY.md §5.7 parity) and cached prefixes are skipped, attending to prior
+  pages via the same paged read path as decode;
+- ``decode_step``: one token for the whole slot batch + batched sampling.
+
+KV arrays are donated through every call so XLA updates them in place.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.model import (
+    dense_causal_attention,
+    init_params,
+    paged_decode_attention_xla,
+    param_specs,
+    prefill_forward,
+    decode_forward,
+)
+from dynamo_tpu.engine.sampler import sample_tokens
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("runner")
+
+
+class ModelRunner:
+    def __init__(self, config: EngineConfig, params=None,
+                 devices: list | None = None, seed: int = 0):
+        self.config = config
+        spec = config.model
+        self.spec = spec
+        devices = devices if devices is not None else jax.devices()
+        total = config.dp * config.tp
+        if len(devices) < total:
+            raise ValueError(f"need {total} devices, have {len(devices)}")
+        dev_array = np.array(devices[:total]).reshape(config.dp, config.tp)
+        self.mesh = Mesh(dev_array, ("dp", "tp"))
+        self._sized_pages(devices[0])
+
+        # Shard or init parameters.
+        pspecs = param_specs(spec)
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), pspecs,
+            is_leaf=lambda x: isinstance(x, P))
+        if params is None:
+            key = jax.random.key(seed)
+            with jax.default_device(jax.devices("cpu")[0]):
+                params = init_params(spec, key)
+        self.params = jax.device_put(params, shardings)
+
+        # KV cache arrays [L, Nkv, P, page, D]: kv heads sharded over tp, and
+        # [page, D] contiguous per (head, page) for clean Pallas DMAs.
+        kv_spec = P(None, "tp", None, None, None)
+        self.kv_sharding = NamedSharding(self.mesh, kv_spec)
+        kv_shape = (spec.num_layers, spec.num_kv_heads, self.num_pages,
+                    config.page_size, spec.head_dim)
+        self.k_cache = jax.device_put(
+            jnp.zeros(kv_shape, jnp.bfloat16), self.kv_sharding)
+        self.v_cache = jax.device_put(
+            jnp.zeros(kv_shape, jnp.bfloat16), self.kv_sharding)
+
+        self._prefill_cache: dict = {}
+        self._decode_fn = None
+        self._rng = jax.random.key(seed + 1)
+        self._attention_impl = self._pick_attention()
+
+    # -- setup ---------------------------------------------------------------
+    def _sized_pages(self, device) -> None:
+        cfg = self.config
+        if cfg.num_pages is not None:
+            self.num_pages = cfg.num_pages
+            return
+        # Size the KV pool from free HBM after params (reference: engines'
+        # gpu_memory_utilization; here hbm_kv_budget_frac).
+        try:
+            stats = device.memory_stats()
+            free = stats["bytes_limit"] - stats["bytes_in_use"]
+        except Exception:  # noqa: BLE001 — CPU tests have no memory_stats
+            free = 2 << 30
+        param_bytes = self.spec.num_params() * 2 // max(1, cfg.tp * cfg.dp)
+        budget = max(64 << 20, int((free - param_bytes) * cfg.hbm_kv_budget_frac))
+        page_bytes = (self.spec.kv_bytes_per_token() * cfg.page_size
+                      // max(1, cfg.tp))
+        self.num_pages = max(16, budget // max(1, page_bytes))
+        log.info("KV pool: %d pages of %d tokens (%.1f GiB)", self.num_pages,
+                 cfg.page_size, self.num_pages * page_bytes / (1 << 30))
+
+    def _pick_attention(self):
+        backend = self.config.attention_backend
+        if backend == "auto":
+            backend = ("pallas" if jax.devices()[0].platform == "tpu"
+                       else "xla")
+        if backend == "pallas":
+            if self.spec.head_dim % 128 != 0:
+                # Mosaic DMA slices need the trailing dim 128-aligned; D=64
+                # models (qwen2.5-0.5b etc.) use the XLA path.
+                log.info("head_dim %d not 128-aligned; pallas kernel disabled",
+                         self.spec.head_dim)
+                return paged_decode_attention_xla
+            try:
+                from dynamo_tpu.engine.attention import paged_decode_attention_pallas
+                return paged_decode_attention_pallas
+            except Exception:  # noqa: BLE001
+                log.exception("pallas attention unavailable; using xla")
+        return paged_decode_attention_xla
+
+    # -- compiled steps -------------------------------------------------------
+    def _get_prefill(self, bucket: int, with_history: bool):
+        key = (bucket, with_history)
+        fn = self._prefill_cache.get(key)
+        if fn is not None:
+            return fn
+        spec = self.spec
+        cfg = self.config
+
+        def step(params, k_cache, v_cache, tokens, positions, page_table,
+                 seq_lens, hist_table, hist_lens):
+            if with_history:
+                logits, k_cache, v_cache = _prefill_with_history(
+                    params, spec, k_cache, v_cache, tokens, positions,
+                    page_table, seq_lens, hist_table, hist_lens,
+                    self._attention_impl)
+            else:
+                logits, k_cache, v_cache = prefill_forward(
+                    params, spec, k_cache, v_cache, tokens, positions,
+                    page_table, seq_lens)
+            return logits, k_cache, v_cache
+
+        fn = jax.jit(step, donate_argnums=(1, 2))
+        self._prefill_cache[key] = fn
+        return fn
+
+    def _get_decode(self):
+        if self._decode_fn is not None:
+            return self._decode_fn
+        spec = self.spec
+
+        def step(params, k_cache, v_cache, tokens, positions, page_table,
+                 seq_lens, temperature, top_k, top_p, rng):
+            logits, k_cache, v_cache = decode_forward(
+                params, spec, k_cache, v_cache, tokens, positions,
+                page_table, seq_lens, attention_impl=self._attention_impl)
+            rng, sub = jax.random.split(rng)
+            sampled = sample_tokens(logits, temperature, top_k, top_p, sub)
+            return sampled, k_cache, v_cache, rng
+
+        self._decode_fn = jax.jit(step, donate_argnums=(1, 2))
+        return self._decode_fn
+
+    # -- public API (blocking; called from the engine thread) -----------------
+    def prefill(self, tokens: np.ndarray, start_pos: int,
+                chunk_pages: np.ndarray, hist_pages: np.ndarray | None,
+                sampling: tuple[float, int, float]) -> tuple[int, jax.Array]:
+        """Prefill one chunk of one sequence; returns (sampled_token, logits).
+
+        tokens: [n] the chunk's tokens; start_pos: absolute position of
+        tokens[0]; chunk_pages: pages covering the chunk; hist_pages: pages of
+        the context before the chunk (None = fresh prompt).
+        """
+        cfg = self.config
+        n = len(tokens)
+        bucket = cfg.bucket_for(n)
+        page = cfg.page_size
+        bucket_pages = bucket // page
+        tok = np.zeros((1, bucket), np.int32)
+        tok[0, :n] = tokens
+        pos = np.zeros((1, bucket), np.int32)
+        pos[0, :n] = np.arange(start_pos, start_pos + n)
+        pos[0, n:] = start_pos + n - 1  # harmless pad positions
+        ptab = np.zeros((1, bucket_pages), np.int32)
+        ptab[0, :len(chunk_pages)] = chunk_pages
+        if len(chunk_pages) < bucket_pages:
+            # Pad with a scratch page (page 0 may be live; use last chunk page
+            # so padded writes land on an already-owned page... safe because
+            # padded lanes rewrite offsets beyond seq_len that are never read).
+            pad_page = chunk_pages[-1] if len(chunk_pages) else 0
+            ptab[0, len(chunk_pages):] = pad_page
+        lens = np.array([n], np.int32)
+        with_history = hist_pages is not None and len(hist_pages) > 0
+        maxp = cfg.max_pages_per_seq
+        htab = np.zeros((1, maxp), np.int32)
+        hlens = np.zeros((1,), np.int32)
+        if with_history:
+            htab[0, :len(hist_pages)] = hist_pages
+            hlens[0] = start_pos
+        fn = self._get_prefill(bucket, with_history)
+        with self.mesh:
+            logits, self.k_cache, self.v_cache = fn(
+                self.params, self.k_cache, self.v_cache, tok, pos, ptab,
+                lens, htab, hlens)
+            temp, tk, tp = sampling
+            self._rng, sub = jax.random.split(self._rng)
+            sampled = sample_tokens(
+                logits, jnp.array([temp], jnp.float32),
+                jnp.array([tk], jnp.int32), jnp.array([tp], jnp.float32), sub)
+        return int(jax.device_get(sampled)[0]), logits
+
+    def decode(self, tokens: np.ndarray, positions: np.ndarray,
+               page_table: np.ndarray, seq_lens: np.ndarray,
+               temperature: np.ndarray, top_k: np.ndarray,
+               top_p: np.ndarray) -> np.ndarray:
+        """One decode step over the slot batch; returns sampled tokens [B]."""
+        fn = self._get_decode()
+        with self.mesh:
+            sampled, self.k_cache, self.v_cache, self._rng = fn(
+                self.params, self.k_cache, self.v_cache,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(page_table), jnp.asarray(seq_lens),
+                jnp.asarray(temperature), jnp.asarray(top_k),
+                jnp.asarray(top_p), self._rng)
+        return np.asarray(jax.device_get(sampled))
+
+
+def _prefill_with_history(params, spec, k_cache, v_cache, tokens, positions,
+                          page_table, seq_lens, hist_table, hist_lens,
+                          attention_impl):
+    """Chunked prefill: like prefill_forward but queries also attend to the
+    sequence's earlier pages (read via the paged path)."""
+    import jax
+    import jax.numpy as jnp
+    from dynamo_tpu.engine.model import (
+        _split_heads, apply_rope, rms_norm, rope_tables)
+
+    b, s = tokens.shape
+    d = spec.head_dim
+    nkv = spec.num_kv_heads
+    page = k_cache.shape[3]
+    x = params["embed"][tokens].astype(jnp.bfloat16)
+    cos, sin = rope_tables(positions, d, spec.rope_theta)
+    valid = jnp.arange(s)[None, :] < seq_lens[:, None]
+    maxp = hist_table.shape[1]
+
+    def layer_fn(x, scan_in):
+        lp, k_pages_l, v_pages_l = scan_in
+        h = rms_norm(x, lp["input_norm"], spec.rms_norm_eps)
+        q = jnp.einsum("bsh,hd->bsd", h, lp["wq"],
+                       preferred_element_type=jnp.bfloat16)
+        k = jnp.einsum("bsh,hd->bsd", h, lp["wk"],
+                       preferred_element_type=jnp.bfloat16)
+        v = jnp.einsum("bsh,hd->bsd", h, lp["wv"],
+                       preferred_element_type=jnp.bfloat16)
+        if spec.qkv_bias:
+            q = q + lp["bq"]
+            k = k + lp["bk"]
+            v = v + lp["bv"]
+        q = _split_heads(q, spec.num_heads, d)
+        k = _split_heads(k, nkv, d)
+        v = _split_heads(v, nkv, d)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        k_blocks = (k.reshape(b * (s // page), page, nkv, d)
+                    .transpose(2, 0, 1, 3))
+        v_blocks = (v.reshape(b * (s // page), page, nkv, d)
+                    .transpose(2, 0, 1, 3))
+        flat = page_table.reshape(-1)
+        k_pages_l = k_pages_l.at[:, flat].set(k_blocks)
+        v_pages_l = v_pages_l.at[:, flat].set(v_blocks)
+        # In-chunk causal scores (grouped GQA, no repeat).
+        qg = q.reshape(b, s, nkv, spec.q_per_kv, d)
+        chunk_scores = jnp.einsum("bqngd,bknd->bngqk", qg, k,
+                                  preferred_element_type=jnp.float32)
+        causal = (positions[:, None, None, :, None]
+                  >= positions[:, None, None, None, :])
+        chunk_scores = jnp.where(causal & valid[:, None, None, None, :],
+                                 chunk_scores, -1e30)
+        # History scores over prior pages ([Nkv,P,page,D] cache).
+        k_hist = k_pages_l[:, hist_table].reshape(nkv, b, maxp * page, d)
+        v_hist = v_pages_l[:, hist_table].reshape(nkv, b, maxp * page, d)
+        hist_scores = jnp.einsum("bqngd,nbld->bngql", qg, k_hist,
+                                 preferred_element_type=jnp.float32)
+        hist_valid = (jnp.arange(maxp * page)[None, :]
+                      < hist_lens[:, None])[:, None, None, None, :]
+        hist_scores = jnp.where(hist_valid, hist_scores, -1e30)
+        scores = jnp.concatenate([hist_scores, chunk_scores], axis=-1)
+        scores = scores / jnp.sqrt(jnp.float32(d))
+        probs = jax.nn.softmax(scores, axis=-1).astype(jnp.bfloat16)
+        p_hist, p_chunk = jnp.split(probs, [maxp * page], axis=-1)
+        attn = (jnp.einsum("bngql,nbld->bqngd", p_hist, v_hist)
+                + jnp.einsum("bngqk,bknd->bqngd", p_chunk, v))
+        attn = attn.reshape(b, s, -1)
+        x = x + jnp.einsum("bsd,dh->bsh", attn, lp["wo"],
+                           preferred_element_type=jnp.bfloat16)
+        h2 = rms_norm(x, lp["post_attn_norm"], spec.rms_norm_eps)
+        gate = jnp.einsum("bsh,hi->bsi", h2, lp["w_gate"],
+                          preferred_element_type=jnp.bfloat16)
+        up = jnp.einsum("bsh,hi->bsi", h2, lp["w_up"],
+                        preferred_element_type=jnp.bfloat16)
+        ff = jax.nn.silu(gate.astype(jnp.float32)).astype(jnp.bfloat16) * up
+        x = x + jnp.einsum("bsi,ih->bsh", ff, lp["w_down"],
+                           preferred_element_type=jnp.bfloat16)
+        return x, (k_pages_l, v_pages_l)
+
+    x, (k_cache, v_cache) = jax.lax.scan(
+        layer_fn, x, (params["layers"], k_cache, v_cache))
+    x = rms_norm(x, params["final_norm"], spec.rms_norm_eps)
+    last_idx = jnp.maximum(seq_lens - 1, 0)
+    x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]
+    head = (params["embed"].T if spec.tie_word_embeddings
+            else params["lm_head"])
+    logits = jnp.einsum("bh,hv->bv", x_last, head,
+                        preferred_element_type=jnp.float32)
+    return logits, k_cache, v_cache

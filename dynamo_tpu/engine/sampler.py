@@ -1,0 +1,45 @@
+"""Token sampling: greedy / temperature / top-k / top-p, fully batched.
+
+Per-slot sampling params live in device arrays so one compiled sampler serves
+heterogeneous requests (no recompile per request — XLA static shapes).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_tokens(logits: jax.Array, temperature: jax.Array,
+                  top_k: jax.Array, top_p: jax.Array, key: jax.Array
+                  ) -> jax.Array:
+    """logits [B,V] fp32; temperature/top_k/top_p [B]; returns [B] int32.
+
+    temperature <= 0 means greedy for that slot. top_k <= 0 disables top-k;
+    top_p >= 1 disables top-p.
+    """
+    b, v = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+
+    # Temperature scale (guard zero).
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)
+    scaled = logits / safe_t[:, None]
+
+    # top-k: mask logits below the k-th largest.
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]  # [B,V] descending
+    k_idx = jnp.clip(jnp.where(top_k > 0, top_k, v) - 1, 0, v - 1)
+    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=1)
+    scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+
+    # top-p (nucleus): keep the smallest prefix with cumulative prob >= p.
+    sorted_desc2 = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs_sorted = jax.nn.softmax(sorted_desc2, axis=-1)
+    cum = jnp.cumsum(probs_sorted, axis=-1)
+    # Threshold logit: smallest logit still inside the nucleus.
+    inside = cum - probs_sorted < top_p[:, None]
+    cutoff = jnp.max(jnp.where(inside, jnp.arange(v)[None, :], 0), axis=-1)
+    thresh = jnp.take_along_axis(sorted_desc2, cutoff[:, None], axis=1)
+    scaled = jnp.where(scaled >= thresh, scaled, -jnp.inf)
+
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)

@@ -1,0 +1,77 @@
+"""HF safetensors -> dynamo_tpu parameter loading.
+
+Maps HF Llama/Qwen2 checkpoint names onto the stacked scan-over-layers pytree
+(model.py param_shapes). Loads on host CPU; the runner shards onto the mesh.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+import numpy as np
+
+from dynamo_tpu.engine.config import ModelSpec
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("weights")
+
+
+def load_hf_weights(spec: ModelSpec, model_dir: str):
+    """Load *.safetensors from ``model_dir`` into our param pytree (numpy,
+    bf16 via ml_dtypes)."""
+    import ml_dtypes
+    from safetensors import safe_open
+
+    files = sorted(glob.glob(os.path.join(model_dir, "*.safetensors")))
+    if not files:
+        raise FileNotFoundError(f"no safetensors under {model_dir}")
+    tensors: dict[str, np.ndarray] = {}
+    wanted_prefixes = ("model.", "lm_head.")
+    for path in files:
+        with safe_open(path, framework="numpy") as fh:
+            for name in fh.keys():
+                if name.startswith(wanted_prefixes):
+                    tensors[name] = fh.get_tensor(name)
+
+    bf16 = ml_dtypes.bfloat16
+
+    def get(name: str) -> np.ndarray:
+        if name not in tensors:
+            raise KeyError(f"missing tensor {name}")
+        return tensors[name].astype(bf16)
+
+    L = spec.num_layers
+    layers: dict[str, list] = {k: [] for k in (
+        "input_norm", "post_attn_norm", "wq", "wk", "wv", "wo",
+        "w_gate", "w_up", "w_down")}
+    if spec.qkv_bias:
+        for k in ("bq", "bk", "bv"):
+            layers[k] = []
+    for i in range(L):
+        p = f"model.layers.{i}."
+        layers["input_norm"].append(get(p + "input_layernorm.weight"))
+        layers["post_attn_norm"].append(
+            get(p + "post_attention_layernorm.weight"))
+        # HF linear weights are [out, in]; ours are [in, out].
+        layers["wq"].append(get(p + "self_attn.q_proj.weight").T)
+        layers["wk"].append(get(p + "self_attn.k_proj.weight").T)
+        layers["wv"].append(get(p + "self_attn.v_proj.weight").T)
+        layers["wo"].append(get(p + "self_attn.o_proj.weight").T)
+        layers["w_gate"].append(get(p + "mlp.gate_proj.weight").T)
+        layers["w_up"].append(get(p + "mlp.up_proj.weight").T)
+        layers["w_down"].append(get(p + "mlp.down_proj.weight").T)
+        if spec.qkv_bias:
+            layers["bq"].append(get(p + "self_attn.q_proj.bias"))
+            layers["bk"].append(get(p + "self_attn.k_proj.bias"))
+            layers["bv"].append(get(p + "self_attn.v_proj.bias"))
+    params = {
+        "embed": get("model.embed_tokens.weight"),
+        "final_norm": get("model.norm.weight"),
+        "layers": {k: np.stack(v) for k, v in layers.items()},
+    }
+    if not spec.tie_word_embeddings:
+        params["lm_head"] = get("lm_head.weight").T
+    log.info("loaded %d tensors from %s", len(tensors), model_dir)
+    return params
