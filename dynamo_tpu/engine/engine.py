@@ -334,8 +334,21 @@ class TPUEngine(AsyncEngine):
     def _free_slot(self, slot: int, register: bool) -> None:
         r = self.slot_req[slot]
         self.slot_req[slot] = None
+        # Reset the slot's device-facing state to the reserved scratch page 0:
+        # decode_forward scatters K/V for EVERY slot each step, so a freed
+        # slot's dummy writes must land on the scratch page, never on pages
+        # that have been released and reallocated to live requests.
+        self.tokens[slot] = 0
+        self.positions[slot] = 0
+        self.seq_lens[slot] = 0
+        self.page_table[slot, :] = 0
         if r is None:
             return
+        if not register:
+            # Failure path: the pages' KV contents are suspect (partial
+            # prefill / failed step) — drop their prefix-cache entries so no
+            # future request reuses them.
+            self.allocator.unregister(r.pages)
         self.allocator.release(r.pages)
         r.pages = []
 

@@ -106,6 +106,15 @@ class PageAllocator:
         self.cached_by_page[page] = block_hash
         self.stored_events.append(block_hash)
 
+    def unregister(self, pages: list[int]) -> None:
+        """Drop these pages' prefix-cache registrations (used when a request
+        fails and its KV contents must not be reused)."""
+        for page in pages:
+            h = self.cached_by_page.pop(page, None)
+            if h is not None:
+                self.cached.pop(h, None)
+                self.removed_events.append(h)
+
     def release(self, pages: list[int]) -> None:
         """Drop one active reference; unreferenced unregistered pages return
         to the free list, registered ones stay cached for reuse."""

@@ -181,14 +181,11 @@ class ModelRunner:
         pos = np.zeros((1, bucket), np.int32)
         pos[0, :n] = np.arange(start_pos, start_pos + n)
         pos[0, n:] = start_pos + n - 1  # harmless pad positions
+        # Pad rows stay 0 = the allocator's RESERVED scratch page, so padded
+        # block scatters land there — padding with a live page would create
+        # duplicate scatter indices whose XLA write order is unspecified.
         ptab = np.zeros((1, bucket_pages), np.int32)
         ptab[0, :len(chunk_pages)] = chunk_pages
-        if len(chunk_pages) < bucket_pages:
-            # Pad with a scratch page (page 0 may be live; use last chunk page
-            # so padded writes land on an already-owned page... safe because
-            # padded lanes rewrite offsets beyond seq_len that are never read).
-            pad_page = chunk_pages[-1] if len(chunk_pages) else 0
-            ptab[0, len(chunk_pages):] = pad_page
         lens = np.array([n], np.int32)
         with_history = hist_pages is not None and len(hist_pages) > 0
         maxp = cfg.max_pages_per_seq

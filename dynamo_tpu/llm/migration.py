@@ -29,18 +29,17 @@ class Migration(Operator):
     async def generate(self, request: PreprocessedRequest | dict,
                        context: Context) -> AsyncIterator[LLMEngineOutput]:
         assert self.inner is not None
-        req = (request if isinstance(request, PreprocessedRequest)
-               else PreprocessedRequest.from_wire(request))
+        original = (request if isinstance(request, PreprocessedRequest)
+                    else PreprocessedRequest.from_wire(request))
         retries_left = self.migration_limit
         accumulated: list[int] = []
-        emitted_tokens = 0
+        req = original
         while True:
             try:
                 async for raw in self.inner.generate(req.to_wire(), context):
                     out = (raw if isinstance(raw, LLMEngineOutput)
                            else LLMEngineOutput.from_wire(raw))
                     accumulated.extend(out.token_ids)
-                    emitted_tokens += len(out.token_ids)
                     yield out
                 return
             except StreamIncompleteError as exc:
@@ -51,11 +50,13 @@ class Migration(Operator):
                     "Stream disconnected (%s)... recreating stream "
                     "(%d retries left, carrying %d generated tokens)",
                     exc, retries_left, len(accumulated))
-                # Continue generation on another worker: prompt + generated so
-                # far becomes the new prompt; budget shrinks accordingly.
-                new_req = req.model_copy(deep=True)
-                new_req.token_ids = req.token_ids + accumulated
+                # Continue generation on another worker: the ORIGINAL prompt
+                # plus everything generated so far becomes the new prompt; the
+                # budget shrinks by total emitted. Rebuilding from `original`
+                # each retry keeps repeated migrations from double-counting.
+                new_req = original.model_copy(deep=True)
+                new_req.token_ids = original.token_ids + accumulated
                 if new_req.stop_conditions.max_tokens is not None:
                     new_req.stop_conditions.max_tokens = max(
-                        1, new_req.stop_conditions.max_tokens - emitted_tokens)
+                        1, new_req.stop_conditions.max_tokens - len(accumulated))
                 req = new_req

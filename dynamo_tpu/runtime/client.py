@@ -224,6 +224,12 @@ class EndpointClient:
                 f"Stream ended before generation completed "
                 f"(connect to {instance.instance_id:x} failed: {exc})") from exc
         stop_sent = False
+        # A stop/kill issued while we're blocked on the queue must reach the
+        # worker immediately (not only after the next frame arrives): a single
+        # watcher pushes a wakeup sentinel into the stream queue when the
+        # context cancels — zero per-frame overhead on the token hot path.
+        stop_t = asyncio.ensure_future(ctx.wait_stopped())
+        stop_t.add_done_callback(lambda _: q.put_nowait(("wake", None)))
         try:
             while True:
                 if ctx.is_killed and not stop_sent:
@@ -240,6 +246,8 @@ class EndpointClient:
                     except (ConnectionError, OSError):
                         pass
                 kind, payload = await q.get()
+                if kind == "wake":
+                    continue  # cancellation wakeup; loop top sends stop/kill
                 if kind == "data":
                     yield payload
                 elif kind == "final":
@@ -253,6 +261,7 @@ class EndpointClient:
                         "Stream ended before generation completed "
                         f"(connection to {instance.instance_id:x} lost)")
         finally:
+            stop_t.cancel()
             conn.close_stream(rid)
 
     async def close(self) -> None:

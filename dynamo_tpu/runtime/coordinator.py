@@ -183,13 +183,26 @@ class Coordinator:
                       writer: asyncio.StreamWriter) -> None:
         conn = _Conn(writer)
         self._conns.add(conn)
+        pending: set[asyncio.Task] = set()
         try:
             while True:
                 msg = await read_frame(reader)
-                asyncio.ensure_future(self._dispatch(conn, msg))
+                if msg.get("m") == "queue_pop":
+                    # The only op that can block (timed wait for an item):
+                    # run it off the read loop, holding a strong reference so
+                    # it isn't garbage-collected mid-flight. Everything else
+                    # dispatches inline, preserving per-connection ordering
+                    # (e.g. two kv_puts, or a put/delete pair).
+                    task = asyncio.ensure_future(self._dispatch(conn, msg))
+                    pending.add(task)
+                    task.add_done_callback(pending.discard)
+                else:
+                    await self._dispatch(conn, msg)
         except (asyncio.IncompleteReadError, ConnectionError, ValueError):
             pass
         finally:
+            for task in pending:
+                task.cancel()
             conn.close()
             self._conns.discard(conn)
 

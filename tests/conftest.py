@@ -7,12 +7,19 @@ processes + simulators (SURVEY.md §4). Set env BEFORE jax import.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard-set (not setdefault): the driver environment ships JAX_PLATFORMS=axon
+# and a sitecustomize that registers a TPU platform at interpreter start, so
+# we must force the selection back to CPU before first backend use.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("DTPU_LOG", "warning")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import asyncio
 import functools

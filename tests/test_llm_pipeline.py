@@ -141,6 +141,43 @@ async def test_migration_retries_with_accumulated_tokens():
 
 
 @async_test
+async def test_double_migration_no_duplicate_tokens():
+    # Two consecutive deaths: each retry prompt must be original + ALL
+    # accumulated tokens exactly once, and the budget must shrink from the
+    # ORIGINAL max_tokens (regression test for double-counting).
+    class TwiceDying(AsyncEngine):
+        def __init__(self):
+            self.calls = []
+
+        async def generate(self, request, context):
+            req = PreprocessedRequest.from_wire(request)
+            self.calls.append(req)
+            n = len(self.calls)
+            if n == 1:
+                yield LLMEngineOutput(token_ids=[1]).to_wire()
+                yield LLMEngineOutput(token_ids=[2]).to_wire()
+                raise StreamIncompleteError()
+            if n == 2:
+                yield LLMEngineOutput(token_ids=[3]).to_wire()
+                raise StreamIncompleteError()
+            yield LLMEngineOutput(
+                token_ids=[4], finish_reason=FinishReason.LENGTH).to_wire()
+
+    engine = TwiceDying()
+    migration = Migration(migration_limit=2, inner=engine)
+    req = PreprocessedRequest(model="m", token_ids=[10, 11])
+    req.stop_conditions.max_tokens = 10
+    outs = []
+    async for out in migration.generate(req, Context()):
+        outs.append(out)
+    assert [t for o in outs for t in o.token_ids] == [1, 2, 3, 4]
+    assert engine.calls[1].token_ids == [10, 11, 1, 2]
+    assert engine.calls[1].stop_conditions.max_tokens == 8
+    assert engine.calls[2].token_ids == [10, 11, 1, 2, 3]
+    assert engine.calls[2].stop_conditions.max_tokens == 7
+
+
+@async_test
 async def test_migration_limit_zero_propagates():
     engine = ScriptedEngine([[[1], [2], [3]]], die_after=1)
     migration = Migration(migration_limit=0, inner=engine)
