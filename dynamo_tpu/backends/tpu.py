@@ -5,6 +5,13 @@ equivalent of the reference's vLLM worker (components/backends/vllm/src/dynamo/
 vllm/main.py, SURVEY.md call stack 3.2): starts the engine, registers the
 model with its runtime config, serves the endpoint, publishes KV events +
 ForwardPassMetrics.
+
+Disaggregated serving (reference handlers.py:113-199, SURVEY.md call stack
+3.3): ``--mode prefill`` serves a prefill-only endpoint (computes prompt KV,
+streams it back as a chunked parcel + first token); ``--mode decode``
+conditionally forwards long prompts to discovered prefill workers
+(``--max-local-prefill-length``, reference disagg_router.rs:25-45), injects
+the transferred KV, and decodes. ``--mode agg`` (default) is fully local.
 """
 
 from __future__ import annotations

@@ -8,8 +8,23 @@ and accumulates flash-style online softmax for the q_per_kv grouped query
 heads. Only live pages are read — unlike the XLA gather fallback
 (model.paged_decode_attention_xla) which touches max_len for every sequence.
 
-Layout contract: k_pages/v_pages are [Nkv, P, page_size, head_dim] so one
-(head, page) slab [page_size, head_dim] is contiguous for DMA.
+Lane packing: Mosaic DMAs want the trailing dim = 128 lanes, but head_dim 64
+models (qwen2.5-0.5b etc.) have 64-wide K/V rows. The kernel therefore views
+each page as [page_size*D/128, 128] — for D=64 each 128-lane row packs
+tpr=2 consecutive tokens — and runs the flash accumulation in packed space:
+
+- queries are pre-expanded to q2 [tpr*qpk, 128] where group t occupies lanes
+  [t*D,(t+1)*D) (so dot(q2, K2^T) yields group t's scores against packed
+  rows, i.e. tokens r*tpr+t);
+- each packed row keeps its own (m, l, acc) flash stats — no cross-group
+  communication inside the kernel (Mosaic relayouts across sublane groups
+  are fragile); the kernel emits unnormalized acc plus m and l;
+- the wrapper merges the tpr groups per head in XLA (standard flash merge:
+  rescale by exp(m_t - m*), sum, divide by combined l) and sums the
+  per-group lane windows.
+
+For D >= 128 this degenerates (tpr=1) to the natural unpacked layout with
+the same merge doing only the final normalization.
 """
 
 from __future__ import annotations
@@ -48,19 +63,20 @@ class _ChunkCopy:
 
 
 def _decode_kernel(page_table_ref, seq_lens_ref,  # scalar prefetch (SMEM)
-                   q_ref, k_hbm, v_hbm,  # q VMEM block; k/v full arrays (ANY)
-                   out_ref,  # output VMEM block
+                   q_ref, k_hbm, v_hbm,  # q2 VMEM block; k/v packed (ANY)
+                   acc_ref, m_ref, l_ref,  # outputs (unnormalized flash)
                    k_buf, v_buf, sems,  # scratch
-                   *, page_size: int, max_pages: int):
+                   *, page_size: int, max_pages: int, tpr: int, qpk: int):
     b = pl.program_id(0)
     h = pl.program_id(1)
     seq_len = seq_lens_ref[b]
     chunk_tokens = PAGES_PER_CHUNK * page_size
+    rows = chunk_tokens // tpr  # packed rows per chunk
     num_chunks = jnp.maximum(1, pl.cdiv(seq_len, chunk_tokens))
 
-    qpk = q_ref.shape[2]
-    d = q_ref.shape[3]
-    q = q_ref[0, 0].astype(jnp.float32)  # [qpk, D]
+    n = tpr * qpk
+    q2 = q_ref[0, 0].astype(jnp.float32)  # [n, 128]
+    d = 128 // tpr
     scale = 1.0 / (d ** 0.5)
 
     def make_copies(c, slot):
@@ -73,6 +89,11 @@ def _decode_kernel(page_table_ref, seq_lens_ref,  # scalar prefetch (SMEM)
     kc0, vc0 = make_copies(0, 0)
     kc0.start()
     vc0.start()
+
+    # token index of (row-group t, packed row r) is chunk_start + r*tpr + t
+    # where t = sublane // qpk.
+    group = jax.lax.broadcasted_iota(jnp.int32, (n, rows), 0) // qpk
+    row = jax.lax.broadcasted_iota(jnp.int32, (n, rows), 1)
 
     def body(c, carry):
         m, l, acc = carry
@@ -87,31 +108,30 @@ def _decode_kernel(page_table_ref, seq_lens_ref,  # scalar prefetch (SMEM)
         kc, vc = make_copies(c, slot)
         kc.wait()
         vc.wait()
-        k = k_buf[slot].astype(jnp.float32).reshape(chunk_tokens, d)
-        v = v_buf[slot].astype(jnp.float32).reshape(chunk_tokens, d)
+        k2 = k_buf[slot].astype(jnp.float32).reshape(rows, 128)
+        v2 = v_buf[slot].astype(jnp.float32).reshape(rows, 128)
         scores = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [qpk, chunk]
-        token_idx = (c * chunk_tokens
-                     + jax.lax.broadcasted_iota(jnp.int32,
-                                                (qpk, chunk_tokens), 1))
+            q2, k2, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [n, rows]
+        token_idx = c * chunk_tokens + row * tpr + group
         scores = jnp.where(token_idx < seq_len, scores, NEG_INF)
-        # Online softmax update.
+        # Per-row online softmax (groups merged outside the kernel).
         m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
         p = jnp.exp(scores - m_new)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p, v2, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
-    m0 = jnp.full((qpk, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((qpk, 1), jnp.float32)
-    acc0 = jnp.zeros((qpk, d), jnp.float32)
+    m0 = jnp.full((n, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((n, 1), jnp.float32)
+    acc0 = jnp.zeros((n, 128), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, num_chunks, body, (m0, l0, acc0))
-    out = acc / jnp.maximum(l, 1e-30)
-    out_ref[0, 0] = out.astype(out_ref.dtype)
+    acc_ref[0, 0] = acc.astype(acc_ref.dtype)
+    m_ref[0, 0] = jnp.broadcast_to(m, (n, 128))
+    l_ref[0, 0] = jnp.broadcast_to(l, (n, 128))
 
 
 @functools.partial(jax.jit, static_argnames=("q_per_kv",))
@@ -122,36 +142,83 @@ def paged_decode_attention_pallas(q: jax.Array, k_pages: jax.Array,
     """Drop-in replacement for model.paged_decode_attention_xla.
 
     q [B,Nh,D]; k_pages/v_pages [Nkv,P,page,D]; page_table [B,maxP];
-    seq_lens [B]. Returns [B,Nh,D].
+    seq_lens [B]. Returns [B,Nh,D]. Requires page_size*D % 128 == 0 and
+    128 % D == 0 (packed) or D % 128 == 0 (natural).
     """
     b, nh, d = q.shape
-    nkv, _, page_size, _ = k_pages.shape
+    nkv, num_pages, page_size, _ = k_pages.shape
     maxp = page_table.shape[1]
-    qg = q.reshape(b, nkv, q_per_kv, d)
+    if d >= 128:
+        # The packed-row math assumes one token per 128-lane row; d > 128
+        # would need a multi-row-per-token variant (no current model needs
+        # it: Llama/Qwen/Mistral families are all D=64 or D=128).
+        assert d == 128, f"head_dim {d} > 128 unsupported by this kernel"
+        tpr = 1
+    else:
+        assert 128 % d == 0 and (page_size * d) % 128 == 0, (
+            f"head_dim {d} cannot pack into 128 lanes")
+        tpr = 128 // d
+    qpk = q_per_kv
+    n = tpr * qpk
+    rows_per_page = page_size * d // 128
 
+    # Pack the caches: view each page as [rows_per_page, 128] (zero-cost
+    # reshape: same row-major layout).
+    kp = k_pages.reshape(nkv, num_pages, rows_per_page, 128)
+    vp = v_pages.reshape(nkv, num_pages, rows_per_page, 128)
+
+    # Expand q: group t occupies rows [t*qpk,(t+1)*qpk) and lanes
+    # [t*d,(t+1)*d).
+    qg = q.reshape(b, nkv, qpk, d)
+    if tpr == 1:
+        q2 = qg
+    else:
+        q2 = jnp.zeros((b, nkv, n, 128), q.dtype)
+        for t in range(tpr):
+            q2 = q2.at[:, :, t * qpk:(t + 1) * qpk, t * d:(t + 1) * d].set(qg)
+
+    blk = pl.BlockSpec((1, 1, n, tpr * d), lambda i, j, *_: (i, j, 0, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, nkv),
         in_specs=[
-            pl.BlockSpec((1, 1, q_per_kv, d), lambda i, j, *_: (i, j, 0, 0)),
+            blk,
             pl.BlockSpec(memory_space=pltpu.ANY),
             pl.BlockSpec(memory_space=pltpu.ANY),
         ],
-        out_specs=pl.BlockSpec((1, 1, q_per_kv, d),
-                               lambda i, j, *_: (i, j, 0, 0)),
+        out_specs=(blk, blk, blk),
         scratch_shapes=[
-            pltpu.VMEM((2, PAGES_PER_CHUNK, page_size, d), k_pages.dtype),
-            pltpu.VMEM((2, PAGES_PER_CHUNK, page_size, d), v_pages.dtype),
+            pltpu.VMEM((2, PAGES_PER_CHUNK, rows_per_page, 128),
+                       k_pages.dtype),
+            pltpu.VMEM((2, PAGES_PER_CHUNK, rows_per_page, 128),
+                       v_pages.dtype),
             pltpu.SemaphoreType.DMA((2, 2)),
         ],
     )
     kernel = functools.partial(_decode_kernel, page_size=page_size,
-                               max_pages=maxp)
-    out = pl.pallas_call(
+                               max_pages=maxp, tpr=tpr, qpk=qpk)
+    shape = jax.ShapeDtypeStruct((b, nkv, n, tpr * d), jnp.float32)
+    acc, m, l = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, nkv, q_per_kv, d), q.dtype),
+        out_shape=(shape, shape, shape),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
-    )(page_table, seq_lens, qg, k_pages, v_pages)
-    return out.reshape(b, nh, d)
+    )(page_table, seq_lens, q2, kp, vp)
+    m = m[..., :1]  # broadcast lanes -> scalar stat per row
+    l = l[..., :1]
+    if tpr == 1:
+        out = acc / jnp.maximum(l, 1e-30)
+        return out.astype(q.dtype).reshape(b, nh, d)
+    # Flash-merge the tpr groups of each head, then sum each group's valid
+    # lane window.
+    acc4 = acc.reshape(b, nkv, tpr, qpk, 128)
+    m4 = m.reshape(b, nkv, tpr, qpk, 1)
+    l4 = l.reshape(b, nkv, tpr, qpk, 1)
+    m_star = jnp.max(m4, axis=2, keepdims=True)
+    w = jnp.exp(m4 - m_star)
+    l_star = jnp.sum(w * l4, axis=2)  # [b,nkv,qpk,1]
+    num = sum((w[:, :, t] * acc4[:, :, t])[..., t * d:(t + 1) * d]
+              for t in range(tpr))  # [b,nkv,qpk,d]
+    out = num / jnp.maximum(l_star, 1e-30)
+    return out.astype(q.dtype).reshape(b, nh, d)

@@ -118,6 +118,11 @@ class EngineConfig:
     max_num_seqs: int = 32
     max_prefill_tokens: int = 8192
     prefill_buckets: tuple = (128, 256, 512, 1024, 2048, 4096, 8192)
+    # Decode steps per dispatched device program (tokens chain on-device;
+    # the host sees sampled tokens once per window). Larger windows amortize
+    # dispatch + readback latency at the cost of coarser stop-condition
+    # granularity (up to window-1 wasted speculative tokens per finish).
+    decode_window: int = 8
     # Parallelism
     tp: int = 1
     dp: int = 1

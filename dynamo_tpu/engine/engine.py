@@ -1,16 +1,25 @@
 """TPUEngine: continuous batching over the ModelRunner.
 
 The engine thread owns all device work (JAX calls block): it admits waiting
-requests (prefill, chunked for long prompts, skipping cached prefix pages),
-then runs decode steps over the fixed slot batch, streaming sampled tokens
-back to asyncio-land. Replaces vLLM's scheduler+engine in the reference's
-worker role (SURVEY.md call stack 3.1 "GPU hot loop"); emits the same KV
-events and ForwardPassMetrics the router consumes.
+requests (batched prefill; chunked for long prompts; cached prefix pages are
+skipped), then decodes in M-step WINDOWS: one device program runs M decode
+steps with tokens chained on-device, so the per-token path has no
+host<->device round-trip. While window w executes, the host processes window
+w-1's tokens (async readback), emits them to streams, registers completed
+blocks, and prepares page tables — a software pipeline replacing the
+reference's per-step GPU loop (SURVEY.md call stack 3.1 "GPU hot loop");
+emits the same KV events and ForwardPassMetrics the router consumes.
+
+KV-pressure policy: when the pool is exhausted mid-decode the engine
+preempts the youngest slot — its pages are released (prefix-cache entries
+kept) and the request is requeued to re-prefill from its accumulated tokens
+(reference vLLM preempt-and-recompute semantics) — instead of failing it.
 """
 
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import dataclasses
 import queue
 import threading
@@ -21,7 +30,9 @@ import numpy as np
 
 from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.engine.kv_cache import PageAllocator
-from dynamo_tpu.engine.runner import ModelRunner
+from dynamo_tpu.engine.runner import (
+    ModelRunner, PrefillSeq, PK_OVERRIDE, PK_TOKEN, PK_POS, PK_SEQLEN,
+    PK_TOPK, PK_TEMP, PK_TOPP, PK_CAP, PK_PREFIX)
 from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics, KvStats, WorkerStats
 from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput, PreprocessedRequest
 from dynamo_tpu.llm.tokens import TokenBlockSequence
@@ -38,14 +49,29 @@ class _Request:
     ctx: Context
     out_q: asyncio.Queue
     loop: asyncio.AbstractEventLoop
+    tokens_all: list[int] = dataclasses.field(default_factory=list)
     blocks: TokenBlockSequence = None  # type: ignore[assignment]
     pages: list[int] = dataclasses.field(default_factory=list)
     generated: int = 0
     slot: int = -1
+    epoch: int = 0
+    last_token: int = -1
+    reuse_tokens: int = 0  # cached-prefix tokens pinned by the last plan
+    # Disaggregation: (first_token, kv [2,L,Nkv,n,page,D]) from a remote
+    # prefill — admission inserts the pages instead of prefilling locally.
+    injected: tuple | None = None
     enqueue_t: float = dataclasses.field(default_factory=time.monotonic)
 
     def push(self, item) -> None:
         self.loop.call_soon_threadsafe(self.out_q.put_nowait, item)
+
+
+@dataclasses.dataclass
+class _Window:
+    toks: object  # [M,B] device array (or None when no active rows)
+    slots: list   # per slot: (request, epoch, start_pos, cap) or None
+    frozen: dict  # slot -> (request, epoch, "requeue" | "oom")
+    size: int
 
 
 class TPUEngine(AsyncEngine):
@@ -57,18 +83,21 @@ class TPUEngine(AsyncEngine):
         self.kv_publisher = kv_publisher
         self.metrics_publisher = metrics_publisher
         b = config.max_num_seqs
-        maxp = config.max_pages_per_seq
-        # Slot state (host).
+        # Slot state (host view; tokens chain on-device between windows).
         self.slot_req: list[_Request | None] = [None] * b
-        self.tokens = np.zeros(b, np.int32)
-        self.positions = np.zeros(b, np.int32)
-        self.page_table = np.zeros((b, maxp), np.int32)
-        self.seq_lens = np.zeros(b, np.int32)
+        self.disp_positions = np.zeros(b, np.int64)
+        self.disp_seq_lens = np.zeros(b, np.int64)
         self.temperature = np.zeros(b, np.float32)
         self.top_k = np.zeros(b, np.int32)
         self.top_p = np.ones(b, np.float32)
+        self.overrides: dict[int, int] = {}  # slot -> first token next window
         self.waiting: queue.Queue[_Request] = queue.Queue()
         self.num_waiting = 0
+        # Control jobs executed on the engine thread between windows
+        # (disagg prefill-extract, KV injection helpers, etc.).
+        self._jobs: queue.Queue = queue.Queue()
+        self._inflight: _Window | None = None
+        self._pending_release: list[int] = []
         self._running = False
         self._thread: threading.Thread | None = None
         self._publish_loop: asyncio.AbstractEventLoop | None = None
@@ -107,8 +136,8 @@ class TPUEngine(AsyncEngine):
                 f"prompt length {len(req.token_ids)} exceeds max model len "
                 f"{self.config.max_model_len}")
         r = _Request(req=req, ctx=context, out_q=asyncio.Queue(),
-                     loop=asyncio.get_running_loop())
-        r.blocks = TokenBlockSequence(self.config.page_size, req.token_ids)
+                     loop=asyncio.get_running_loop(),
+                     tokens_all=list(req.token_ids))
         self.waiting.put(r)
         self.num_waiting += 1
         while True:
@@ -121,6 +150,76 @@ class TPUEngine(AsyncEngine):
             if item.get("finish_reason"):
                 return
 
+    async def generate_injected(self, request, context: Context,
+                                first_token: int, kv) -> AsyncIterator[dict]:
+        """Serve a request whose prompt KV was prefilled REMOTELY: admission
+        inserts the transferred pages and decoding starts at first_token
+        (disaggregated decode side; reference handlers.py:113-162)."""
+        self.start()
+        req = (request if isinstance(request, PreprocessedRequest)
+               else PreprocessedRequest.from_wire(request))
+        r = _Request(req=req, ctx=context, out_q=asyncio.Queue(),
+                     loop=asyncio.get_running_loop(),
+                     tokens_all=list(req.token_ids),
+                     injected=(first_token, kv))
+        self.waiting.put(r)
+        self.num_waiting += 1
+        while True:
+            item = await r.out_q.get()
+            if item is None:
+                return
+            if isinstance(item, Exception):
+                raise item
+            yield item
+            if item.get("finish_reason"):
+                return
+
+    # -- engine-thread jobs (disaggregation control path) ---------------------
+    async def run_job(self, fn):
+        """Run ``fn`` on the engine thread (which owns all device work)
+        between windows; await its result."""
+        self.start()
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        self._jobs.put((fn, fut))
+        return await asyncio.wrap_future(fut)
+
+    def _run_jobs(self) -> None:
+        while True:
+            try:
+                fn, fut = self._jobs.get_nowait()
+            except queue.Empty:
+                return
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn())
+            except Exception as exc:  # noqa: BLE001 — deliver to caller
+                fut.set_exception(exc)
+
+    def prefill_extract(self, req: PreprocessedRequest):
+        """ENGINE-THREAD ONLY (call via run_job). Prefill a prompt, register
+        its blocks in the prefix cache, and extract the prompt's KV pages to
+        host. Returns (first_token, kv [2,L,Nkv,n,page,D], prompt_len) —
+        the disaggregated prefill side (reference PrefillWorkerHandler,
+        handlers.py:167-199)."""
+        r = _Request(req=req, ctx=Context(), out_q=None, loop=None,  # type: ignore[arg-type]
+                     tokens_all=list(req.token_ids))
+        plan = self._plan_prefill(r)
+        if plan is None:
+            raise RuntimeError("prefill worker KV pool exhausted")
+        try:
+            if plan == "chunked":
+                first_token = self._prefill_chunked_token(r)
+            else:
+                first_token = int(self.runner.prefill_batch([plan])[0])
+            for idx, h in enumerate(r.blocks.block_hashes):
+                self.allocator.register(r.pages[idx], h)
+            kv = self.runner.extract_pages(r.pages)
+        finally:
+            self.allocator.release(r.pages)
+            r.pages = []
+        return first_token, kv, len(r.tokens_all)
+
     def handler(self):
         async def handle(request, context):
             async for out in self.generate(request, context):
@@ -130,189 +229,375 @@ class TPUEngine(AsyncEngine):
 
     # -- engine thread --------------------------------------------------------
     def _engine_loop(self) -> None:
-        log.info("engine loop starting (slots=%d pages=%d)",
-                 self.config.max_num_seqs, self.runner.num_pages)
+        log.info("engine loop starting (slots=%d pages=%d window=%d)",
+                 self.config.max_num_seqs, self.runner.num_pages,
+                 self.config.decode_window)
         while self._running:
-            admitted = self._admit()
-            active = [i for i, r in enumerate(self.slot_req) if r is not None]
-            if not active:
-                if not admitted:
-                    time.sleep(0.002)
-                continue
+            self._run_jobs()
             try:
-                self._decode_step(active)
-            except Exception as exc:  # noqa: BLE001 — fail all, keep serving
-                log.exception("decode step failed")
-                for i in active:
-                    r = self.slot_req[i]
-                    if r is not None:
-                        r.push(RuntimeError(f"engine step failed: {exc}"))
-                        self._free_slot(i, register=False)
-            self.step_count += 1
-            self._publish()
+                admitted = self._admit()
+            except Exception:  # noqa: BLE001
+                log.exception("admission failed")
+                admitted = False
+            have_active = any(r is not None for r in self.slot_req)
+            window = None
+            if have_active:
+                try:
+                    window = self._dispatch_window()
+                except Exception as exc:  # noqa: BLE001 — fail all, keep serving
+                    log.exception("decode window dispatch failed")
+                    for i, r in enumerate(self.slot_req):
+                        if r is not None:
+                            r.push(RuntimeError(f"engine step failed: {exc}"))
+                            self._finish_slot(i, register=False)
+            if self._inflight is not None:
+                try:
+                    self._process_window(self._inflight)
+                except Exception as exc:  # noqa: BLE001
+                    # Device faults surface at the readback: host token state
+                    # has diverged from the on-device chain, so fail every
+                    # request this window covered rather than continue with
+                    # silently-wrong streams/prefix hashes.
+                    log.exception("window processing failed")
+                    for i, snap in enumerate(self._inflight.slots):
+                        if snap is not None and self.slot_req[i] is snap[0]:
+                            snap[0].push(RuntimeError(
+                                f"window processing failed: {exc}"))
+                            self._finish_slot(i, register=False)
+                self.step_count += 1
+                self._publish()
+            self._inflight = window
+            if window is None and not admitted and not have_active:
+                # Fully idle: release any deferred pages (no in-flight writer)
+                # and nap.
+                if self._pending_release:
+                    self.allocator.release(self._pending_release)
+                    self._pending_release = []
+                time.sleep(0.002)
 
+    # -- admission / prefill --------------------------------------------------
     def _admit(self) -> bool:
-        admitted = False
-        while True:
-            free_slots = [i for i, r in enumerate(self.slot_req) if r is None]
-            if not free_slots:
-                return admitted
+        free_slots = [i for i, r in enumerate(self.slot_req) if r is None]
+        staged: list[tuple[_Request, int, PrefillSeq]] = []
+        while free_slots:
             try:
                 r = self.waiting.get_nowait()
             except queue.Empty:
-                return admitted
+                break
             self.num_waiting -= 1
             if r.ctx.is_killed or r.ctx.is_stopped:
                 r.push(LLMEngineOutput(
                     token_ids=[], finish_reason=FinishReason.CANCELLED).to_wire())
                 continue
+            if r.injected is not None:
+                slot = free_slots.pop(0)
+                try:
+                    if self._admit_injected(r, slot):
+                        continue
+                except Exception as exc:  # noqa: BLE001
+                    log.exception("KV injection failed")
+                    r.push(RuntimeError(f"kv injection failed: {exc}"))
+                    free_slots.insert(0, slot)
+                    continue
+                # No pages for the transferred KV: fall back to a normal
+                # local prefill of the full prompt (correctness preserved).
+                free_slots.insert(0, slot)
+                r.injected = None
             try:
-                ok = self._prefill_request(r, free_slots[0])
+                plan = self._plan_prefill(r)
             except Exception as exc:  # noqa: BLE001
-                log.exception("prefill failed")
+                log.exception("prefill planning failed")
                 r.push(RuntimeError(f"prefill failed: {exc}"))
                 continue
-            if not ok:
+            if plan is None:
                 # No KV room: put back and stop admitting.
                 self.waiting.put(r)
                 self.num_waiting += 1
-                return admitted
-            admitted = True
+                break
+            slot = free_slots.pop(0)
+            if plan == "chunked":
+                try:
+                    self._prefill_chunked(r, slot)
+                except Exception as exc:  # noqa: BLE001
+                    log.exception("chunked prefill failed")
+                    self.allocator.release(r.pages)
+                    r.pages = []
+                    r.push(RuntimeError(f"prefill failed: {exc}"))
+                    free_slots.insert(0, slot)
+                continue
+            staged.append((r, slot, plan))
+        if not staged:
+            return False
+        # Batch the staged whole-prompt rows (split by history-ness; the
+        # history variant costs a full-maxp gather per row).
+        for with_h in (False, True):
+            group = [(r, s, p) for (r, s, p) in staged
+                     if (p.hist_pages is not None) == with_h]
+            while group:
+                chunk, group = group[:8], group[8:]
+                try:
+                    tokens = self.runner.prefill_batch([p for _, _, p in chunk])
+                except Exception as exc:  # noqa: BLE001
+                    log.exception("batched prefill failed")
+                    for r, _, _ in chunk:
+                        self.allocator.release(r.pages)
+                        r.pages = []
+                        r.push(RuntimeError(f"prefill failed: {exc}"))
+                    continue
+                for (r, slot, _), tok in zip(chunk, tokens):
+                    self._place_in_slot(r, slot, int(tok))
+        return True
 
-    def _prefill_request(self, r: _Request, slot: int) -> bool:
+    def _admit_injected(self, r: _Request, slot: int) -> bool:
+        """Place a remotely-prefilled request: allocate pages, upload the
+        transferred KV, start decoding at its first token. Returns False if
+        the pool has no room (caller falls back to local prefill)."""
+        page = self.config.page_size
+        first_token, kv = r.injected
+        prompt = r.tokens_all
+        r.blocks = TokenBlockSequence(page, prompt)
+        total_pages = -(-len(prompt) // page)
+        if kv.shape[3] != total_pages:
+            raise ValueError(
+                f"transferred KV has {kv.shape[3]} pages, prompt needs "
+                f"{total_pages}")
+        pages = self.allocator.allocate(total_pages)
+        if pages is None:
+            return False
+        self.runner.insert_pages(kv, pages)
+        r.pages = pages
+        r.injected = None
+        self._place_in_slot(r, slot, first_token)
+        return True
+
+    def _plan_prefill(self, r: _Request):
+        """Pin cached prefix pages + allocate the rest. Returns a PrefillSeq
+        (whole-prompt row), "chunked" (long prompt; caller runs the chunk
+        loop), or None (no KV room)."""
         cfg = self.config
         page = cfg.page_size
-        prompt = r.req.token_ids
+        prompt = r.tokens_all
+        r.blocks = TokenBlockSequence(page, prompt)
         hashes = r.blocks.block_hashes
-        # Prefix reuse: pin cached pages for the longest cached prefix, but
-        # always recompute at least the last token so we have logits.
         cached_pages = self.allocator.acquire_cached(hashes)
         reuse_tokens = len(cached_pages) * page
         if reuse_tokens >= len(prompt):
+            # Always recompute at least the last token so we have logits.
             drop = (reuse_tokens - len(prompt)) // page + 1
             self.allocator.release(cached_pages[len(cached_pages) - drop:])
             cached_pages = cached_pages[:len(cached_pages) - drop]
             reuse_tokens = len(cached_pages) * page
         self.prefix_lookup_blocks += max(1, len(hashes))
         self.prefix_hit_blocks += len(cached_pages)
-        # Pages needed for the rest of the prompt + headroom for generation.
+        r.reuse_tokens = reuse_tokens
         total_prompt_pages = -(-len(prompt) // page)
         need = total_prompt_pages - len(cached_pages)
         new_pages = self.allocator.allocate(need)
         if new_pages is None:
             self.allocator.release(cached_pages)
-            return False
-        pages = cached_pages + new_pages
-        r.pages = pages
-        # Chunked prefill over buckets.
-        start = reuse_tokens
+            return None
+        r.pages = cached_pages + new_pages
+        rest = len(prompt) - reuse_tokens
+        max_chunk = min(cfg.max_prefill_tokens, cfg.prefill_buckets[-1])
+        if rest > max_chunk:
+            return "chunked"
+        first_page = reuse_tokens // page
+        chunk_pages = np.asarray(r.pages[first_page:], np.int32)
+        hist = (np.asarray(r.pages[:first_page], np.int32)
+                if first_page else None)
+        return PrefillSeq(
+            tokens=np.asarray(prompt[reuse_tokens:], np.int32),
+            start_pos=reuse_tokens, chunk_pages=chunk_pages,
+            hist_pages=hist, sampling=self._sampling_of(r))
+
+    def _prefill_chunked(self, r: _Request, slot: int) -> None:
+        """Long prompt: prefill in page-aligned chunks with history."""
+        self._place_in_slot(r, slot, self._prefill_chunked_token(r))
+
+    def _prefill_chunked_token(self, r: _Request) -> int:
+        cfg = self.config
+        page = cfg.page_size
+        prompt = r.tokens_all
+        pages = r.pages
+        start = r.reuse_tokens  # cached prefix pinned by the plan
         max_chunk = min(cfg.max_prefill_tokens, cfg.prefill_buckets[-1])
         first_token = None
         while start < len(prompt):
             n = min(max_chunk, len(prompt) - start)
-            # Chunks must start at page boundaries (start is one by
-            # construction); align chunk length to page size unless final.
             chunk_tokens = np.asarray(prompt[start:start + n], np.int32)
             first_page = start // page
             chunk_pages = np.asarray(
                 pages[first_page:first_page + (-(-n // page))], np.int32)
             hist = np.asarray(pages[:first_page], np.int32)
-            sampling = self._sampling_of(r)
             token, _ = self.runner.prefill(
                 chunk_tokens, start, chunk_pages,
-                hist if len(hist) else None, sampling)
+                hist if len(hist) else None, self._sampling_of(r))
             start += n
             if start >= len(prompt):
                 first_token = token
         assert first_token is not None
-        self._place_in_slot(r, slot, first_token)
-        return True
+        return first_token
 
     def _sampling_of(self, r: _Request) -> tuple[float, int, float]:
         s = r.req.sampling_options
         return (s.temperature or 0.0, s.top_k or 0, s.top_p or 1.0)
 
     def _place_in_slot(self, r: _Request, slot: int, first_token: int) -> None:
-        prompt_len = len(r.req.token_ids)
+        prompt_len = len(r.tokens_all)
         # The prompt's complete blocks are now resident: register them for
         # prefix reuse + router events.
         for idx, h in enumerate(r.blocks.block_hashes):
             self.allocator.register(r.pages[idx], h)
-        r.generated = 1  # the prefill sampled the first token
+        r.generated += 1
         finish = self._check_finish(r, first_token)
-        self._emit_token(r, first_token, finish)
+        self._emit(r, [first_token], finish)
         if finish is not None:
-            self.allocator.release(r.pages)
+            self._pending_release.extend(r.pages)
             r.pages = []
             return
         r.slot = slot
+        r.epoch += 1
+        r.last_token = first_token
+        r.tokens_all.append(first_token)
         self.slot_req[slot] = r
-        self.tokens[slot] = first_token
-        self.positions[slot] = prompt_len  # where the new token will be written
-        self.page_table[slot, :len(r.pages)] = r.pages
-        self.seq_lens[slot] = prompt_len + 1
+        self.disp_positions[slot] = prompt_len
+        self.disp_seq_lens[slot] = prompt_len + 1
         temp, tk, tp = self._sampling_of(r)
         self.temperature[slot] = temp
         self.top_k[slot] = tk
         self.top_p[slot] = tp
+        self.overrides[slot] = first_token
 
-    def _decode_step(self, active: list[int]) -> None:
+    # -- decode windows -------------------------------------------------------
+    def _dispatch_window(self) -> _Window:
         cfg = self.config
         page = cfg.page_size
-        # Ensure every active slot has a page for the position being written.
-        for i in active:
-            r = self.slot_req[i]
-            needed_pages = self.positions[i] // page + 1
-            if needed_pages > self.config.max_pages_per_seq:
-                r.push(LLMEngineOutput(
-                    token_ids=[], finish_reason=FinishReason.LENGTH).to_wire())
-                self._free_slot(i, register=True)
-                continue
-            while len(r.pages) < needed_pages:
-                new = self.allocator.allocate(1)
-                if new is None:
-                    # Out of KV: fail this request (preemption lands with the
-                    # KVBM offload tier).
-                    r.push(RuntimeError("KV pool exhausted"))
-                    self._free_slot(i, register=False)
-                    break
-                r.pages.extend(new)
-                self.page_table[i, len(r.pages) - 1] = new[0]
-            if self.slot_req[i] is None:
-                active = [j for j in active if j != i]
-        if not active:
-            return
-        sampled = self.runner.decode(
-            self.tokens, self.positions, self.page_table, self.seq_lens,
-            self.temperature, self.top_k, self.top_p)
-        for i in active:
-            r = self.slot_req[i]
+        M = cfg.decode_window
+        b = cfg.max_num_seqs
+        frozen: dict[int, tuple] = {}
+        needed_max = 1
+        n_live = sum(1 for r in self.slot_req if r is not None)
+        for i, r in enumerate(self.slot_req):
             if r is None:
                 continue
-            token = int(sampled[i])
+            last_pos = int(self.disp_positions[i]) + M - 1
+            # Clamp to the model-length cap: the slot decodes up to its
+            # allocated capacity within the window and freezes in-graph
+            # (the host emits LENGTH when processing reaches the cap).
+            needed = min(last_pos // page + 1, cfg.max_pages_per_seq)
+            ok = True
+            while len(r.pages) < needed:
+                new = self.allocator.allocate(1)
+                if new is None:
+                    ok = False
+                    break
+                r.pages.extend(new)
+            if not ok:
+                # Preempt-and-requeue, unless this is the only live slot (the
+                # pool is simply too small for the request: fail it).
+                frozen[i] = (r, r.epoch, "requeue" if n_live > 1 else "oom")
+                continue
+            needed_max = max(needed_max, len(r.pages))
+        active_rows = [i for i, r in enumerate(self.slot_req)
+                       if r is not None and i not in frozen]
+        # A slot frozen at the PREVIOUS dispatch whose allocation now
+        # succeeded is live again: cancel the pending preemption record so
+        # processing the previous window doesn't spuriously requeue it.
+        if self._inflight is not None:
+            for i in active_rows:
+                self._inflight.frozen.pop(i, None)
+        if not active_rows:
+            return _Window(toks=None, slots=[None] * b, frozen=frozen, size=M)
+        bucket = self.runner.bucket_pages_for(needed_max)
+        packed = np.zeros((b, PK_PREFIX + bucket), np.int32)
+        slots: list = [None] * b
+        for i in active_rows:
+            r = self.slot_req[i]
+            # Consume the override only when the slot actually dispatches
+            # (a frozen slot's first-token override must survive a retry).
+            tok = self.overrides.pop(i, None)
+            if tok is not None:
+                packed[i, PK_OVERRIDE] = 1
+                packed[i, PK_TOKEN] = tok
+            start = int(self.disp_positions[i])
+            cap = len(r.pages) * page
+            packed[i, PK_POS] = start
+            packed[i, PK_SEQLEN] = self.disp_seq_lens[i]
+            packed[i, PK_TOPK] = self.top_k[i]
+            packed[i, PK_TEMP] = self.temperature[i:i + 1].view(np.int32)[0]
+            packed[i, PK_TOPP] = self.top_p[i:i + 1].view(np.int32)[0]
+            packed[i, PK_CAP] = cap
+            packed[i, PK_PREFIX:PK_PREFIX + len(r.pages)] = r.pages
+            slots[i] = (r, r.epoch, start, cap)
+            adv = min(M, max(0, cap - start))
+            self.disp_positions[i] += adv
+            self.disp_seq_lens[i] += adv
+        toks = self.runner.decode_window(packed, M)
+        try:
+            toks.copy_to_host_async()
+        except Exception:  # noqa: BLE001 — not all backends support it
+            pass
+        return _Window(toks=toks, slots=slots, frozen=frozen, size=M)
+
+    def _process_window(self, w: _Window) -> None:
+        page = self.config.page_size
+        toks = np.asarray(w.toks) if w.toks is not None else None
+        # The previous window (whose pages these were) has now completed —
+        # its dummy scatters can no longer touch them.
+        if self._pending_release:
+            self.allocator.release(self._pending_release)
+            self._pending_release = []
+        for i, (fr, fepoch, reason) in w.frozen.items():
+            r = self.slot_req[i]
+            if r is not fr or r is None or r.epoch != fepoch:
+                continue  # slot was re-assigned since dispatch
+            if reason == "oom":
+                r.push(RuntimeError(
+                    "KV pool exhausted and no other request to preempt"))
+                self._finish_slot(i, register=False)
+            else:  # requeue (preemption)
+                self._requeue_slot(i)
+        if toks is None:
+            return
+        for i, snap in enumerate(w.slots):
+            if snap is None:
+                continue
+            r, epoch, start, cap = snap
+            if self.slot_req[i] is not r or r.epoch != epoch:
+                continue  # slot was re-assigned since dispatch
             if r.ctx.is_killed:
                 r.push(None)
-                self._free_slot(i, register=True)
+                self._finish_slot(i, register=True)
                 continue
-            if r.ctx.is_stopped:
-                r.push(LLMEngineOutput(
-                    token_ids=[], finish_reason=FinishReason.CANCELLED).to_wire())
-                self._free_slot(i, register=True)
-                continue
-            r.generated += 1
-            new_block = r.blocks.append(self.tokens[i])
-            if new_block is not None:
-                # Register the just-completed page under its chained hash.
-                page_idx = (len(r.blocks.tokens) // page) - 1
-                self.allocator.register(r.pages[page_idx], new_block)
-            finish = self._check_finish(r, token)
-            self._emit_token(r, token, finish)
+            accepted: list[int] = []
+            finish = None
+            inp = r.last_token
+            for m in range(w.size):
+                if start + m >= cap:
+                    # The slot hit its page capacity (= max_model_len here:
+                    # dispatch clamps allocation only at max_pages_per_seq)
+                    # and froze in-graph.
+                    finish = FinishReason.LENGTH
+                    break
+                token = int(toks[m, i])
+                r.generated += 1
+                new_block = r.blocks.append(inp)
+                if new_block is not None:
+                    # Register the just-completed page under its chained hash.
+                    page_idx = (len(r.blocks.tokens) // page) - 1
+                    self.allocator.register(r.pages[page_idx], new_block)
+                accepted.append(token)
+                r.tokens_all.append(token)
+                inp = token
+                finish = self._check_finish(r, token)
+                if finish is not None:
+                    break
+            r.last_token = inp
+            if finish is None and r.ctx.is_stopped:
+                finish = FinishReason.CANCELLED
+            self._emit(r, accepted, finish)
             if finish is not None:
-                self._free_slot(i, register=True)
-            else:
-                self.tokens[i] = token
-                self.positions[i] += 1
-                self.seq_lens[i] += 1
+                self._finish_slot(i, register=True)
 
     def _check_finish(self, r: _Request, token: int) -> FinishReason | None:
         sc = r.req.stop_conditions
@@ -326,31 +611,48 @@ class TPUEngine(AsyncEngine):
             return FinishReason.STOP
         return None
 
-    def _emit_token(self, r: _Request, token: int,
-                    finish: FinishReason | None = None) -> None:
-        r.push(LLMEngineOutput(token_ids=[token],
+    def _emit(self, r: _Request, tokens: list[int],
+              finish: FinishReason | None = None) -> None:
+        r.push(LLMEngineOutput(token_ids=tokens,
                                finish_reason=finish).to_wire())
 
-    def _free_slot(self, slot: int, register: bool) -> None:
+    def _finish_slot(self, slot: int, register: bool) -> None:
         r = self.slot_req[slot]
         self.slot_req[slot] = None
-        # Reset the slot's device-facing state to the reserved scratch page 0:
-        # decode_forward scatters K/V for EVERY slot each step, so a freed
-        # slot's dummy writes must land on the scratch page, never on pages
-        # that have been released and reallocated to live requests.
-        self.tokens[slot] = 0
-        self.positions[slot] = 0
-        self.seq_lens[slot] = 0
-        self.page_table[slot, :] = 0
+        self.disp_positions[slot] = 0
+        self.disp_seq_lens[slot] = 0
+        self.overrides.pop(slot, None)
         if r is None:
             return
+        r.slot = -1
+        r.epoch += 1
         if not register:
             # Failure path: the pages' KV contents are suspect (partial
             # prefill / failed step) — drop their prefix-cache entries so no
             # future request reuses them.
             self.allocator.unregister(r.pages)
-        self.allocator.release(r.pages)
+        # Defer the release until the in-flight window (which may still
+        # scatter dummy K/V through the old page table) completes.
+        self._pending_release.extend(r.pages)
         r.pages = []
+
+    def _requeue_slot(self, slot: int) -> None:
+        """Preempt: free this slot's pages (prefix-cache entries survive so
+        the re-prefill mostly hits) and requeue the request with its
+        accumulated tokens."""
+        r = self.slot_req[slot]
+        self._finish_slot(slot, register=True)
+        if r is None:
+            return
+        if r.ctx.is_killed or r.ctx.is_stopped:
+            r.push(LLMEngineOutput(
+                token_ids=[], finish_reason=FinishReason.CANCELLED).to_wire())
+            return
+        log.warning("KV pool exhausted: preempting slot %d (request %s, "
+                    "%d tokens so far) and requeueing", slot, r.ctx.id,
+                    len(r.tokens_all))
+        self.waiting.put(r)
+        self.num_waiting += 1
 
     # -- metrics + events -----------------------------------------------------
     def _publish(self) -> None:

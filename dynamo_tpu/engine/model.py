@@ -273,12 +273,15 @@ def decode_forward(params: Params, spec: ModelSpec,
                    k_cache: jax.Array, v_cache: jax.Array,
                    tokens: jax.Array, positions: jax.Array,
                    page_table: jax.Array, seq_lens: jax.Array,
-                   attention_impl=None,
+                   attention_impl=None, write_mask: jax.Array | None = None,
                    ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One decode step for the whole slot batch.
 
     tokens [B], positions [B] (absolute position of the new token), page_table
-    [B, maxP], seq_lens [B] (lengths INCLUDING the new token). Returns
+    [B, maxP], seq_lens [B] (lengths INCLUDING the new token). write_mask [B]
+    bool (optional): rows with False scatter their K/V to the reserved
+    scratch page 0 instead of their own pages (used by the window loop to
+    freeze slots that hit page capacity mid-window). Returns
     (logits [B,V], k_cache, v_cache).
     """
     b = tokens.shape[0]
@@ -290,6 +293,9 @@ def decode_forward(params: Params, spec: ModelSpec,
     page_idx = positions // page
     page_off = positions % page
     dest_page = jnp.take_along_axis(page_table, page_idx[:, None], axis=1)[:, 0]
+    if write_mask is not None:
+        dest_page = jnp.where(write_mask, dest_page, 0)
+        page_off = jnp.where(write_mask, page_off, 0)
     attn_fn = attention_impl or paged_decode_attention_xla
 
     def layer_fn(x, scan_in):

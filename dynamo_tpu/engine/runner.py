@@ -4,17 +4,29 @@ Owns the mesh (("dp","tp"), reference §2.7 TP delegated-to-engine -> here
 native via jax.sharding), the sharded parameters, the paged KV device arrays,
 and the jit-compiled step functions:
 
-- ``prefill(chunk)``: length-bucketed (one compiled program per bucket);
-  supports history pages so long prompts prefill in chunks (chunked prefill,
-  SURVEY.md §5.7 parity) and cached prefixes are skipped, attending to prior
-  pages via the same paged read path as decode;
-- ``decode_step``: one token for the whole slot batch + batched sampling.
+- ``prefill_batch``: length-bucketed, batch-bucketed prefill of whole
+  prompts (one compiled program per (bucket, batch, with_history)); supports
+  history pages so long prompts prefill in chunks (chunked prefill, SURVEY.md
+  §5.7 parity) and cached prefixes are skipped, attending to prior pages via
+  the same paged read path as decode. First-token sampling is fused into the
+  program (no separate sampler dispatch).
+- ``decode_window``: M decode steps for the whole slot batch in ONE device
+  program (lax.scan over steps): tokens chain on-device, positions/lengths
+  advance in-graph, sampling per step. The host uploads a single packed
+  int32 control array per window and reads back the [M,B] sampled tokens
+  asynchronously — the design keeps host<->device round-trips OFF the
+  per-token path (the reference's GPU engines rely on CUDA-graph replay for
+  the same reason; XLA's equivalent is one big compiled window).
+- page-table width bucketing: the decode window is compiled per power-of-2
+  page-table width, so the XLA gather attention reads ~live pages instead of
+  max_pages_per_seq for every sequence.
 
 KV arrays are donated through every call so XLA updates them in place.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 
 import jax
@@ -35,6 +47,30 @@ from dynamo_tpu.engine.sampler import sample_tokens
 from dynamo_tpu.runtime.logging import get_logger
 
 log = get_logger("runner")
+
+# Packed per-window control array columns (int32; floats bitcast).
+PK_OVERRIDE = 0   # 1 -> take PK_TOKEN instead of the chained device token
+PK_TOKEN = 1
+PK_POS = 2        # absolute position of the token to be written this window
+PK_SEQLEN = 3     # length INCLUDING that token; 0 -> slot inactive
+PK_TOPK = 4
+PK_TEMP = 5       # float32 bits
+PK_TOPP = 6       # float32 bits
+PK_CAP = 7        # position capacity = allocated pages * page_size; a slot
+                  # freezes in-graph when its position reaches this
+PK_PREFIX = 8     # page table starts here
+
+_PF_HDR = 8       # prefill packed-array header columns
+
+
+@dataclasses.dataclass
+class PrefillSeq:
+    """One whole-prompt (or final-chunk) prefill row."""
+    tokens: np.ndarray          # [n] chunk tokens
+    start_pos: int              # absolute position of tokens[0]
+    chunk_pages: np.ndarray     # pages covering the chunk
+    hist_pages: np.ndarray | None  # pages before the chunk (None = fresh)
+    sampling: tuple[float, int, float]  # (temperature, top_k, top_p)
 
 
 class ModelRunner:
@@ -75,7 +111,9 @@ class ModelRunner:
 
         self._prefill_cache: dict = {}
         self._decode_fn = None
+        self._window_cache: dict = {}
         self._rng = jax.random.key(seed + 1)
+        self.tokens_dev = jnp.zeros((config.max_num_seqs,), jnp.int32)
         self._attention_impl = self._pick_attention()
 
     # -- setup ---------------------------------------------------------------
@@ -102,14 +140,22 @@ class ModelRunner:
     def _pick_attention(self):
         backend = self.config.attention_backend
         if backend == "auto":
-            backend = ("pallas" if jax.devices()[0].platform == "tpu"
-                       else "xla")
+            # The bucketed XLA gather is the default: it reads ~live pages
+            # and avoids Mosaic constraints. The Pallas kernel is opt-in
+            # (wins for long mixed-length batches where one long sequence
+            # widens the gather bucket for everyone).
+            backend = "xla"
         if backend == "pallas":
-            if self.spec.head_dim % 128 != 0:
-                # Mosaic DMA slices need the trailing dim 128-aligned; D=64
-                # models (qwen2.5-0.5b etc.) use the XLA path.
-                log.info("head_dim %d not 128-aligned; pallas kernel disabled",
-                         self.spec.head_dim)
+            d = self.spec.head_dim
+            page = self.config.page_size
+            packable = (d == 128
+                        or (d < 128 and 128 % d == 0
+                            and (page * d) % 128 == 0))
+            if not packable:
+                # The kernel packs D<128 rows into 128 lanes; that needs
+                # 128 % D == 0 and page_size*D % 128 == 0.
+                log.info("head_dim %d/page %d not packable to 128 lanes; "
+                         "pallas kernel disabled", d, page)
                 return paged_decode_attention_xla
             try:
                 from dynamo_tpu.engine.attention import paged_decode_attention_pallas
@@ -119,16 +165,36 @@ class ModelRunner:
         return paged_decode_attention_xla
 
     # -- compiled steps -------------------------------------------------------
-    def _get_prefill(self, bucket: int, with_history: bool):
-        key = (bucket, with_history)
+    def _get_prefill(self, bucket: int, batch: int, with_history: bool):
+        key = (bucket, batch, with_history)
         fn = self._prefill_cache.get(key)
         if fn is not None:
             return fn
         spec = self.spec
-        cfg = self.config
+        page = self.config.page_size
+        bucket_pages = bucket // page
 
-        def step(params, k_cache, v_cache, tokens, positions, page_table,
-                 seq_lens, hist_table, hist_lens):
+        # All host inputs travel in ONE packed int32 array (floats bitcast):
+        # h2d transfers are latency-bound, so one transfer beats ten.
+        # Columns: 0 start_pos, 1 n_tokens, 2 hist_len, 3 temp bits,
+        # 4 top_k, 5 top_p bits, then tokens[bucket], ptab[bucket_pages],
+        # htab[maxp if with_history].
+        def step(params, k_cache, v_cache, packed, rng):
+            start = packed[:, 0]
+            n = packed[:, 1]
+            hist_lens = packed[:, 2]
+            temp = jax.lax.bitcast_convert_type(packed[:, 3], jnp.float32)
+            top_k = packed[:, 4]
+            top_p = jax.lax.bitcast_convert_type(packed[:, 5], jnp.float32)
+            tokens = packed[:, _PF_HDR:_PF_HDR + bucket]
+            page_table = packed[:, _PF_HDR + bucket:
+                                _PF_HDR + bucket + bucket_pages]
+            hist_table = packed[:, _PF_HDR + bucket + bucket_pages:]
+            # positions: start..start+n-1, pads clamped to the last valid.
+            positions = start[:, None] + jnp.minimum(
+                jnp.arange(bucket)[None, :],
+                jnp.maximum(n - 1, 0)[:, None])
+            seq_lens = n
             if with_history:
                 logits, k_cache, v_cache = _prefill_with_history(
                     params, spec, k_cache, v_cache, tokens, positions,
@@ -138,7 +204,9 @@ class ModelRunner:
                 logits, k_cache, v_cache = prefill_forward(
                     params, spec, k_cache, v_cache, tokens, positions,
                     page_table, seq_lens)
-            return logits, k_cache, v_cache
+            rng, sub = jax.random.split(rng)
+            sampled = sample_tokens(logits, temp, top_k, top_p, sub)
+            return sampled, logits, k_cache, v_cache, rng
 
         fn = jax.jit(step, donate_argnums=(1, 2))
         self._prefill_cache[key] = fn
@@ -161,56 +229,211 @@ class ModelRunner:
         self._decode_fn = jax.jit(step, donate_argnums=(1, 2))
         return self._decode_fn
 
+    def _get_window(self, window: int, bucket_pages: int):
+        key = (window, bucket_pages)
+        fn = self._window_cache.get(key)
+        if fn is not None:
+            return fn
+        spec = self.spec
+
+        def run_window(params, k_cache, v_cache, tokens_dev, packed, rng):
+            mask = packed[:, PK_OVERRIDE] > 0
+            tokens = jnp.where(mask, packed[:, PK_TOKEN], tokens_dev)
+            positions = packed[:, PK_POS]
+            seq_lens = packed[:, PK_SEQLEN]
+            top_k = packed[:, PK_TOPK]
+            temp = jax.lax.bitcast_convert_type(packed[:, PK_TEMP],
+                                                jnp.float32)
+            top_p = jax.lax.bitcast_convert_type(packed[:, PK_TOPP],
+                                                 jnp.float32)
+            cap = packed[:, PK_CAP]
+            page_table = packed[:, PK_PREFIX:]
+
+            def step(carry, _):
+                k_cache, v_cache, tokens, positions, seq_lens, rng = carry
+                # A slot advances only while live AND within its allocated
+                # pages; at capacity it freezes in-graph (scatters go to the
+                # scratch page; the host emits LENGTH when it sees the cap).
+                live = (seq_lens > 0) & (positions < cap)
+                logits, k_cache, v_cache = decode_forward(
+                    params, spec, k_cache, v_cache, tokens, positions,
+                    page_table, seq_lens,
+                    attention_impl=self._attention_impl, write_mask=live)
+                rng, sub = jax.random.split(rng)
+                sampled = sample_tokens(logits, temp, top_k, top_p, sub)
+                adv = live.astype(jnp.int32)
+                tokens = jnp.where(live, sampled, tokens)
+                positions = positions + adv
+                seq_lens = seq_lens + adv
+                return (k_cache, v_cache, tokens, positions, seq_lens,
+                        rng), sampled
+
+            (k_cache, v_cache, tokens, _, _, rng), toks = jax.lax.scan(
+                step, (k_cache, v_cache, tokens, positions, seq_lens, rng),
+                None, length=window)
+            return toks, tokens, k_cache, v_cache, rng
+
+        fn = jax.jit(run_window, donate_argnums=(1, 2))
+        self._window_cache[key] = fn
+        return fn
+
     # -- public API (blocking; called from the engine thread) -----------------
+    def prefill_batch(self, seqs: list[PrefillSeq]) -> np.ndarray:
+        """Prefill a batch of chunks (same compiled program per
+        (bucket, padded-batch, with_history) key); returns sampled first
+        tokens [len(seqs)].
+
+        All rows must agree on with-history-ness; rows are padded to the next
+        batch bucket (1,2,4,8) with inactive rows.
+        """
+        cfg = self.config
+        page = cfg.page_size
+        n_max = max(len(s.tokens) for s in seqs)
+        bucket = cfg.bucket_for(n_max)
+        bucket_pages = bucket // page
+        with_history = any(s.hist_pages is not None and len(s.hist_pages)
+                           for s in seqs)
+        bp = 1
+        while bp < len(seqs):
+            bp *= 2
+        maxp = cfg.max_pages_per_seq
+        width = _PF_HDR + bucket + bucket_pages + (maxp if with_history else 0)
+        packed = np.zeros((bp, width), np.int32)
+        for i, s in enumerate(seqs):
+            n = len(s.tokens)
+            packed[i, 0] = s.start_pos
+            packed[i, 1] = n
+            temp, top_k, top_p = s.sampling
+            packed[i, 3] = np.float32(temp).view(np.int32)
+            packed[i, 4] = top_k
+            packed[i, 5] = np.float32(top_p).view(np.int32)
+            packed[i, _PF_HDR:_PF_HDR + n] = s.tokens
+            # Pad page-table rows stay 0 = the allocator's RESERVED scratch
+            # page, so padded block scatters land there — padding with a
+            # live page would create duplicate scatter indices whose XLA
+            # write order is unspecified.
+            packed[i, _PF_HDR + bucket:
+                   _PF_HDR + bucket + len(s.chunk_pages)] = s.chunk_pages
+            if with_history and s.hist_pages is not None and len(s.hist_pages):
+                off = _PF_HDR + bucket + bucket_pages
+                packed[i, off:off + len(s.hist_pages)] = s.hist_pages
+                packed[i, 2] = s.start_pos
+        fn = self._get_prefill(bucket, bp, with_history)
+        with self.mesh:
+            sampled, logits, self.k_cache, self.v_cache, self._rng = fn(
+                self.params, self.k_cache, self.v_cache, jnp.asarray(packed),
+                self._rng)
+        # Device handle (no transfer unless a caller converts it).
+        self.last_prefill_logits = logits
+        return np.asarray(jax.device_get(sampled))[:len(seqs)]
+
     def prefill(self, tokens: np.ndarray, start_pos: int,
                 chunk_pages: np.ndarray, hist_pages: np.ndarray | None,
                 sampling: tuple[float, int, float]) -> tuple[int, jax.Array]:
-        """Prefill one chunk of one sequence; returns (sampled_token, logits).
+        """Single-sequence prefill chunk; returns (sampled_token,
+        last-position logits [1,V])."""
+        seq = PrefillSeq(tokens=np.asarray(tokens, np.int32),
+                         start_pos=start_pos,
+                         chunk_pages=np.asarray(chunk_pages, np.int32),
+                         hist_pages=hist_pages, sampling=sampling)
+        token = int(self.prefill_batch([seq])[0])
+        return token, self.last_prefill_logits[:1]
 
-        tokens: [n] the chunk's tokens; start_pos: absolute position of
-        tokens[0]; chunk_pages: pages covering the chunk; hist_pages: pages of
-        the context before the chunk (None = fresh prompt).
+    def bucket_pages_for(self, needed: int) -> int:
+        """Page-table width bucket (power of two, >= 8) for the decode
+        window."""
+        b = 8
+        maxp = self.config.max_pages_per_seq
+        while b < needed and b < maxp:
+            b *= 2
+        return min(b, maxp)
+
+    def decode_window(self, packed: np.ndarray, window: int):
+        """Dispatch one M-step decode window.
+
+        packed [B, PK_PREFIX + bucket_pages] int32 (see PK_* columns).
+        Returns the [M,B] sampled-token device array (fetch with
+        np.asarray when needed; start an async copy early via
+        .copy_to_host_async()).
         """
-        cfg = self.config
-        n = len(tokens)
-        bucket = cfg.bucket_for(n)
-        page = cfg.page_size
-        bucket_pages = bucket // page
-        tok = np.zeros((1, bucket), np.int32)
-        tok[0, :n] = tokens
-        pos = np.zeros((1, bucket), np.int32)
-        pos[0, :n] = np.arange(start_pos, start_pos + n)
-        pos[0, n:] = start_pos + n - 1  # harmless pad positions
-        # Pad rows stay 0 = the allocator's RESERVED scratch page, so padded
-        # block scatters land there — padding with a live page would create
-        # duplicate scatter indices whose XLA write order is unspecified.
-        ptab = np.zeros((1, bucket_pages), np.int32)
-        ptab[0, :len(chunk_pages)] = chunk_pages
-        lens = np.array([n], np.int32)
-        with_history = hist_pages is not None and len(hist_pages) > 0
-        maxp = cfg.max_pages_per_seq
-        htab = np.zeros((1, maxp), np.int32)
-        hlens = np.zeros((1,), np.int32)
-        if with_history:
-            htab[0, :len(hist_pages)] = hist_pages
-            hlens[0] = start_pos
-        fn = self._get_prefill(bucket, with_history)
+        bucket_pages = packed.shape[1] - PK_PREFIX
+        fn = self._get_window(window, bucket_pages)
         with self.mesh:
-            logits, self.k_cache, self.v_cache = fn(
-                self.params, self.k_cache, self.v_cache, tok, pos, ptab,
-                lens, htab, hlens)
-            temp, tk, tp = sampling
-            self._rng, sub = jax.random.split(self._rng)
-            sampled = sample_tokens(
-                logits, jnp.array([temp], jnp.float32),
-                jnp.array([tk], jnp.int32), jnp.array([tp], jnp.float32), sub)
-        return int(jax.device_get(sampled)[0]), logits
+            toks, self.tokens_dev, self.k_cache, self.v_cache, self._rng = fn(
+                self.params, self.k_cache, self.v_cache, self.tokens_dev,
+                jnp.asarray(packed), self._rng)
+        return toks
+
+    # -- KV page transfer (disaggregation data plane) -------------------------
+    def _get_extract(self, n: int):
+        key = ("extract", n)
+        fn = self._window_cache.get(key)
+        if fn is None:
+            def gather(k_cache, v_cache, pages):
+                return jnp.stack([k_cache[:, :, pages], v_cache[:, :, pages]])
+            fn = jax.jit(gather)
+            self._window_cache[key] = fn
+        return fn
+
+    def _get_insert(self, n: int):
+        key = ("insert", n)
+        fn = self._window_cache.get(key)
+        if fn is None:
+            def scatter(k_cache, v_cache, kv, pages):
+                k_cache = k_cache.at[:, :, pages].set(kv[0])
+                v_cache = v_cache.at[:, :, pages].set(kv[1])
+                return k_cache, v_cache
+            fn = jax.jit(scatter, donate_argnums=(0, 1))
+            self._window_cache[key] = fn
+        return fn
+
+    @staticmethod
+    def _page_bucket(n: int) -> int:
+        b = 8
+        while b < n:
+            b *= 2
+        return b
+
+    def extract_pages(self, pages: list[int]) -> np.ndarray:
+        """Gather the given pages' K/V to host: [2, L, Nkv, n, page, D]
+        (bf16). The disaggregation data plane's source side (role of the
+        reference's NIXL reads, host-staged v0 — SURVEY.md §5.8)."""
+        n = len(pages)
+        nb = self._page_bucket(n)
+        idx = np.zeros(nb, np.int32)  # pad rows gather the scratch page
+        idx[:n] = pages
+        with self.mesh:
+            out = self._get_extract(nb)(self.k_cache, self.v_cache,
+                                        jnp.asarray(idx))
+        return np.asarray(jax.device_get(out))[:, :, :, :n]
+
+    def insert_pages(self, kv: np.ndarray, pages: list[int]) -> None:
+        """Write transferred K/V pages into this runner's cache. kv
+        [2, L, Nkv, n, page, D]; the mesh re-shards on upload, so
+        TP-mismatched prefill->decode transfers work without a transpose
+        kernel (the role of block_copy.cu)."""
+        n = len(pages)
+        assert kv.shape[3] == n, (kv.shape, n)
+        nb = self._page_bucket(n)
+        if nb != n:
+            # Pad with copies of the scratch page target (duplicate scatters
+            # to page 0 are unordered but all-garbage).
+            pad_kv = np.zeros(
+                (*kv.shape[:3], nb - n, *kv.shape[4:]), kv.dtype)
+            kv = np.concatenate([kv, pad_kv], axis=3)
+        idx = np.zeros(nb, np.int32)
+        idx[:n] = pages
+        with self.mesh:
+            self.k_cache, self.v_cache = self._get_insert(nb)(
+                self.k_cache, self.v_cache, jnp.asarray(kv),
+                jnp.asarray(idx))
 
     def decode(self, tokens: np.ndarray, positions: np.ndarray,
                page_table: np.ndarray, seq_lens: np.ndarray,
                temperature: np.ndarray, top_k: np.ndarray,
                top_p: np.ndarray) -> np.ndarray:
-        """One decode step over the slot batch; returns sampled tokens [B]."""
+        """One decode step over the slot batch; returns sampled tokens [B].
+        (Kept for tests/dryrun; the serving engine uses decode_window.)"""
         fn = self._get_decode()
         with self.mesh:
             sampled, self.k_cache, self.v_cache, self._rng = fn(

@@ -1,0 +1,70 @@
+"""KV parcel serialization for disaggregated prefill->decode transfer.
+
+The host-staged v0 data plane (SURVEY.md §5.8): the prefill worker extracts
+the prompt's KV pages ([2, L, Nkv, n_pages, page, D] bf16), serializes them,
+and streams them INLINE over the request plane as chunked response frames —
+the role NIXL RDMA plays in the reference (lib/llm/src/block_manager/storage/
+nixl.rs; vllm handlers.py kv_transfer_params). A device-to-device ICI path
+(jax.experimental.transfer) can replace the wire format transparently later:
+the metadata contract (shape + dtype + chunk count) stays.
+
+TP-mismatch handling: the parcel is the FULL unsharded KV — the decode
+worker's mesh re-shards on upload (runner.insert_pages), so 1-TP prefill ->
+2-TP decode works without the reference's block_copy.cu transpose kernel.
+"""
+
+from __future__ import annotations
+
+from typing import AsyncIterator
+
+import numpy as np
+
+CHUNK_BYTES = 8 << 20  # 8 MiB response frames
+
+_DTYPES = {"bfloat16": None, "float32": np.float32, "float16": np.float16}
+
+
+def _bf16():
+    import ml_dtypes
+
+    return ml_dtypes.bfloat16
+
+
+def kv_to_chunks(kv: np.ndarray) -> tuple[dict, list[bytes]]:
+    """Serialize a KV parcel: returns (meta, chunk list)."""
+    raw = np.ascontiguousarray(kv).tobytes()
+    chunks = [raw[i:i + CHUNK_BYTES] for i in range(0, len(raw), CHUNK_BYTES)]
+    if not chunks:
+        chunks = [b""]
+    meta = {"shape": list(kv.shape), "dtype": str(kv.dtype),
+            "n_chunks": len(chunks)}
+    return meta, chunks
+
+
+def kv_from_chunks(meta: dict, chunks: list[bytes]) -> np.ndarray:
+    assert len(chunks) == meta["n_chunks"], (len(chunks), meta)
+    dtype = (_bf16() if meta["dtype"] == "bfloat16"
+             else np.dtype(meta["dtype"]))
+    raw = b"".join(chunks)
+    return np.frombuffer(raw, dtype=dtype).reshape(meta["shape"])
+
+
+async def collect_prefill_response(stream: AsyncIterator[dict]
+                                   ) -> tuple[int, np.ndarray]:
+    """Assemble a prefill worker's chunked response into
+    (first_token, kv parcel)."""
+    chunks: list[bytes] = []
+    meta = None
+    first_token = None
+    async for out in stream:
+        dp = out.get("disagg_params") or {}
+        if "kv_chunk" in dp:
+            chunks.append(dp["kv_chunk"])
+        if "shape" in dp:
+            meta = dp
+        toks = out.get("token_ids") or []
+        if toks:
+            first_token = toks[0]
+    if meta is None or first_token is None:
+        raise RuntimeError("incomplete disaggregated prefill response")
+    return first_token, kv_from_chunks(meta, chunks)

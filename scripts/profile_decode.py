@@ -1,0 +1,74 @@
+"""Decompose the decode-step time on the real chip: forward-only vs sampler
+vs full step, and the attention gather cost vs maxp. Run on TPU."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.config import EngineConfig, PRESETS
+from dynamo_tpu.engine.model import (
+    decode_forward, init_params, paged_decode_attention_xla)
+from dynamo_tpu.engine.sampler import sample_tokens
+
+
+def timeit(fn, *args, n=20):
+    fn(*args)  # warm
+    jax.block_until_ready(fn(*args))
+    t0 = time.monotonic()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.monotonic() - t0) / n * 1e3
+
+
+def main():
+    spec = PRESETS["qwen2.5-0.5b"]
+    batch, maxp, page = 32, 64, 16
+    num_pages = batch * maxp + 16
+    params = init_params(spec, jax.random.key(0))
+    kv_shape = (spec.num_layers, spec.num_kv_heads, num_pages, page,
+                spec.head_dim)
+    k = jnp.zeros(kv_shape, jnp.bfloat16)
+    v = jnp.zeros(kv_shape, jnp.bfloat16)
+    tokens = jnp.zeros((batch,), jnp.int32)
+    positions = jnp.full((batch,), 128, jnp.int32)
+    pt = np.zeros((batch, maxp), np.int32)
+    for b in range(batch):
+        pt[b] = np.arange(1 + b * maxp, 1 + (b + 1) * maxp)
+    page_table = jnp.asarray(pt)
+    seq_lens = jnp.full((batch,), 129, jnp.int32)
+    temp = jnp.zeros((batch,), jnp.float32)
+    top_k = jnp.zeros((batch,), jnp.int32)
+    top_p = jnp.ones((batch,), jnp.float32)
+    rng = jax.random.key(1)
+
+    fwd = jax.jit(lambda p, k, v: decode_forward(
+        p, spec, k, v, tokens, positions, page_table, seq_lens,
+        attention_impl=paged_decode_attention_xla)[0])
+    print("forward only (logits):", round(timeit(fwd, params, k, v), 2), "ms")
+
+    logits = fwd(params, k, v)
+    samp = jax.jit(lambda lg, r: sample_tokens(lg, temp, top_k, top_p, r))
+    print("sampler only:", round(timeit(samp, logits, rng), 2), "ms")
+
+    # Attention gather alone at this maxp.
+    q = jnp.zeros((batch, spec.num_heads, spec.head_dim), jnp.bfloat16)
+    att = jax.jit(lambda q, kk: paged_decode_attention_xla(
+        q, kk[0], kk[0], page_table, seq_lens, spec.q_per_kv))
+    print("xla paged attn, 1 layer:", round(timeit(att, q, k), 2), "ms")
+
+    # Pallas kernel attempt at D=64.
+    try:
+        from dynamo_tpu.engine.attention import paged_decode_attention_pallas
+        attp = jax.jit(lambda q, kk: paged_decode_attention_pallas(
+            q, kk[0], kk[0], page_table, seq_lens, spec.q_per_kv))
+        print("pallas paged attn, 1 layer:", round(timeit(attp, q, k), 2),
+              "ms")
+    except Exception as e:  # noqa: BLE001
+        print("pallas D=64 failed:", type(e).__name__, str(e)[:300])
+
+
+if __name__ == "__main__":
+    main()

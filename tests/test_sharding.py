@@ -47,6 +47,7 @@ def run_steps(runner):
     prompt = (np.arange(1, 21, dtype=np.int32) * 13) % SPEC.vocab_size
     token, logits = runner.prefill(prompt, 0, np.array([1, 2], np.int32),
                                    None, (0.0, 0, 1.0))
+    assert logits is not None and logits.shape == (1, SPEC.vocab_size)
     tokens = np.array([token, 0, 0, 0], np.int32)
     positions = np.array([20, 0, 0, 0], np.int32)
     page_table = np.zeros((4, 8), np.int32)
