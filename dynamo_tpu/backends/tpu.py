@@ -12,6 +12,7 @@ streams it back as a chunked parcel + first token); ``--mode decode``
 conditionally forwards long prompts to discovered prefill workers
 (``--max-local-prefill-length``, reference disagg_router.rs:25-45), injects
 the transferred KV, and decodes. ``--mode agg`` (default) is fully local.
+Handlers live in dynamo_tpu.llm.disagg; e2e-tested in tests/test_disagg.py.
 """
 
 from __future__ import annotations
@@ -52,6 +53,19 @@ def parse_args(argv=None) -> argparse.Namespace:
                         choices=["auto", "pallas", "xla"])
     parser.add_argument("--migration-limit", type=int, default=0)
     parser.add_argument("--coordinator-url", default=None)
+    parser.add_argument("--mode", default="agg",
+                        choices=["agg", "prefill", "decode"],
+                        help="agg = fully local; prefill = prefill-only "
+                             "worker (serves KV parcels); decode = decode "
+                             "worker forwarding long prompts to prefill "
+                             "workers")
+    parser.add_argument("--max-local-prefill-length", type=int, default=512,
+                        help="decode mode: prompts longer than this prefill "
+                             "remotely (conditional disaggregation; dynamic "
+                             "via the coordinator disagg/<model> key)")
+    parser.add_argument("--prefill-component", default=None,
+                        help="component name prefill workers serve under "
+                             "(default: 'prefill')")
     return parser.parse_args(argv)
 
 
@@ -96,20 +110,49 @@ async def run(args: argparse.Namespace) -> None:
             params = load_hf_weights(engine_cfg.model, args.model)
         engine = TPUEngine(engine_cfg, params=params, kv_publisher=kv_pub,
                            metrics_publisher=metrics_pub)
-        endpoint = (runtime.namespace(None).component(args.component)
-                    .endpoint(args.endpoint))
-        server = await endpoint.serve_endpoint(engine.handler(),
-                                               graceful_shutdown=False)
-        await register_llm(
-            runtime, endpoint, model_name, tokenizer,
-            context_length=engine_cfg.max_model_len,
-            kv_cache_block_size=engine_cfg.page_size,
-            migration_limit=args.migration_limit,
-            runtime_config=ModelRuntimeConfig(
-                total_kv_blocks=engine.runner.num_pages,
-                max_num_seqs=engine_cfg.max_num_seqs))
+        from dynamo_tpu.llm.disagg import (
+            PREFILL_COMPONENT, PREFILL_ENDPOINT, DisaggDecodeHandler,
+            DisaggRouterConfig, make_prefill_handler)
+        prefill_component = args.prefill_component or PREFILL_COMPONENT
+        disagg_handler = None
+        if args.mode == "prefill":
+            # Prefill workers register under their own component so decode
+            # workers (not the frontend router) discover them; prefill
+            # drains gracefully on shutdown (reference vllm main.py:151-161).
+            endpoint = (runtime.namespace(None).component(prefill_component)
+                        .endpoint(PREFILL_ENDPOINT))
+            server = await endpoint.serve_endpoint(
+                make_prefill_handler(engine), graceful_shutdown=True)
+        elif args.mode == "decode":
+            prefill_ep = (runtime.namespace(None)
+                          .component(prefill_component)
+                          .endpoint(PREFILL_ENDPOINT))
+            prefill_client = await prefill_ep.client()
+            disagg_cfg = await DisaggRouterConfig.from_coordinator_with_watch(
+                runtime.require_coordinator(), model_name,
+                default_max_local=args.max_local_prefill_length)
+            disagg_handler = DisaggDecodeHandler(engine, prefill_client,
+                                                 disagg_cfg)
+            endpoint = (runtime.namespace(None).component(args.component)
+                        .endpoint(args.endpoint))
+            server = await endpoint.serve_endpoint(disagg_handler.handler(),
+                                                   graceful_shutdown=False)
+        else:
+            endpoint = (runtime.namespace(None).component(args.component)
+                        .endpoint(args.endpoint))
+            server = await endpoint.serve_endpoint(engine.handler(),
+                                                   graceful_shutdown=False)
+        if args.mode != "prefill":
+            await register_llm(
+                runtime, endpoint, model_name, tokenizer,
+                context_length=engine_cfg.max_model_len,
+                kv_cache_block_size=engine_cfg.page_size,
+                migration_limit=args.migration_limit,
+                runtime_config=ModelRuntimeConfig(
+                    total_kv_blocks=engine.runner.num_pages,
+                    max_num_seqs=engine_cfg.max_num_seqs))
         engine.start()
-        print(f"TPU_WORKER_READY port={server.port} "
+        print(f"TPU_WORKER_READY mode={args.mode} port={server.port} "
               f"worker={runtime.instance_id:x} pages={engine.runner.num_pages}",
               flush=True)
         import signal

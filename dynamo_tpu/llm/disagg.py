@@ -1,0 +1,175 @@
+"""Disaggregated prefill/decode serving: worker-side handlers + config.
+
+TPU-native version of the reference's disaggregation path (SURVEY.md call
+stack 3.3; components/backends/vllm/src/dynamo/vllm/handlers.py:113-199):
+
+- The PREFILL worker serves a prefill-only endpoint: it computes the
+  prompt's KV (engine.prefill_extract on the engine thread), samples the
+  first token, and streams the KV back as a chunked parcel
+  (llm/kv_transfer.py) — the host-staged stand-in for the reference's NIXL
+  GPU->GPU writes (handlers.py:167-199 PrefillWorkerHandler).
+- The DECODE worker conditionally forwards prompts longer than
+  ``max_local_prefill_length`` to a discovered prefill worker
+  (round-robin, like the reference's prefill_worker_client.round_robin at
+  handlers.py:148-152), assembles the parcel, uploads it into its own KV
+  pool (the mesh re-shards on upload, so TP-mismatched transfers work),
+  and decodes from the returned first token. Anything shorter — or any
+  remote failure — prefills locally (conditional disaggregation,
+  lib/llm/src/disagg_router.rs:25-45).
+
+The conditional threshold is dynamic: ``DisaggRouterConfig`` reads
+``disagg/<model>`` from the coordinator KV store and watches it for
+updates, mirroring DisaggRouterConf::from_etcd_with_watcher.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import AsyncIterator
+
+from dynamo_tpu.llm.kv_transfer import collect_prefill_response, kv_to_chunks
+from dynamo_tpu.llm.model_card import model_slug
+from dynamo_tpu.llm.protocols import LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.errors import (
+    EngineError, NoInstancesError, StreamIncompleteError)
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("disagg")
+
+DISAGG_CONFIG_ROOT = "disagg/"
+
+# Default component name prefill workers serve under (decode workers
+# discover them by this, namespaced like any endpoint).
+PREFILL_COMPONENT = "prefill"
+PREFILL_ENDPOINT = "generate"
+
+
+def disagg_config_key(model_name: str) -> str:
+    return f"{DISAGG_CONFIG_ROOT}{model_slug(model_name)}"
+
+
+class DisaggRouterConfig:
+    """Per-model conditional-disaggregation config, watchable from the
+    coordinator KV store (reference DisaggRouterConf,
+    disagg_router.rs:25-45: read once, then watched for updates)."""
+
+    def __init__(self, max_local_prefill_length: int = 512):
+        self.max_local_prefill_length = max_local_prefill_length
+        self._watch = None
+        self._task: asyncio.Task | None = None
+
+    def prefill_remote(self, prompt_len: int) -> bool:
+        return prompt_len > self.max_local_prefill_length
+
+    @classmethod
+    async def from_coordinator_with_watch(
+            cls, client, model_name: str,
+            default_max_local: int = 512) -> "DisaggRouterConfig":
+        cfg = cls(default_max_local)
+        key = disagg_config_key(model_name)
+        watch = await client.watch_prefix(key)
+        for item in watch.snapshot:
+            cfg._apply(item["v"])
+        cfg._watch = watch
+        cfg._task = asyncio.create_task(cfg._watch_loop())
+        return cfg
+
+    def _apply(self, value) -> None:
+        if isinstance(value, dict) and "max_local_prefill_length" in value:
+            self.max_local_prefill_length = int(
+                value["max_local_prefill_length"])
+            log.info("disagg config updated: max_local_prefill_length=%d",
+                     self.max_local_prefill_length)
+
+    async def _watch_loop(self) -> None:
+        async for event in self._watch:
+            if event["event"] == "put":
+                self._apply(event["value"])
+
+    async def close(self) -> None:
+        if self._task:
+            self._task.cancel()
+        if self._watch:
+            await self._watch.cancel()
+
+
+def make_prefill_handler(engine):
+    """Prefill-worker endpoint handler: prompt in, (KV parcel + first
+    token) out as a chunked response stream.
+
+    Frame contract (consumed by collect_prefill_response): one frame with
+    the parcel meta {shape, dtype, n_chunks}, n_chunks frames each with a
+    kv_chunk bytes payload, and a final frame carrying the sampled first
+    token — the role of the reference's kv_transfer_params response
+    (handlers.py:195-199)."""
+
+    async def handle(request, context: Context) -> AsyncIterator[dict]:
+        req = (request if isinstance(request, PreprocessedRequest)
+               else PreprocessedRequest.from_wire(request))
+        first_token, kv, prompt_len = await engine.run_job(
+            lambda: engine.prefill_extract(req))
+        meta, chunks = kv_to_chunks(kv)
+        meta["prompt_len"] = prompt_len
+        yield LLMEngineOutput(disagg_params=meta).to_wire()
+        for chunk in chunks:
+            if context.is_killed or context.is_stopped:
+                return
+            yield LLMEngineOutput(
+                disagg_params={"kv_chunk": chunk}).to_wire()
+        yield LLMEngineOutput(token_ids=[first_token]).to_wire()
+
+    return handle
+
+
+class DisaggDecodeHandler:
+    """Decode-worker handler with conditional remote prefill (reference
+    DecodeWorkerHandler, handlers.py:113-162)."""
+
+    def __init__(self, engine, prefill_client, config: DisaggRouterConfig):
+        self.engine = engine
+        self.prefill_client = prefill_client
+        self.config = config
+        # Telemetry for tests + metrics.
+        self.remote_prefills = 0
+        self.local_prefills = 0
+        self.remote_failures = 0
+
+    def handler(self):
+        async def handle(request, context):
+            async for out in self.generate(request, context):
+                yield out
+        return handle
+
+    async def generate(self, request, context: Context) -> AsyncIterator[dict]:
+        req = (request if isinstance(request, PreprocessedRequest)
+               else PreprocessedRequest.from_wire(request))
+        if self.config.prefill_remote(len(req.token_ids)):
+            injected = await self._remote_prefill(req, context)
+            if injected is not None:
+                self.remote_prefills += 1
+                first_token, kv = injected
+                async for out in self.engine.generate_injected(
+                        req, context, first_token, kv):
+                    yield out
+                return
+        self.local_prefills += 1
+        async for out in self.engine.generate(req, context):
+            yield out
+
+    async def _remote_prefill(self, req: PreprocessedRequest,
+                              context: Context):
+        """Forward the prompt to a prefill worker; returns
+        (first_token, kv parcel) or None to fall back to local prefill
+        (any remote failure degrades to aggregated serving, never fails
+        the request)."""
+        try:
+            stream = await self.prefill_client.round_robin(
+                req.to_wire(), context=context)
+            return await collect_prefill_response(stream)
+        except (NoInstancesError, StreamIncompleteError, EngineError,
+                ConnectionError, OSError, RuntimeError) as exc:
+            self.remote_failures += 1
+            log.warning("remote prefill failed (%s: %s); prefilling locally",
+                        type(exc).__name__, exc)
+            return None
