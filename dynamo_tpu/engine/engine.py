@@ -19,6 +19,7 @@ kept) and the request is requeued to re-prefill from its accumulated tokens
 from __future__ import annotations
 
 import asyncio
+import collections
 import concurrent.futures
 import dataclasses
 import queue
@@ -33,6 +34,7 @@ from dynamo_tpu.engine.kv_cache import PageAllocator
 from dynamo_tpu.engine.runner import (
     ModelRunner, PrefillSeq, PK_OVERRIDE, PK_TOKEN, PK_POS, PK_SEQLEN,
     PK_TOPK, PK_TEMP, PK_TOPP, PK_CAP, PK_PREFIX)
+from dynamo_tpu.engine.sampler import MAX_TOPK
 from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics, KvStats, WorkerStats
 from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput, PreprocessedRequest
 from dynamo_tpu.llm.tokens import TokenBlockSequence
@@ -104,6 +106,10 @@ class TPUEngine(AsyncEngine):
         self.step_count = 0
         self.prefix_hit_blocks = 0
         self.prefix_lookup_blocks = 0
+        self.preempt_count = 0
+        # Recent victims (bounded; observability + tests).
+        self.preempted_ids: collections.deque[str] = collections.deque(
+            maxlen=64)
 
     # -- lifecycle ------------------------------------------------------------
     def start(self) -> None:
@@ -125,16 +131,30 @@ class TPUEngine(AsyncEngine):
             self._thread = None
 
     # -- AsyncEngine ----------------------------------------------------------
-    async def generate(self, request, context: Context) -> AsyncIterator[dict]:
-        self.start()
-        req = (request if isinstance(request, PreprocessedRequest)
-               else PreprocessedRequest.from_wire(request))
+    def _validate(self, req: PreprocessedRequest) -> None:
         if not req.token_ids:
             raise ValueError("empty token_ids")
         if len(req.token_ids) >= self.config.max_model_len:
             raise ValueError(
                 f"prompt length {len(req.token_ids)} exceeds max model len "
                 f"{self.config.max_model_len}")
+        s = req.sampling_options
+        if s.top_k and s.top_k > MAX_TOPK:
+            # The sampler prefilters to the top-MAX_TOPK candidates (no
+            # full-vocab sort on TPU) — top-k beyond that, and the top-p
+            # nucleus, operate within those candidates. Clamp visibly
+            # rather than silently truncating inside the kernel.
+            log.warning(
+                "top_k=%d exceeds sampler cap %d; clamping (top-k/top-p "
+                "sample among the top-%d logits)", s.top_k, MAX_TOPK,
+                MAX_TOPK)
+            s.top_k = MAX_TOPK
+
+    async def generate(self, request, context: Context) -> AsyncIterator[dict]:
+        self.start()
+        req = (request if isinstance(request, PreprocessedRequest)
+               else PreprocessedRequest.from_wire(request))
+        self._validate(req)
         r = _Request(req=req, ctx=context, out_q=asyncio.Queue(),
                      loop=asyncio.get_running_loop(),
                      tokens_all=list(req.token_ids))
@@ -158,6 +178,7 @@ class TPUEngine(AsyncEngine):
         self.start()
         req = (request if isinstance(request, PreprocessedRequest)
                else PreprocessedRequest.from_wire(request))
+        self._validate(req)
         r = _Request(req=req, ctx=context, out_q=asyncio.Queue(),
                      loop=asyncio.get_running_loop(),
                      tokens_all=list(req.token_ids),
@@ -202,6 +223,7 @@ class TPUEngine(AsyncEngine):
         host. Returns (first_token, kv [2,L,Nkv,n,page,D], prompt_len) —
         the disaggregated prefill side (reference PrefillWorkerHandler,
         handlers.py:167-199)."""
+        self._validate(req)
         r = _Request(req=req, ctx=Context(), out_q=None, loop=None,  # type: ignore[arg-type]
                      tokens_all=list(req.token_ids))
         plan = self._plan_prefill(r)
@@ -475,11 +497,17 @@ class TPUEngine(AsyncEngine):
         M = cfg.decode_window
         b = cfg.max_num_seqs
         frozen: dict[int, tuple] = {}
+        stalled: set[int] = set()
+        deficits: dict[int, int] = {}
         needed_max = 1
-        n_live = sum(1 for r in self.slot_req if r is not None)
-        for i, r in enumerate(self.slot_req):
-            if r is None:
-                continue
+        live = [i for i, r in enumerate(self.slot_req) if r is not None]
+        n_live = len(live)
+        # Allocate pages oldest-request-first (requeued requests keep their
+        # original enqueue time, so they age past new arrivals — no
+        # starvation).
+        order = sorted(live, key=lambda j: self.slot_req[j].enqueue_t)
+        for i in order:
+            r = self.slot_req[i]
             last_pos = int(self.disp_positions[i]) + M - 1
             # Clamp to the model-length cap: the slot decodes up to its
             # allocated capacity within the window and freezes in-graph
@@ -493,18 +521,42 @@ class TPUEngine(AsyncEngine):
                     break
                 r.pages.extend(new)
             if not ok:
-                # Preempt-and-requeue, unless this is the only live slot (the
-                # pool is simply too small for the request: fail it).
-                frozen[i] = (r, r.epoch, "requeue" if n_live > 1 else "oom")
+                if n_live == 1:
+                    # Only live slot: the pool is simply too small — fail it.
+                    frozen[i] = (r, r.epoch, "oom")
+                else:
+                    deficits[i] = needed - len(r.pages)
+                    stalled.add(i)
                 continue
             needed_max = max(needed_max, len(r.pages))
-        active_rows = [i for i, r in enumerate(self.slot_req)
-                       if r is not None and i not in frozen]
-        # A slot frozen at the PREVIOUS dispatch whose allocation now
-        # succeeded is live again: cancel the pending preemption record so
-        # processing the previous window doesn't spuriously requeue it.
+        if deficits:
+            # Preempt the YOUNGEST live slots (vLLM preempt-the-youngest
+            # semantics) until the pages they will free (released after the
+            # in-flight window completes) cover what older slots still need.
+            # The under-allocated older slots STALL this window — they keep
+            # all state (pages, device token chain, pending override) and
+            # retry next dispatch — rather than being preempted themselves.
+            # The very oldest slot is never a victim.
+            freed = 0
+            want = sum(deficits.values())
+            for j in reversed(order[1:]):
+                if freed >= want:
+                    break
+                if j in frozen:
+                    continue
+                r_j = self.slot_req[j]
+                want -= deficits.pop(j, 0)  # a victim needs no pages
+                stalled.discard(j)
+                frozen[j] = (r_j, r_j.epoch, "requeue")
+                freed += len(r_j.pages)
+        active_rows = [i for i in live if i not in frozen and i not in stalled]
+        # A slot frozen at the PREVIOUS dispatch that this dispatch decided
+        # to keep (allocation succeeded, or it merely stalls) is live again:
+        # cancel the pending preemption record so processing the previous
+        # window doesn't spuriously requeue or oom-fail it — this dispatch's
+        # decision supersedes the previous one.
         if self._inflight is not None:
-            for i in active_rows:
+            for i in (*active_rows, *stalled):
                 self._inflight.frozen.pop(i, None)
         if not active_rows:
             return _Window(toks=None, slots=[None] * b, frozen=frozen, size=M)
@@ -648,6 +700,8 @@ class TPUEngine(AsyncEngine):
             r.push(LLMEngineOutput(
                 token_ids=[], finish_reason=FinishReason.CANCELLED).to_wire())
             return
+        self.preempt_count += 1
+        self.preempted_ids.append(r.ctx.id)
         log.warning("KV pool exhausted: preempting slot %d (request %s, "
                     "%d tokens so far) and requeueing", slot, r.ctx.id,
                     len(r.tokens_all))

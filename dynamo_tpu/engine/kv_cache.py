@@ -7,6 +7,13 @@ the reference's KVBM block lifecycle (lib/llm/src/block_manager: active pool /
 inactive reusable pool / LRU eviction): pages of finished sequences stay
 registered under their chained block hash and are reused on prefix hits until
 evicted. Emits stored/removed block hashes for the router's index.
+
+Lifecycle invariant (reference block_manager/pool/managed.rs): a page is
+either FREE (unregistered, refcount 0), ACTIVE (refcount > 0 — held by one
+or more live sequences; may also be registered for sharing), or INACTIVE
+(registered, refcount 0 — reusable on a prefix hit, evictable LRU).
+Only INACTIVE pages may be evicted: evicting a page a live sequence still
+writes to would silently corrupt its KV.
 """
 
 from __future__ import annotations
@@ -28,9 +35,12 @@ class PageAllocator:
         self.num_pages = num_pages - 1  # page 0 reserved
         self.page_size = page_size
         self.free: list[int] = list(range(num_pages - 1, 0, -1))
-        # Reusable (inactive but cached) pages: block_hash -> page id, LRU.
-        self.cached: OrderedDict[int, int] = OrderedDict()
+        # All registered blocks: block_hash -> page id.
+        self.cached: dict[int, int] = {}
         self.cached_by_page: dict[int, int] = {}
+        # INACTIVE subset (registered AND refcount 0) in LRU order — the
+        # only pages eviction may take.
+        self.inactive: OrderedDict[int, int] = OrderedDict()
         # Active references: page id -> refcount.
         self.refs: dict[int, int] = {}
         # Router event buffers.
@@ -40,7 +50,7 @@ class PageAllocator:
     # -- queries --------------------------------------------------------------
     @property
     def num_free(self) -> int:
-        return len(self.free) + len(self.cached)
+        return len(self.free) + len(self.inactive)
 
     @property
     def num_active(self) -> int:
@@ -58,8 +68,9 @@ class PageAllocator:
 
     # -- allocation -----------------------------------------------------------
     def allocate(self, count: int) -> list[int] | None:
-        """Allocate ``count`` fresh pages (evicting LRU cached pages as
-        needed). None if impossible."""
+        """Allocate ``count`` fresh pages (evicting LRU *inactive* cached
+        pages as needed — never a page a live sequence holds). None if
+        impossible."""
         if self.num_free < count:
             return None
         out = []
@@ -67,11 +78,14 @@ class PageAllocator:
             if self.free:
                 page = self.free.pop()
             else:
-                # Evict least-recently-used cached page.
-                h, page = self.cached.popitem(last=False)
+                # Evict least-recently-used inactive page.
+                h, page = self.inactive.popitem(last=False)
+                del self.cached[h]
                 del self.cached_by_page[page]
                 self.removed_events.append(h)
-            self.refs[page] = self.refs.get(page, 0) + 1
+            assert page not in self.refs, \
+                f"allocator invariant violated: page {page} already active"
+            self.refs[page] = 1
             out.append(page)
         return out
 
@@ -82,9 +96,9 @@ class PageAllocator:
             page = self.cached.get(h)
             if page is None:
                 break
-            # Move from inactive to active (stays in cached map for other
-            # sequences to share — refcount tracks active users).
-            self.cached.move_to_end(h)
+            # Inactive -> active (stays registered so other sequences can
+            # share — refcount tracks active users).
+            self.inactive.pop(h, None)
             self.refs[page] = self.refs.get(page, 0) + 1
             pages.append(page)
         return pages
@@ -98,12 +112,15 @@ class PageAllocator:
             return
         if existing is not None:
             self.cached.pop(existing, None)
+            self.inactive.pop(existing, None)
             self.removed_events.append(existing)
         if block_hash in self.cached:
             # Another page already holds this block; keep the older one.
             return
         self.cached[block_hash] = page
         self.cached_by_page[page] = block_hash
+        if page not in self.refs:
+            self.inactive[block_hash] = page
         self.stored_events.append(block_hash)
 
     def unregister(self, pages: list[int]) -> None:
@@ -113,11 +130,15 @@ class PageAllocator:
             h = self.cached_by_page.pop(page, None)
             if h is not None:
                 self.cached.pop(h, None)
+                self.inactive.pop(h, None)
                 self.removed_events.append(h)
+                if page not in self.refs:
+                    self.free.append(page)
 
     def release(self, pages: list[int]) -> None:
         """Drop one active reference; unreferenced unregistered pages return
-        to the free list, registered ones stay cached for reuse."""
+        to the free list, registered ones become inactive (reusable LRU,
+        most-recently-released last)."""
         for page in pages:
             ref = self.refs.get(page)
             if ref is None:
@@ -126,8 +147,12 @@ class PageAllocator:
                 self.refs[page] = ref - 1
                 continue
             del self.refs[page]
-            if page not in self.cached_by_page:
+            h = self.cached_by_page.get(page)
+            if h is None:
                 self.free.append(page)
+            else:
+                self.inactive[h] = page
+                self.inactive.move_to_end(h)
 
     def drain_events(self) -> tuple[list[int], list[int]]:
         stored, self.stored_events = self.stored_events, []

@@ -135,6 +135,16 @@ async def register_llm(
     # dedups by model name (reference keys entries by lease id too).
     key = f"{MODEL_ROOT}{model_slug(model_name)}/{runtime.instance_id:x}"
     await client.kv_put(key, entry.to_wire(), use_primary_lease=True)
+
+    # The card rides the primary lease: if the lease expires (e.g. the
+    # process stalls past the TTL during engine compilation) the coordinator
+    # deletes it — re-put on re-grant so the model doesn't silently vanish
+    # from discovery (the endpoint instance re-registers the same way,
+    # runtime/service.py).
+    async def _reput(_new_lease_id: int) -> None:
+        await client.kv_put(key, entry.to_wire(), use_primary_lease=True)
+
+    client.on_lease_recreated(_reput)
     return entry
 
 

@@ -1,0 +1,89 @@
+"""PageAllocator lifecycle tests.
+
+Regression for the round-3 corruption find: eviction must only take
+INACTIVE pages (registered, refcount 0) — never a page a live sequence
+still holds, even if that page is registered in the prefix cache
+(reference block lifecycle, lib/llm/src/block_manager/pool/managed.rs).
+"""
+
+from dynamo_tpu.engine.kv_cache import PageAllocator
+
+
+def test_basic_alloc_release_cycle():
+    a = PageAllocator(num_pages=5, page_size=16)  # 4 usable (page 0 scratch)
+    pages = a.allocate(4)
+    assert len(pages) == 4 and 0 not in pages
+    assert a.allocate(1) is None
+    a.release(pages)
+    assert a.num_free == 4
+
+
+def test_active_registered_page_never_evicted():
+    """A live sequence's registered page must not be evicted and handed to
+    another allocation (would double-assign the page -> KV corruption)."""
+    a = PageAllocator(num_pages=4, page_size=16)  # 3 usable
+    held = a.allocate(2)
+    # The live request's completed blocks get registered mid-flight.
+    a.register(held[0], 111)
+    a.register(held[1], 222)
+    third = a.allocate(1)
+    assert third is not None
+    # Pool is now truly exhausted: held pages are active+registered, the
+    # third is active. Nothing is evictable.
+    assert a.allocate(1) is None
+    assert a.num_free == 0
+    assert set(held).isdisjoint(set(third))
+
+
+def test_inactive_page_evicted_lru():
+    a = PageAllocator(num_pages=4, page_size=16)
+    p = a.allocate(3)
+    a.register(p[0], 1)
+    a.register(p[1], 2)
+    a.register(p[2], 3)
+    a.release(p)  # all inactive now, LRU order: 1, 2, 3
+    assert a.num_free == 3
+    # Touch hash 1 (acquire + release) -> becomes most recent.
+    got = a.acquire_cached([1])
+    assert got == [p[0]]
+    a.release(got)
+    fresh = a.allocate(2)  # evicts 2 then 3, not 1
+    assert set(fresh) == {p[1], p[2]}
+    assert a.lookup([1]) == [p[0]]
+    assert a.lookup([2]) == []
+
+
+def test_shared_prefix_refcounting():
+    a = PageAllocator(num_pages=4, page_size=16)
+    p = a.allocate(1)
+    a.register(p[0], 7)
+    # Second sequence pins the same block.
+    q = a.acquire_cached([7])
+    assert q == p
+    a.release(p)  # first seq done; still held by second
+    assert a.allocate(3) is None  # page not reusable yet: 2 free + p active
+    a.release(q)
+    assert a.num_free == 3
+
+
+def test_unregister_returns_inactive_page_to_free():
+    a = PageAllocator(num_pages=3, page_size=16)
+    p = a.allocate(1)
+    a.register(p[0], 9)
+    a.release(p)
+    assert a.num_free == 2
+    a.unregister(p)
+    assert a.lookup([9]) == []
+    got = a.allocate(2)
+    assert p[0] in got
+
+
+def test_failed_request_unregister_then_release():
+    """Engine failure path: unregister while still held, release later —
+    page must come back exactly once."""
+    a = PageAllocator(num_pages=3, page_size=16)
+    p = a.allocate(2)
+    a.register(p[0], 5)
+    a.unregister(p)   # contents suspect; still referenced
+    a.release(p)      # deferred release
+    assert sorted(a.allocate(2)) == sorted(p)
