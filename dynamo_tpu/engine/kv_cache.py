@@ -111,11 +111,18 @@ class PageAllocator:
         if existing == block_hash:
             return
         if existing is not None:
+            # The page's content no longer matches its old hash: drop the
+            # stale registration entirely.
+            del self.cached_by_page[page]
             self.cached.pop(existing, None)
             self.inactive.pop(existing, None)
             self.removed_events.append(existing)
         if block_hash in self.cached:
-            # Another page already holds this block; keep the older one.
+            # Another page already holds this block; keep the older one. A
+            # page whose old registration we just dropped must not leak out
+            # of every pool: unreferenced -> back to free.
+            if existing is not None and page not in self.refs:
+                self.free.append(page)
             return
         self.cached[block_hash] = page
         self.cached_by_page[page] = block_hash
@@ -152,7 +159,6 @@ class PageAllocator:
                 self.free.append(page)
             else:
                 self.inactive[h] = page
-                self.inactive.move_to_end(h)
 
     def drain_events(self) -> tuple[list[int], list[int]]:
         stored, self.stored_events = self.stored_events, []

@@ -78,6 +78,33 @@ class ModelRunner:
                  devices: list | None = None, seed: int = 0):
         self.config = config
         spec = config.model
+        # TP feasibility + KV-head replication (the role of vLLM's KV-head
+        # replication for tp > num_kv_heads): each canonical KV head is
+        # duplicated tp/nkv times so the cache's head axis shards evenly
+        # over "tp". q head j maps to effective group j // (H/tp), which
+        # composes back to the canonical grouping j // (H/nkv).
+        self.canonical_spec = spec
+        self.canonical_nkv = spec.num_kv_heads
+        if spec.num_heads % config.tp != 0:
+            raise ValueError(
+                f"num_heads={spec.num_heads} not divisible by tp={config.tp}")
+        if config.tp > spec.num_kv_heads:
+            if config.tp % spec.num_kv_heads != 0:
+                raise ValueError(
+                    f"tp={config.tp} exceeds num_kv_heads="
+                    f"{spec.num_kv_heads} and is not a multiple of it; "
+                    f"KV-head replication needs tp % num_kv_heads == 0")
+            self.kv_rep = config.tp // spec.num_kv_heads
+            spec = dataclasses.replace(spec, num_kv_heads=config.tp)
+            log.info("tp=%d > num_kv_heads=%d: replicating each KV head "
+                     "%dx (KV cache grows %dx)", config.tp,
+                     self.canonical_nkv, self.kv_rep, self.kv_rep)
+        else:
+            if spec.num_kv_heads % config.tp != 0:
+                raise ValueError(
+                    f"num_kv_heads={spec.num_kv_heads} not divisible by "
+                    f"tp={config.tp}")
+            self.kv_rep = 1
         self.spec = spec
         devices = devices if devices is not None else jax.devices()
         total = config.dp * config.tp
@@ -95,7 +122,12 @@ class ModelRunner:
         if params is None:
             key = jax.random.key(seed)
             with jax.default_device(jax.devices("cpu")[0]):
-                params = init_params(spec, key)
+                # Init the CANONICAL shape so tp variants of one logical
+                # model share identical parameters.
+                params = init_params(self.canonical_spec, key)
+        if self.kv_rep > 1:
+            params = _replicate_kv_heads(params, self.canonical_spec,
+                                         self.kv_rep)
         self.params = jax.device_put(params, shardings)
 
         # KV cache arrays [L, Nkv, P, page, D]: kv heads sharded over tp, and
@@ -405,7 +437,12 @@ class ModelRunner:
         with self.mesh:
             out = self._get_extract(nb)(self.k_cache, self.v_cache,
                                         jnp.asarray(idx))
-        return np.asarray(jax.device_get(out))[:, :, :, :n]
+        out = np.asarray(jax.device_get(out))[:, :, :, :n]
+        if self.kv_rep > 1:
+            # Canonicalize: replica heads are identical — keep the first of
+            # each group so parcels are portable across tp configurations.
+            out = out[:, :, ::self.kv_rep]
+        return out
 
     def insert_pages(self, kv: np.ndarray, pages: list[int]) -> None:
         """Write transferred K/V pages into this runner's cache. kv
@@ -414,6 +451,10 @@ class ModelRunner:
         kernel (the role of block_copy.cu)."""
         n = len(pages)
         assert kv.shape[3] == n, (kv.shape, n)
+        if kv.shape[2] == self.canonical_nkv and self.kv_rep > 1:
+            kv = np.repeat(kv, self.kv_rep, axis=2)
+        assert kv.shape[2] == self.spec.num_kv_heads, (
+            kv.shape, self.spec.num_kv_heads)
         nb = self._page_bucket(n)
         if nb != n:
             # Pad with copies of the scratch page target (duplicate scatters
@@ -443,6 +484,34 @@ class ModelRunner:
                 jnp.asarray(temperature), jnp.asarray(top_k),
                 jnp.asarray(top_p), self._rng)
         return np.asarray(jax.device_get(sampled))
+
+
+def _replicate_kv_heads(params, spec, rep: int):
+    """Duplicate each canonical KV head ``rep`` times in wk/wv (+ biases) so
+    the effective head axis equals tp. Canonical head g lands at effective
+    heads [g*rep, (g+1)*rep)."""
+    d = spec.head_dim
+    nkv = spec.num_kv_heads
+
+    def rep_w(w):  # [L, h, nkv*d] -> [L, h, nkv*rep*d]
+        L, h, _ = w.shape
+        return np.asarray(w).reshape(L, h, nkv, d).repeat(rep, axis=2) \
+            .reshape(L, h, nkv * rep * d)
+
+    def rep_b(b):  # [L, nkv*d] -> [L, nkv*rep*d]
+        L, _ = b.shape
+        return np.asarray(b).reshape(L, nkv, d).repeat(rep, axis=1) \
+            .reshape(L, nkv * rep * d)
+
+    layers = dict(params["layers"])
+    layers["wk"] = rep_w(layers["wk"])
+    layers["wv"] = rep_w(layers["wv"])
+    if "bk" in layers:
+        layers["bk"] = rep_b(layers["bk"])
+        layers["bv"] = rep_b(layers["bv"])
+    out = dict(params)
+    out["layers"] = layers
+    return out
 
 
 def _prefill_with_history(params, spec, k_cache, v_cache, tokens, positions,

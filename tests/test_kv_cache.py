@@ -78,6 +78,20 @@ def test_unregister_returns_inactive_page_to_free():
     assert p[0] in got
 
 
+def test_reregister_duplicate_hash_does_not_leak_page():
+    """Re-registering an inactive page under a hash another page already
+    holds must return it to the free pool, not orphan it."""
+    a = PageAllocator(num_pages=4, page_size=16)
+    p = a.allocate(2)
+    a.register(p[0], 1)
+    a.register(p[1], 2)
+    a.release(p)  # both inactive
+    a.register(p[1], 1)  # hash 1 already held by p[0]
+    assert a.num_free == 3  # p[1] back in free, p[0] inactive, 1 untouched
+    got = a.allocate(3)
+    assert set(got) >= {p[0], p[1]}
+
+
 def test_failed_request_unregister_then_release():
     """Engine failure path: unregister while still held, release later —
     page must come back exactly once."""
