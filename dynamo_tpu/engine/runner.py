@@ -172,10 +172,14 @@ class ModelRunner:
     def _pick_attention(self):
         backend = self.config.attention_backend
         if backend == "auto":
-            # The bucketed XLA gather is the default: it reads ~live pages
-            # and avoids Mosaic constraints. The Pallas kernel is opt-in
-            # (wins for long mixed-length batches where one long sequence
-            # widens the gather bucket for everyone).
+            # The bucketed XLA gather is the default. Measured on v5e
+            # (qwen2.5-0.5b, bs32, M=16 windows, end-to-end decode_window
+            # incl. readback — scripts/profile_decode.py): uniform-length
+            # batches favor xla (297 vs 323 ms/window at seq 800); the
+            # Pallas kernel wins only the mixed-length case its design
+            # targets (1x800+31x64: 277 vs 296 ms/window) — within run
+            # noise, so it stays opt-in. Correctness is CI-tested either
+            # way (tests/test_attention_pallas.py, CPU interpret + TPU).
             backend = "xla"
         if backend == "pallas":
             d = self.spec.head_dim

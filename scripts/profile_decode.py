@@ -1,5 +1,11 @@
-"""Decompose the decode-step time on the real chip: forward-only vs sampler
-vs full step, and the attention gather cost vs maxp. Run on TPU."""
+"""Decompose the decode-step time on the real chip.
+
+Per-dispatch overhead through the remote-TPU tunnel is ~10ms, so naive
+one-call timing measures the tunnel, not the op. Every measurement here
+chains ITERS iterations inside ONE jitted lax.scan and divides — the same
+amortization the serving engine's decode windows use. Run on TPU:
+``python -m scripts.profile_decode``.
+"""
 
 import time
 
@@ -7,67 +13,98 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dynamo_tpu.engine.config import EngineConfig, PRESETS
+from dynamo_tpu.engine.config import PRESETS
 from dynamo_tpu.engine.model import (
     decode_forward, init_params, paged_decode_attention_xla)
 from dynamo_tpu.engine.sampler import sample_tokens
 
+ITERS = 64
 
-def timeit(fn, *args, n=20):
-    fn(*args)  # warm
+
+def timed(label, fn, *args, reps=5):
+    fn(*args)
     jax.block_until_ready(fn(*args))
-    t0 = time.monotonic()
-    for _ in range(n):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.monotonic() - t0) / n * 1e3
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.monotonic()
+        jax.block_until_ready(fn(*args))
+        best = min(best, (time.monotonic() - t0) / ITERS * 1e3)
+    print(f"{label}: {best * 1e3:.0f} us/iter")
+    return best
 
 
 def main():
     spec = PRESETS["qwen2.5-0.5b"]
-    batch, maxp, page = 32, 64, 16
-    num_pages = batch * maxp + 16
+    batch, page = 32, 16
     params = init_params(spec, jax.random.key(0))
-    kv_shape = (spec.num_layers, spec.num_kv_heads, num_pages, page,
-                spec.head_dim)
-    k = jnp.zeros(kv_shape, jnp.bfloat16)
-    v = jnp.zeros(kv_shape, jnp.bfloat16)
-    tokens = jnp.zeros((batch,), jnp.int32)
-    positions = jnp.full((batch,), 128, jnp.int32)
-    pt = np.zeros((batch, maxp), np.int32)
-    for b in range(batch):
-        pt[b] = np.arange(1 + b * maxp, 1 + (b + 1) * maxp)
-    page_table = jnp.asarray(pt)
-    seq_lens = jnp.full((batch,), 129, jnp.int32)
+
+    rng = jax.random.key(1)
     temp = jnp.zeros((batch,), jnp.float32)
     top_k = jnp.zeros((batch,), jnp.int32)
     top_p = jnp.ones((batch,), jnp.float32)
-    rng = jax.random.key(1)
 
-    fwd = jax.jit(lambda p, k, v: decode_forward(
-        p, spec, k, v, tokens, positions, page_table, seq_lens,
-        attention_impl=paged_decode_attention_xla)[0])
-    print("forward only (logits):", round(timeit(fwd, params, k, v), 2), "ms")
+    # Sampler: scan-chained.
+    logits0 = jnp.zeros((batch, spec.vocab_size), jnp.float32)
 
-    logits = fwd(params, k, v)
-    samp = jax.jit(lambda lg, r: sample_tokens(lg, temp, top_k, top_p, r))
-    print("sampler only:", round(timeit(samp, logits, rng), 2), "ms")
+    @jax.jit
+    def samp_chain(lg, r):
+        def body(carry, _):
+            lg, r = carry
+            r, sub = jax.random.split(r)
+            t = sample_tokens(lg, temp, top_k, top_p, sub)
+            # fold the token back in so the scan can't be elided
+            lg2 = lg + t[:, None] * 1e-9
+            return (lg2, r), ()
+        (lg, r), _ = jax.lax.scan(body, (lg, r), None, length=ITERS)
+        return lg
+    timed("sampler", samp_chain, logits0, rng)
 
-    # Attention gather alone at this maxp.
-    q = jnp.zeros((batch, spec.num_heads, spec.head_dim), jnp.bfloat16)
-    att = jax.jit(lambda q, kk: paged_decode_attention_xla(
-        q, kk[0], kk[0], page_table, seq_lens, spec.q_per_kv))
-    print("xla paged attn, 1 layer:", round(timeit(att, q, k), 2), "ms")
+    for maxp in (8, 16, 32, 64):
+        num_pages = batch * maxp + 16
+        kv_shape = (spec.num_layers, spec.num_kv_heads, num_pages, page,
+                    spec.head_dim)
+        k = jnp.zeros(kv_shape, jnp.bfloat16)
+        v = jnp.zeros(kv_shape, jnp.bfloat16)
+        pt = np.zeros((batch, maxp), np.int32)
+        for b in range(batch):
+            pt[b] = np.arange(1 + b * maxp, 1 + (b + 1) * maxp)
+        page_table = jnp.asarray(pt)
+        seq_lens = jnp.full((batch,), maxp * page - 8, jnp.int32)
+        positions = seq_lens - 1
+        tokens = jnp.zeros((batch,), jnp.int32)
 
-    # Pallas kernel attempt at D=64.
-    try:
-        from dynamo_tpu.engine.attention import paged_decode_attention_pallas
-        attp = jax.jit(lambda q, kk: paged_decode_attention_pallas(
-            q, kk[0], kk[0], page_table, seq_lens, spec.q_per_kv))
-        print("pallas paged attn, 1 layer:", round(timeit(attp, q, k), 2),
-              "ms")
-    except Exception as e:  # noqa: BLE001
-        print("pallas D=64 failed:", type(e).__name__, str(e)[:300])
+        # Full forward, scan-chained (token feedback like the real window).
+        def fwd_chain_of(impl):
+            @jax.jit
+            def fwd_chain(params, k, v):
+                def body(carry, _):
+                    k, v, tok = carry
+                    lg, k, v = decode_forward(
+                        params, spec, k, v, tok, positions, page_table,
+                        seq_lens, attention_impl=impl)
+                    tok = jnp.argmax(lg, -1).astype(jnp.int32)
+                    return (k, v, tok), ()
+                (k, v, tok), _ = jax.lax.scan(
+                    body, (k, v, tokens), None, length=ITERS)
+                return tok
+            return fwd_chain
+
+        t_x = timed(f"forward+argmax maxp={maxp} xla",
+                    fwd_chain_of(paged_decode_attention_xla), params, k, v)
+        try:
+            from dynamo_tpu.engine.attention import (
+                paged_decode_attention_pallas)
+            t_p = timed(f"forward+argmax maxp={maxp} pallas",
+                        fwd_chain_of(paged_decode_attention_pallas),
+                        params, k, v)
+            print(f"  -> pallas/xla = {t_p / t_x:.2f}")
+        except Exception as e:  # noqa: BLE001
+            print("pallas failed:", type(e).__name__, str(e)[:300])
+
+    # Weight-read roofline context.
+    pb = spec.num_params() * 2
+    print(f"params {pb / 1e9:.2f} GB -> weight-read floor "
+          f"@819GB/s = {pb / 819e9 * 1e6:.0f} us/step")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,74 @@
+"""Pallas paged-attention kernel == XLA gather reference (VERDICT r2 #2).
+
+Runs everywhere: on CPU the TPU kernel executes through Pallas interpret
+lowering; on a real TPU it compiles through Mosaic. Covers both kernel
+layouts — D=64 (lane-packed, 2 tokens per 128-lane row) and D=128
+(natural) — across ragged sequence lengths, GQA grouping, and page-table
+indirection. Tolerances are bf16-input flash-vs-softmax differences.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dynamo_tpu.engine.attention import paged_decode_attention_pallas
+from dynamo_tpu.engine.model import paged_decode_attention_xla
+
+
+def _case(d, b, nkv, qpk, maxp, seq_lens, seed=0, page=16):
+    rng = np.random.default_rng(seed)
+    nh = nkv * qpk
+    npages = maxp * b + 2
+    q = jnp.asarray(rng.standard_normal((b, nh, d)), jnp.bfloat16)
+    kp = jnp.asarray(rng.standard_normal((nkv, npages, page, d)),
+                     jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((nkv, npages, page, d)),
+                     jnp.bfloat16)
+    pt = np.zeros((b, maxp), np.int32)
+    for i in range(b):
+        pt[i] = rng.permutation(np.arange(1, npages - 1))[:maxp]
+    sl = jnp.asarray(seq_lens, jnp.int32)
+    return q, kp, vp, jnp.asarray(pt), sl
+
+
+@pytest.mark.parametrize("d", [64, 128])
+def test_pallas_matches_xla(d):
+    q, kp, vp, pt, sl = _case(d, b=4, nkv=2, qpk=4, maxp=8,
+                              seq_lens=[5, 17, 64, 128])
+    ref = np.asarray(paged_decode_attention_xla(q, kp, vp, pt, sl, 4),
+                     np.float32)
+    out = np.asarray(paged_decode_attention_pallas(q, kp, vp, pt, sl, 4),
+                     np.float32)
+    np.testing.assert_allclose(out, ref, atol=0.03, rtol=0.03)
+
+
+@pytest.mark.parametrize("d", [64, 128])
+def test_pallas_matches_xla_long_ragged(d):
+    """Sequence lengths crossing multiple DMA chunks (chunk = 128 tokens),
+    including non-chunk-aligned and single-token rows."""
+    q, kp, vp, pt, sl = _case(d, b=4, nkv=2, qpk=2, maxp=32,
+                              seq_lens=[1, 129, 300, 512], seed=3)
+    ref = np.asarray(paged_decode_attention_xla(q, kp, vp, pt, sl, 2),
+                     np.float32)
+    out = np.asarray(paged_decode_attention_pallas(q, kp, vp, pt, sl, 2),
+                     np.float32)
+    np.testing.assert_allclose(out, ref, atol=0.03, rtol=0.03)
+
+
+def test_pallas_mqa_single_group():
+    """MQA extreme: one KV head, 8 query heads."""
+    q, kp, vp, pt, sl = _case(64, b=2, nkv=1, qpk=8, maxp=8,
+                              seq_lens=[33, 90], seed=5)
+    ref = np.asarray(paged_decode_attention_xla(q, kp, vp, pt, sl, 8),
+                     np.float32)
+    out = np.asarray(paged_decode_attention_pallas(q, kp, vp, pt, sl, 8),
+                     np.float32)
+    np.testing.assert_allclose(out, ref, atol=0.03, rtol=0.03)
+
+
+def test_pallas_rejects_unpackable_head_dim():
+    with pytest.raises(AssertionError):
+        q, kp, vp, pt, sl = _case(48, b=2, nkv=1, qpk=2, maxp=4,
+                                  seq_lens=[8, 8])
+        paged_decode_attention_pallas(q, kp, vp, pt, sl, 2)
