@@ -89,6 +89,7 @@ async def main_async():
     from dynamo_tpu.engine.config import EngineConfig, PRESETS
     from dynamo_tpu.engine.engine import TPUEngine
 
+    import os
     spec = PRESETS["qwen2.5-0.5b"]
     page = 16
     maxp = 64  # up to 1024 tokens/seq
@@ -96,8 +97,10 @@ async def main_async():
         model=spec, page_size=page, num_pages=BATCH * maxp + 16,
         max_pages_per_seq=maxp, max_num_seqs=BATCH,
         prefill_buckets=(128, 256, 512, 1024),
-        max_prefill_tokens=1024, attention_backend="auto",
-        decode_window=16)
+        max_prefill_tokens=1024,
+        attention_backend=os.environ.get("BENCH_ATTN", "auto"),
+        decode_window=int(os.environ.get("BENCH_WINDOW", "8")),
+        pipeline_depth=int(os.environ.get("BENCH_DEPTH", "8")))
     engine = TPUEngine(config)
     engine.start()
     rng = np.random.default_rng(0)
@@ -132,6 +135,7 @@ async def main_async():
             "roofline_tok_s_weight_read": round(roofline_tok_s, 0),
             "frac_of_roofline": round(tok_s / roofline_tok_s, 3),
             "decode_window": config.decode_window,
+            "pipeline_depth": config.pipeline_depth,
             "platform": jax.devices()[0].platform,
             "device": str(jax.devices()[0]),
         },

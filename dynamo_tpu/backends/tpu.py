@@ -104,12 +104,19 @@ async def run(args: argparse.Namespace) -> None:
                                   runtime.instance_id)
         metrics_pub = WorkerMetricsPublisher(runtime, ns, args.component,
                                              runtime.instance_id)
-        params = None
-        if os.path.isdir(args.model):
-            from dynamo_tpu.engine.weights import load_hf_weights
-            params = load_hf_weights(engine_cfg.model, args.model)
-        engine = TPUEngine(engine_cfg, params=params, kv_publisher=kv_pub,
-                           metrics_publisher=metrics_pub)
+        def build_engine() -> TPUEngine:
+            params = None
+            if os.path.isdir(args.model):
+                from dynamo_tpu.engine.weights import load_hf_weights
+                params = load_hf_weights(engine_cfg.model, args.model)
+            return TPUEngine(engine_cfg, params=params, kv_publisher=kv_pub,
+                             metrics_publisher=metrics_pub)
+
+        # Engine construction blocks for seconds (weight load + sharded
+        # device_put + first compiles); run it off the event loop so the
+        # coordinator lease keepalives keep flowing.
+        engine = await asyncio.get_running_loop().run_in_executor(
+            None, build_engine)
         from dynamo_tpu.llm.disagg import (
             PREFILL_COMPONENT, PREFILL_ENDPOINT, DisaggDecodeHandler,
             DisaggRouterConfig, make_prefill_handler)

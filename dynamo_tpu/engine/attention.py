@@ -41,17 +41,17 @@ NEG_INF = -1e30
 
 
 class _ChunkCopy:
-    """Async copy of PAGES_PER_CHUNK K/V pages for one (head, chunk) into a
-    VMEM slot (idiom after the stock multi-page copy descriptor)."""
+    """Async copy of PAGES_PER_CHUNK K/V pages for one (layer, head, chunk)
+    into a VMEM slot (idiom after the stock multi-page copy descriptor)."""
 
-    def __init__(self, hbm_ref, buf, sem, page_table_ref, b, h, chunk,
+    def __init__(self, hbm_ref, buf, sem, layer, page_table_ref, b, h, chunk,
                  max_pages):
         self._copies = []
         for j in range(PAGES_PER_CHUNK):
             idx = jnp.minimum(chunk * PAGES_PER_CHUNK + j, max_pages - 1)
             pid = page_table_ref[b, idx]
             self._copies.append(pltpu.make_async_copy(
-                hbm_ref.at[h].at[pid], buf.at[j], sem))
+                hbm_ref.at[layer].at[h].at[pid], buf.at[j], sem))
 
     def start(self):
         for c in self._copies:
@@ -62,13 +62,14 @@ class _ChunkCopy:
             c.wait()
 
 
-def _decode_kernel(page_table_ref, seq_lens_ref,  # scalar prefetch (SMEM)
+def _decode_kernel(layer_ref, page_table_ref, seq_lens_ref,  # SMEM prefetch
                    q_ref, k_hbm, v_hbm,  # q2 VMEM block; k/v packed (ANY)
                    acc_ref, m_ref, l_ref,  # outputs (unnormalized flash)
                    k_buf, v_buf, sems,  # scratch
                    *, page_size: int, max_pages: int, tpr: int, qpk: int):
     b = pl.program_id(0)
     h = pl.program_id(1)
+    layer = layer_ref[0]
     seq_len = seq_lens_ref[b]
     chunk_tokens = PAGES_PER_CHUNK * page_size
     rows = chunk_tokens // tpr  # packed rows per chunk
@@ -80,9 +81,9 @@ def _decode_kernel(page_table_ref, seq_lens_ref,  # scalar prefetch (SMEM)
     scale = 1.0 / (d ** 0.5)
 
     def make_copies(c, slot):
-        kc = _ChunkCopy(k_hbm, k_buf.at[slot], sems.at[0, slot],
+        kc = _ChunkCopy(k_hbm, k_buf.at[slot], sems.at[0, slot], layer,
                         page_table_ref, b, h, c, max_pages)
-        vc = _ChunkCopy(v_hbm, v_buf.at[slot], sems.at[1, slot],
+        vc = _ChunkCopy(v_hbm, v_buf.at[slot], sems.at[1, slot], layer,
                         page_table_ref, b, h, c, max_pages)
         return kc, vc
 
@@ -134,20 +135,17 @@ def _decode_kernel(page_table_ref, seq_lens_ref,  # scalar prefetch (SMEM)
     l_ref[0, 0] = jnp.broadcast_to(l, (n, 128))
 
 
-@functools.partial(jax.jit, static_argnames=("q_per_kv",))
-def paged_decode_attention_pallas(q: jax.Array, k_pages: jax.Array,
-                                  v_pages: jax.Array, page_table: jax.Array,
-                                  seq_lens: jax.Array, q_per_kv: int
-                                  ) -> jax.Array:
-    """Drop-in replacement for model.paged_decode_attention_xla.
-
-    q [B,Nh,D]; k_pages/v_pages [Nkv,P,page,D]; page_table [B,maxP];
-    seq_lens [B]. Returns [B,Nh,D]. Requires page_size*D % 128 == 0 and
-    128 % D == 0 (packed) or D % 128 == 0 (natural).
-    """
+def _hist_flash_pallas(q, k_cache, v_cache, layer, page_table, hist_lens,
+                       q_per_kv):
+    """Run the kernel over the cache-resident history; returns the flash
+    triple (num [b,nkv,qpk,d] unnormalized, l_star [b,nkv,qpk,1],
+    m_s [b,nkv,qpk,1]) for the wrapper to merge with out-of-cache columns
+    (the in-window buffer and/or the current token)."""
     b, nh, d = q.shape
-    nkv, num_pages, page_size, _ = k_pages.shape
+    _, nkv, num_pages, page_size, _ = k_cache.shape
     maxp = page_table.shape[1]
+    seq_lens = hist_lens
+    q_per_kv = int(q_per_kv)
     if d >= 128:
         # The packed-row math assumes one token per 128-lane row; d > 128
         # would need a multi-row-per-token variant (no current model needs
@@ -164,8 +162,10 @@ def paged_decode_attention_pallas(q: jax.Array, k_pages: jax.Array,
 
     # Pack the caches: view each page as [rows_per_page, 128] (zero-cost
     # reshape: same row-major layout).
-    kp = k_pages.reshape(nkv, num_pages, rows_per_page, 128)
-    vp = v_pages.reshape(nkv, num_pages, rows_per_page, 128)
+    L = k_cache.shape[0]
+    kp = k_cache.reshape(L, nkv, num_pages, rows_per_page, 128)
+    vp = v_cache.reshape(L, nkv, num_pages, rows_per_page, 128)
+    layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
 
     # Expand q: group t occupies rows [t*qpk,(t+1)*qpk) and lanes
     # [t*d,(t+1)*d).
@@ -179,7 +179,7 @@ def paged_decode_attention_pallas(q: jax.Array, k_pages: jax.Array,
 
     blk = pl.BlockSpec((1, 1, n, tpr * d), lambda i, j, *_: (i, j, 0, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(b, nkv),
         in_specs=[
             blk,
@@ -189,9 +189,9 @@ def paged_decode_attention_pallas(q: jax.Array, k_pages: jax.Array,
         out_specs=(blk, blk, blk),
         scratch_shapes=[
             pltpu.VMEM((2, PAGES_PER_CHUNK, rows_per_page, 128),
-                       k_pages.dtype),
+                       k_cache.dtype),
             pltpu.VMEM((2, PAGES_PER_CHUNK, rows_per_page, 128),
-                       v_pages.dtype),
+                       v_cache.dtype),
             pltpu.SemaphoreType.DMA((2, 2)),
         ],
     )
@@ -207,21 +207,98 @@ def paged_decode_attention_pallas(q: jax.Array, k_pages: jax.Array,
         # CPU (CI / the virtual test mesh) runs the TPU kernel through the
         # Pallas interpreter; Mosaic compiles it on real chips.
         interpret=jax.default_backend() == "cpu",
-    )(page_table, seq_lens, q2, kp, vp)
+    )(layer_arr, page_table, seq_lens, q2, kp, vp)
     m = m[..., :1]  # broadcast lanes -> scalar stat per row
     l = l[..., :1]
     if tpr == 1:
-        out = acc / jnp.maximum(l, 1e-30)
-        return out.astype(q.dtype).reshape(b, nh, d)
-    # Flash-merge the tpr groups of each head, then sum each group's valid
-    # lane window.
-    acc4 = acc.reshape(b, nkv, tpr, qpk, 128)
-    m4 = m.reshape(b, nkv, tpr, qpk, 1)
-    l4 = l.reshape(b, nkv, tpr, qpk, 1)
-    m_star = jnp.max(m4, axis=2, keepdims=True)
-    w = jnp.exp(m4 - m_star)
-    l_star = jnp.sum(w * l4, axis=2)  # [b,nkv,qpk,1]
-    num = sum((w[:, :, t] * acc4[:, :, t])[..., t * d:(t + 1) * d]
-              for t in range(tpr))  # [b,nkv,qpk,d]
-    out = num / jnp.maximum(l_star, 1e-30)
+        num = acc.reshape(b, nkv, qpk, d)
+        l_star = l.reshape(b, nkv, qpk, 1)
+        m_s = m.reshape(b, nkv, qpk, 1)
+    else:
+        # Flash-merge the tpr groups of each head, then sum each group's
+        # valid lane window.
+        acc4 = acc.reshape(b, nkv, tpr, qpk, 128)
+        m4 = m.reshape(b, nkv, tpr, qpk, 1)
+        l4 = l.reshape(b, nkv, tpr, qpk, 1)
+        m_star = jnp.max(m4, axis=2, keepdims=True)
+        w = jnp.exp(m4 - m_star)
+        l_star = jnp.sum(w * l4, axis=2)  # [b,nkv,qpk,1]
+        num = sum((w[:, :, t] * acc4[:, :, t])[..., t * d:(t + 1) * d]
+                  for t in range(tpr))  # [b,nkv,qpk,d]
+        m_s = m_star.reshape(b, nkv, qpk, 1)
+    return num, l_star, m_s
+
+
+def _merge_extra(q, num, l_star, m_s, k_extra, v_extra, s_mask, q_per_kv):
+    """Flash-merge the kernel's history block with explicit extra columns
+    (window buffer tokens and/or the current token). k_extra/v_extra
+    [b,nkv,J,d]; s_mask [b,1,1,J] bool (True = valid)."""
+    b, nh, d = q.shape
+    nkv = k_extra.shape[1]
+    qpk = q_per_kv
+    qg = q.reshape(b, nkv, qpk, d).astype(jnp.float32)
+    s = jnp.einsum("bngd,bnjd->bngj", qg,
+                   k_extra.astype(jnp.float32)) / (d ** 0.5)
+    s = jnp.where(s_mask, s, NEG_INF)
+    m_b = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m_b)
+    l_b = jnp.sum(p, axis=-1, keepdims=True)
+    acc_b = jnp.einsum("bngj,bnjd->bngd", p, v_extra.astype(jnp.float32))
+    m_t = jnp.maximum(m_s, m_b)
+    w_h = jnp.exp(m_s - m_t)
+    w_b = jnp.exp(m_b - m_t)
+    out = ((num * w_h + acc_b * w_b)
+           / jnp.maximum(l_star * w_h + l_b * w_b, 1e-30))
     return out.astype(q.dtype).reshape(b, nh, d)
+
+
+@functools.partial(jax.jit, static_argnames=("q_per_kv",))
+def paged_decode_attention_pallas(q: jax.Array, k_cache: jax.Array,
+                                  v_cache: jax.Array, layer: jax.Array,
+                                  page_table: jax.Array, hist_lens: jax.Array,
+                                  k_self: jax.Array, v_self: jax.Array,
+                                  q_per_kv: int) -> jax.Array:
+    """Drop-in replacement for model.paged_decode_attention_xla.
+
+    q [B,Nh,D]; k_cache/v_cache [L,Nkv,P,page,D] (the FULL stacked cache —
+    the kernel DMAs pages of the given layer directly, never slicing);
+    layer: scalar layer index; page_table [B,maxP]; hist_lens [B] (tokens
+    already cache-resident); k_self/v_self [B,Nkv,D] (the new token's K/V,
+    merged as an extra flash column outside the kernel). Returns [B,Nh,D].
+    Requires page_size*D % 128 == 0 and 128 % D == 0 (packed) or
+    D % 128 == 0 (natural).
+    """
+    b = q.shape[0]
+    nkv = k_cache.shape[1]
+    num, l_star, m_s = _hist_flash_pallas(q, k_cache, v_cache, layer,
+                                          page_table, hist_lens, q_per_kv)
+    mask = jnp.ones((b, 1, 1, 1), bool)
+    return _merge_extra(q, num, l_star, m_s, k_self[:, :, None, :],
+                        v_self[:, :, None, :], mask, q_per_kv)
+
+
+@functools.partial(jax.jit, static_argnames=("q_per_kv",))
+def paged_window_attention_pallas(q: jax.Array, k_cache: jax.Array,
+                                  v_cache: jax.Array, layer: jax.Array,
+                                  page_table: jax.Array, hist_lens: jax.Array,
+                                  k_win: jax.Array, v_win: jax.Array,
+                                  m: jax.Array, k_self: jax.Array,
+                                  v_self: jax.Array, q_per_kv: int
+                                  ) -> jax.Array:
+    """Window variant (model.paged_window_attention_xla interface): kernel
+    over the cache-resident history + XLA flash-merge of the in-window
+    buffer (cols j < m) and the current token. k_win/v_win [Nkv,B,M,D]."""
+    b = q.shape[0]
+    M = k_win.shape[2]
+    num, l_star, m_s = _hist_flash_pallas(q, k_cache, v_cache, layer,
+                                          page_table, hist_lens, q_per_kv)
+    k_extra = jnp.concatenate(
+        [k_win.transpose(1, 0, 2, 3), k_self[:, :, None, :]], axis=2)
+    v_extra = jnp.concatenate(
+        [v_win.transpose(1, 0, 2, 3), v_self[:, :, None, :]], axis=2)
+    win_valid = jnp.arange(M)[None, :] < m          # [1,M] (m traced)
+    col_mask = jnp.concatenate(
+        [jnp.broadcast_to(win_valid, (b, M)),
+         jnp.ones((b, 1), bool)], axis=1)[:, None, None, :]
+    return _merge_extra(q, num, l_star, m_s, k_extra, v_extra, col_mask,
+                        q_per_kv)

@@ -123,6 +123,13 @@ class EngineConfig:
     # dispatch + readback latency at the cost of coarser stop-condition
     # granularity (up to window-1 wasted speculative tokens per finish).
     decode_window: int = 8
+    # Windows in flight before the host blocks on the oldest readback.
+    # Each dispatch/readback pays a host<->device round trip (~100 ms
+    # through a tunneled chip, ~100 us locally); depth D overlaps D of
+    # them, so the steady-state window period approaches pure compute
+    # (measured on v5e: depth 1->8 at M=8 = 3.6K->10.1K tok/s at bs32;
+    # docs/PERF_NOTES.md).
+    pipeline_depth: int = 8
     # Parallelism
     tp: int = 1
     dp: int = 1

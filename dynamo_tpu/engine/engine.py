@@ -57,12 +57,17 @@ class _Request:
     generated: int = 0
     slot: int = -1
     epoch: int = 0
-    last_token: int = -1
+    # None = first token still on device (async fetch pending).
+    last_token: int | None = -1
     reuse_tokens: int = 0  # cached-prefix tokens pinned by the last plan
     # Disaggregation: (first_token, kv [2,L,Nkv,n,page,D]) from a remote
     # prefill — admission inserts the pages instead of prefilling locally.
     injected: tuple | None = None
     enqueue_t: float = dataclasses.field(default_factory=time.monotonic)
+    # Upper bound on total sequence length (original prompt + max_tokens):
+    # dispatch never allocates pages past it, so pipelined lookahead can't
+    # demand pages a finishing request will never write.
+    len_cap: int = 2**30
 
     def push(self, item) -> None:
         self.loop.call_soon_threadsafe(self.out_q.put_nowait, item)
@@ -74,6 +79,7 @@ class _Window:
     slots: list   # per slot: (request, epoch, start_pos, cap) or None
     frozen: dict  # slot -> (request, epoch, "requeue" | "oom")
     size: int
+    serial: int = 0  # dispatch order (pipelined deferred-release fencing)
 
 
 class TPUEngine(AsyncEngine):
@@ -98,8 +104,17 @@ class TPUEngine(AsyncEngine):
         # Control jobs executed on the engine thread between windows
         # (disagg prefill-extract, KV injection helpers, etc.).
         self._jobs: queue.Queue = queue.Queue()
-        self._inflight: _Window | None = None
-        self._pending_release: list[int] = []
+        # Dispatched-but-unprocessed windows, oldest first. Depth > 1
+        # overlaps the host<->device round trips of consecutive windows.
+        self._inflight: collections.deque[_Window] = collections.deque()
+        self._dispatch_serial = 0
+        # Batched-prefill first tokens awaiting async device->host fetch:
+        # {"handle": device array, "rows": [(row, request, slot, epoch)]}.
+        self._pending_first: list[dict] = []
+        # Pages freed while windows that may still scatter to them are in
+        # flight: (serial of the newest dispatched window at free time,
+        # pages). Released once that window has been processed.
+        self._pending_release: list[tuple[int, list[int]]] = []
         self._running = False
         self._thread: threading.Thread | None = None
         self._publish_loop: asyncio.AbstractEventLoop | None = None
@@ -157,7 +172,9 @@ class TPUEngine(AsyncEngine):
         self._validate(req)
         r = _Request(req=req, ctx=context, out_q=asyncio.Queue(),
                      loop=asyncio.get_running_loop(),
-                     tokens_all=list(req.token_ids))
+                     tokens_all=list(req.token_ids),
+                     len_cap=len(req.token_ids)
+                     + (req.stop_conditions.max_tokens or 2**30))
         self.waiting.put(r)
         self.num_waiting += 1
         while True:
@@ -182,7 +199,9 @@ class TPUEngine(AsyncEngine):
         r = _Request(req=req, ctx=context, out_q=asyncio.Queue(),
                      loop=asyncio.get_running_loop(),
                      tokens_all=list(req.token_ids),
-                     injected=(first_token, kv))
+                     injected=(first_token, kv),
+                     len_cap=len(req.token_ids)
+                     + (req.stop_conditions.max_tokens or 2**30))
         self.waiting.put(r)
         self.num_waiting += 1
         while True:
@@ -254,16 +273,18 @@ class TPUEngine(AsyncEngine):
         log.info("engine loop starting (slots=%d pages=%d window=%d)",
                  self.config.max_num_seqs, self.runner.num_pages,
                  self.config.decode_window)
+        depth = max(1, self.config.pipeline_depth)
         while self._running:
             self._run_jobs()
+            self._resolve_ready_first()
             try:
                 admitted = self._admit()
             except Exception:  # noqa: BLE001
                 log.exception("admission failed")
                 admitted = False
             have_active = any(r is not None for r in self.slot_req)
-            window = None
-            if have_active:
+            dispatched = False
+            if have_active and len(self._inflight) < depth:
                 try:
                     window = self._dispatch_window()
                 except Exception as exc:  # noqa: BLE001 — fail all, keep serving
@@ -272,30 +293,103 @@ class TPUEngine(AsyncEngine):
                         if r is not None:
                             r.push(RuntimeError(f"engine step failed: {exc}"))
                             self._finish_slot(i, register=False)
-            if self._inflight is not None:
-                try:
-                    self._process_window(self._inflight)
-                except Exception as exc:  # noqa: BLE001
-                    # Device faults surface at the readback: host token state
-                    # has diverged from the on-device chain, so fail every
-                    # request this window covered rather than continue with
-                    # silently-wrong streams/prefix hashes.
-                    log.exception("window processing failed")
-                    for i, snap in enumerate(self._inflight.slots):
-                        if snap is not None and self.slot_req[i] is snap[0]:
-                            snap[0].push(RuntimeError(
-                                f"window processing failed: {exc}"))
-                            self._finish_slot(i, register=False)
+                else:
+                    if window.toks is None:
+                        # No device work (every live slot frozen): handle
+                        # the preemption records immediately.
+                        self._do_process(window)
+                    else:
+                        self._inflight.append(window)
+                        dispatched = True
+            # Process the oldest window once the pipe is full (or drain it
+            # when nothing new can be dispatched).
+            if self._inflight and (len(self._inflight) >= depth
+                                   or not dispatched):
+                self._do_process(self._inflight.popleft())
                 self.step_count += 1
                 self._publish()
-            self._inflight = window
-            if window is None and not admitted and not have_active:
-                # Fully idle: release any deferred pages (no in-flight writer)
-                # and nap.
-                if self._pending_release:
-                    self.allocator.release(self._pending_release)
-                    self._pending_release = []
-                time.sleep(0.002)
+            self._release_ready_pages()
+            if not self._inflight and not admitted and not have_active:
+                time.sleep(0.002)  # fully idle
+            elif not self._inflight and self._pending_first:
+                # Nothing left on the device but first tokens unfetched
+                # (e.g. a lone max_tokens=1 request): block on them now.
+                self._resolve_ready_first(force=True)
+
+    def _release_ready_pages(self) -> None:
+        """Release deferred pages whose potential writers are done. An
+        entry (s, pages) may still be scattered to by any window with
+        device work dispatched at-or-before serial s; windows process in
+        serial order, so the fence is just below the oldest in-flight
+        window (everything, if none are in flight — toks=None windows
+        never carry device work and never enter the deque)."""
+        if not self._pending_release:
+            return
+        fence = (self._inflight[0].serial - 1 if self._inflight
+                 else self._dispatch_serial)
+        keep = []
+        for serial, pages in self._pending_release:
+            if serial <= fence:
+                self.allocator.release(pages)
+            else:
+                keep.append((serial, pages))
+        self._pending_release = keep
+
+    def _resolve_ready_first(self, force: bool = False) -> None:
+        for entry in list(self._pending_first):
+            handle = entry["handle"]
+            ready = getattr(handle, "is_ready", lambda: True)()
+            if not (ready or force):
+                continue
+            self._pending_first.remove(entry)
+            self._resolve_first(entry)
+
+    def _force_resolve_first_for(self, slots_needed: set[int]) -> None:
+        """Block on the fetches whose first tokens the caller is about to
+        need (their windows are being processed — the fetch predates those
+        windows' compute, so it is effectively ready)."""
+        for entry in list(self._pending_first):
+            if any(slot in slots_needed and self.slot_req[slot] is r
+                   for _, r, slot, _ in entry["rows"]):
+                self._pending_first.remove(entry)
+                self._resolve_first(entry)
+
+    def _resolve_first(self, entry: dict) -> None:
+        try:
+            vals = np.asarray(entry["handle"])
+        except Exception as exc:  # noqa: BLE001 — device fault at fetch
+            log.exception("first-token fetch failed")
+            for _, r, slot, epoch in entry["rows"]:
+                if self.slot_req[slot] is r and r.epoch == epoch:
+                    r.push(RuntimeError(f"prefill readback failed: {exc}"))
+                    self._finish_slot(slot, register=False)
+            return
+        for row, r, slot, epoch in entry["rows"]:
+            if self.slot_req[slot] is not r or r.epoch != epoch:
+                continue  # slot reassigned (failure path already notified)
+            tok = int(vals[row])
+            r.generated += 1
+            finish = self._check_finish(r, tok)
+            self._emit(r, [tok], finish)
+            r.last_token = tok
+            r.tokens_all.append(tok)
+            if finish is not None:
+                self._finish_slot(slot, register=True)
+
+    def _do_process(self, w: _Window) -> None:
+        try:
+            self._process_window(w)
+        except Exception as exc:  # noqa: BLE001
+            # Device faults surface at the readback: host token state has
+            # diverged from the on-device chain, so fail every request this
+            # window covered rather than continue with silently-wrong
+            # streams/prefix hashes.
+            log.exception("window processing failed")
+            for i, snap in enumerate(w.slots):
+                if snap is not None and self.slot_req[i] is snap[0]:
+                    snap[0].push(RuntimeError(
+                        f"window processing failed: {exc}"))
+                    self._finish_slot(i, register=False)
 
     # -- admission / prefill --------------------------------------------------
     def _admit(self) -> bool:
@@ -358,7 +452,9 @@ class TPUEngine(AsyncEngine):
             while group:
                 chunk, group = group[:8], group[8:]
                 try:
-                    tokens = self.runner.prefill_batch([p for _, _, p in chunk])
+                    handle = self.runner.prefill_batch(
+                        [p for _, _, p in chunk],
+                        slots=[s for _, s, _ in chunk])
                 except Exception as exc:  # noqa: BLE001
                     log.exception("batched prefill failed")
                     for r, _, _ in chunk:
@@ -366,8 +462,13 @@ class TPUEngine(AsyncEngine):
                         r.pages = []
                         r.push(RuntimeError(f"prefill failed: {exc}"))
                     continue
-                for (r, slot, _), tok in zip(chunk, tokens):
-                    self._place_in_slot(r, slot, int(tok))
+                rows = []
+                for row, (r, slot, _) in enumerate(chunk):
+                    self._place_in_slot_pending(r, slot)
+                    rows.append((row, r, slot, r.epoch))
+                # First tokens are already chained on-device (tokens_dev);
+                # their host values arrive asynchronously.
+                self._pending_first.append({"handle": handle, "rows": rows})
         return True
 
     def _admit_injected(self, r: _Request, slot: int) -> bool:
@@ -464,6 +565,26 @@ class TPUEngine(AsyncEngine):
         s = r.req.sampling_options
         return (s.temperature or 0.0, s.top_k or 0, s.top_p or 1.0)
 
+    def _place_in_slot_pending(self, r: _Request, slot: int) -> None:
+        """Occupy a slot whose first token is still on device (scattered
+        into tokens_dev by the prefill program): decode windows chain from
+        it with no override; the host value is emitted when the async
+        fetch resolves (_resolve_first)."""
+        prompt_len = len(r.tokens_all)
+        for idx, h in enumerate(r.blocks.block_hashes):
+            self.allocator.register(r.pages[idx], h)
+        r.slot = slot
+        r.epoch += 1
+        r.last_token = None
+        self.slot_req[slot] = r
+        self.disp_positions[slot] = prompt_len
+        self.disp_seq_lens[slot] = prompt_len + 1
+        temp, tk, tp = self._sampling_of(r)
+        self.temperature[slot] = temp
+        self.top_k[slot] = tk
+        self.top_p[slot] = tp
+        self.overrides.pop(slot, None)
+
     def _place_in_slot(self, r: _Request, slot: int, first_token: int) -> None:
         prompt_len = len(r.tokens_all)
         # The prompt's complete blocks are now resident: register them for
@@ -474,7 +595,7 @@ class TPUEngine(AsyncEngine):
         finish = self._check_finish(r, first_token)
         self._emit(r, [first_token], finish)
         if finish is not None:
-            self._pending_release.extend(r.pages)
+            self._pending_release.append((self._dispatch_serial, r.pages))
             r.pages = []
             return
         r.slot = slot
@@ -509,10 +630,12 @@ class TPUEngine(AsyncEngine):
         for i in order:
             r = self.slot_req[i]
             last_pos = int(self.disp_positions[i]) + M - 1
-            # Clamp to the model-length cap: the slot decodes up to its
-            # allocated capacity within the window and freezes in-graph
-            # (the host emits LENGTH when processing reaches the cap).
-            needed = min(last_pos // page + 1, cfg.max_pages_per_seq)
+            # Clamp to the model-length cap AND the request's own length
+            # cap: the slot decodes up to its allocated capacity within the
+            # window and freezes in-graph (the host emits LENGTH when
+            # processing reaches the cap).
+            needed = min(last_pos // page + 1, cfg.max_pages_per_seq,
+                         (r.len_cap - 1) // page + 1)
             ok = True
             while len(r.pages) < needed:
                 new = self.allocator.allocate(1)
@@ -521,8 +644,12 @@ class TPUEngine(AsyncEngine):
                     break
                 r.pages.extend(new)
             if not ok:
-                if n_live == 1:
-                    # Only live slot: the pool is simply too small — fail it.
+                pending = sum(len(p) for _, p in self._pending_release)
+                if (n_live == 1 and needed - len(r.pages)
+                        > self.allocator.num_free + pending):
+                    # Only live slot and the pool — even counting pages
+                    # queued for release behind in-flight windows — is
+                    # simply too small: fail it.
                     frozen[i] = (r, r.epoch, "oom")
                 else:
                     deficits[i] = needed - len(r.pages)
@@ -532,12 +659,13 @@ class TPUEngine(AsyncEngine):
         if deficits:
             # Preempt the YOUNGEST live slots (vLLM preempt-the-youngest
             # semantics) until the pages they will free (released after the
-            # in-flight window completes) cover what older slots still need.
-            # The under-allocated older slots STALL this window — they keep
-            # all state (pages, device token chain, pending override) and
-            # retry next dispatch — rather than being preempted themselves.
-            # The very oldest slot is never a victim.
-            freed = 0
+            # in-flight windows complete) — plus pages already queued for
+            # release — cover what older slots still need. The
+            # under-allocated older slots STALL this window: they keep all
+            # state (pages, device token chain, pending override) and retry
+            # next dispatch rather than being preempted themselves. The
+            # very oldest slot is never a victim.
+            freed = sum(len(p) for _, p in self._pending_release)
             want = sum(deficits.values())
             for j in reversed(order[1:]):
                 if freed >= want:
@@ -550,16 +678,18 @@ class TPUEngine(AsyncEngine):
                 frozen[j] = (r_j, r_j.epoch, "requeue")
                 freed += len(r_j.pages)
         active_rows = [i for i in live if i not in frozen and i not in stalled]
-        # A slot frozen at the PREVIOUS dispatch that this dispatch decided
+        # A slot frozen at a PREVIOUS dispatch that this dispatch decided
         # to keep (allocation succeeded, or it merely stalls) is live again:
-        # cancel the pending preemption record so processing the previous
-        # window doesn't spuriously requeue or oom-fail it — this dispatch's
-        # decision supersedes the previous one.
-        if self._inflight is not None:
+        # cancel the pending preemption records so processing the earlier
+        # windows doesn't spuriously requeue or oom-fail it — this
+        # dispatch's decision supersedes the previous ones.
+        for w in self._inflight:
             for i in (*active_rows, *stalled):
-                self._inflight.frozen.pop(i, None)
+                w.frozen.pop(i, None)
+        self._dispatch_serial += 1
         if not active_rows:
-            return _Window(toks=None, slots=[None] * b, frozen=frozen, size=M)
+            return _Window(toks=None, slots=[None] * b, frozen=frozen,
+                           size=M, serial=self._dispatch_serial)
         bucket = self.runner.bucket_pages_for(needed_max)
         packed = np.zeros((b, PK_PREFIX + bucket), np.int32)
         slots: list = [None] * b
@@ -589,16 +719,22 @@ class TPUEngine(AsyncEngine):
             toks.copy_to_host_async()
         except Exception:  # noqa: BLE001 — not all backends support it
             pass
-        return _Window(toks=toks, slots=slots, frozen=frozen, size=M)
+        return _Window(toks=toks, slots=slots, frozen=frozen, size=M,
+                       serial=self._dispatch_serial)
 
     def _process_window(self, w: _Window) -> None:
         page = self.config.page_size
         toks = np.asarray(w.toks) if w.toks is not None else None
-        # The previous window (whose pages these were) has now completed —
-        # its dummy scatters can no longer touch them.
-        if self._pending_release:
-            self.allocator.release(self._pending_release)
-            self._pending_release = []
+        self._release_ready_pages()
+        # Window processing walks host token chains; make sure every slot
+        # this window touches has its first token resolved.
+        if self._pending_first:
+            need = {i for i, snap in enumerate(w.slots)
+                    if snap is not None and snap[0].last_token is None}
+            need |= {i for i, (fr, _, _) in w.frozen.items()
+                     if fr.last_token is None}
+            if need:
+                self._force_resolve_first_for(need)
         for i, (fr, fepoch, reason) in w.frozen.items():
             r = self.slot_req[i]
             if r is not fr or r is None or r.epoch != fepoch:
@@ -683,9 +819,9 @@ class TPUEngine(AsyncEngine):
             # prefill / failed step) — drop their prefix-cache entries so no
             # future request reuses them.
             self.allocator.unregister(r.pages)
-        # Defer the release until the in-flight window (which may still
+        # Defer the release until every in-flight window (which may still
         # scatter dummy K/V through the old page table) completes.
-        self._pending_release.extend(r.pages)
+        self._pending_release.append((self._dispatch_serial, r.pages))
         r.pages = []
 
     def _requeue_slot(self, slot: int) -> None:

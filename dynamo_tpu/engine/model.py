@@ -163,30 +163,76 @@ def dense_causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.reshape(b, s, nh, d)
 
 
-def paged_decode_attention_xla(q: jax.Array, k_pages: jax.Array,
-                               v_pages: jax.Array, page_table: jax.Array,
-                               seq_lens: jax.Array, q_per_kv: int
-                               ) -> jax.Array:
-    """Reference/fallback decode attention (gather-based; CPU tests + any
-    platform). q [B,Nh,D]; k_pages/v_pages [Nkv,P,page,D]; page_table
-    [B,maxP]; seq_lens [B]. The Pallas kernel (attention.py) replaces this on
-    TPU — it reads only live pages from HBM instead of gathering max_len."""
+def paged_decode_attention_xla(q: jax.Array, k_cache: jax.Array,
+                               v_cache: jax.Array, layer: jax.Array,
+                               page_table: jax.Array, hist_lens: jax.Array,
+                               k_self: jax.Array, v_self: jax.Array,
+                               q_per_kv: int) -> jax.Array:
+    """Gather-based decode attention over the FULL stacked cache.
+
+    q [B,Nh,D]; k_cache/v_cache [L,Nkv,P,page,D]; layer: scalar layer index;
+    page_table [B,maxP]; hist_lens [B] = tokens already IN the cache (the
+    new token travels as k_self/v_self [B,Nkv,D] — its cache write is
+    deferred so the whole forward needs only ONE scatter; see
+    decode_forward). The layer index is folded into the gather itself —
+    never slice the cache (a dynamic-slice copy of cache/L per layer is the
+    difference between 1.5 ms and 50 ms steps at multi-GB pools).
+
+    This is the window attention with zero in-window columns."""
+    b = q.shape[0]
+    nkv, d = k_cache.shape[1], k_cache.shape[4]
+    empty = jnp.zeros((nkv, b, 0, d), k_cache.dtype)
+    return paged_window_attention_xla(
+        q, k_cache, v_cache, layer, page_table, hist_lens, empty, empty,
+        jnp.asarray(0, jnp.int32), k_self, v_self, q_per_kv)
+
+
+def paged_window_attention_xla(q: jax.Array, k_cache: jax.Array,
+                               v_cache: jax.Array, layer: jax.Array,
+                               page_table: jax.Array, hist_lens: jax.Array,
+                               k_win: jax.Array, v_win: jax.Array,
+                               m: jax.Array, k_self: jax.Array,
+                               v_self: jax.Array, q_per_kv: int) -> jax.Array:
+    """Decode attention for step ``m`` of an M-step window.
+
+    Keys/values come from three places: pages already in the cache
+    (hist_lens tokens, read via a layer-folded gather), the in-window
+    buffer k_win/v_win [Nkv,B,M,D] holding this window's previous steps
+    (cols j < m valid), and the current token (k_self/v_self [B,Nkv,D]).
+    The cache itself is read-only here — the window's writes are committed
+    by ONE scatter after the step scan, which is what lets XLA run the
+    whole window without copying the multi-GB pool (see runner._get_window).
+    """
     b, nh, d = q.shape
-    nkv, _, page, _ = k_pages.shape
+    nkv, page = k_cache.shape[1], k_cache.shape[3]
     maxp = page_table.shape[1]
-    k_all = k_pages[:, page_table]  # [Nkv,B,maxP,page,D]
-    v_all = v_pages[:, page_table]
-    k_all = k_all.reshape(nkv, b, maxp * page, d)
-    v_all = v_all.reshape(nkv, b, maxp * page, d)
+    M = k_win.shape[2]
+    idx_l = jnp.broadcast_to(layer, page_table.shape)
+    k_all = (k_cache[idx_l, :, page_table]
+             .transpose(2, 0, 1, 3, 4).reshape(nkv, b, maxp * page, d))
+    v_all = (v_cache[idx_l, :, page_table]
+             .transpose(2, 0, 1, 3, 4).reshape(nkv, b, maxp * page, d))
     qg = q.reshape(b, nkv, q_per_kv, d)
-    scores = jnp.einsum("bngd,nbld->bngl", qg, k_all,
-                        preferred_element_type=jnp.float32)
-    scores = scores / jnp.sqrt(jnp.float32(d))
-    positions = jnp.arange(maxp * page)[None, :]
-    mask = (positions < seq_lens[:, None])[:, None, None, :]
-    scores = jnp.where(mask, scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bngl,nbld->bngd", probs, v_all)
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    s_hist = jnp.einsum("bngd,nbld->bngl", qg, k_all,
+                        preferred_element_type=jnp.float32) * scale
+    pos = jnp.arange(maxp * page)[None, :]
+    s_hist = jnp.where((pos < hist_lens[:, None])[:, None, None, :],
+                       s_hist, -1e30)
+    s_win = jnp.einsum("bngd,nbjd->bngj", qg, k_win,
+                       preferred_element_type=jnp.float32) * scale
+    win_valid = (jnp.arange(M)[None, :] < m)[:, None, None, :]
+    s_win = jnp.where(jnp.broadcast_to(win_valid, s_win.shape), s_win, -1e30)
+    s_self = jnp.einsum("bngd,bnd->bng", qg, k_self,
+                        preferred_element_type=jnp.float32)[..., None] * scale
+    full = jnp.concatenate([s_hist, s_win, s_self], axis=-1)
+    probs = jax.nn.softmax(full, axis=-1)
+    p_hist = probs[..., :maxp * page].astype(q.dtype)
+    p_win = probs[..., maxp * page:-1].astype(q.dtype)
+    p_self = probs[..., -1]
+    out = (jnp.einsum("bngl,nbld->bngd", p_hist, v_all)
+           + jnp.einsum("bngj,nbjd->bngd", p_win, v_win)
+           + p_self[..., None].astype(q.dtype) * v_self[:, :, None, :])
     return out.reshape(b, nh, d)
 
 
@@ -216,8 +262,7 @@ def prefill_forward(params: Params, spec: ModelSpec,
     cos, sin = rope_tables(positions, d, spec.rope_theta)
     valid = jnp.arange(s)[None, :] < seq_lens[:, None]
 
-    def layer_fn(x, scan_in):
-        lp, k_pages_l, v_pages_l = scan_in
+    def layer_fn(x, lp):
         h = rms_norm(x, lp["input_norm"], spec.rms_norm_eps)
         q = jnp.einsum("bsh,hd->bsd", h, lp["wq"],
                        preferred_element_type=jnp.bfloat16)
@@ -234,14 +279,6 @@ def prefill_forward(params: Params, spec: ModelSpec,
         v = _split_heads(v, spec.num_kv_heads, d)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        # Write K/V into this chunk's pages: cache is [Nkv, P, page, D].
-        k_blocks = (k.reshape(b * (s // page), page, spec.num_kv_heads, d)
-                    .transpose(2, 0, 1, 3))
-        v_blocks = (v.reshape(b * (s // page), page, spec.num_kv_heads, d)
-                    .transpose(2, 0, 1, 3))
-        flat_pages = page_table.reshape(-1)
-        k_pages_l = k_pages_l.at[:, flat_pages].set(k_blocks)
-        v_pages_l = v_pages_l.at[:, flat_pages].set(v_blocks)
         attn = dense_causal_attention(q, k, v, positions, valid, spec.q_per_kv)
         attn = attn.reshape(b, s, -1)
         x = x + jnp.einsum("bsd,dh->bsh", attn, lp["wo"],
@@ -254,10 +291,22 @@ def prefill_forward(params: Params, spec: ModelSpec,
         ff = jax.nn.silu(gate.astype(jnp.float32)).astype(jnp.bfloat16) * up
         x = x + jnp.einsum("bsi,ih->bsh", ff, lp["w_down"],
                            preferred_element_type=jnp.bfloat16)
-        return x, (k_pages_l, v_pages_l)
+        return x, (k, v)
 
-    x, (k_cache, v_cache) = jax.lax.scan(
-        layer_fn, x, (params["layers"], k_cache, v_cache))
+    # Cache writes are deferred out of the scan (ys are fresh allocations —
+    # carrying the caches through would rewrite the whole pool per call).
+    x, (k_new, v_new) = jax.lax.scan(layer_fn, x, params["layers"])
+    # k_new [L,B,S,Nkv,D] -> page blocks [L,Nkv,B*S/page,page,D]; one
+    # in-place scatter per cache covers every layer.
+    L = spec.num_layers
+    nkv = spec.num_kv_heads
+    k_blocks = (k_new.reshape(L, b * (s // page), page, nkv, d)
+                .transpose(0, 3, 1, 2, 4))
+    v_blocks = (v_new.reshape(L, b * (s // page), page, nkv, d)
+                .transpose(0, 3, 1, 2, 4))
+    flat_pages = page_table.reshape(-1)
+    k_cache = k_cache.at[:, :, flat_pages].set(k_blocks)
+    v_cache = v_cache.at[:, :, flat_pages].set(v_blocks)
     x = rms_norm(x, params["final_norm"], spec.rms_norm_eps)
     # Last valid token per sequence.
     last_idx = jnp.maximum(seq_lens - 1, 0)
@@ -297,9 +346,17 @@ def decode_forward(params: Params, spec: ModelSpec,
         dest_page = jnp.where(write_mask, dest_page, 0)
         page_off = jnp.where(write_mask, page_off, 0)
     attn_fn = attention_impl or paged_decode_attention_xla
+    # The new token's K/V is NOT written inside the layer loop: attention
+    # takes it as an explicit self column (hist_lens = cache-resident
+    # length) and one batched scatter below writes all layers at once. The
+    # caches therefore never ride the scan as stacked ys — scan ys are
+    # freshly allocated each call, which silently rewrote the ENTIRE pool
+    # per decode step (50 ms/step at a 3 GB pool vs ~1.5 ms now).
+    hist_lens = jnp.maximum(seq_lens - 1, 0)
+    L = spec.num_layers
 
     def layer_fn(x, scan_in):
-        lp, k_pages_l, v_pages_l = scan_in
+        lp, layer = scan_in
         h = rms_norm(x, lp["input_norm"], spec.rms_norm_eps)
         q = h @ lp["wq"]
         k = h @ lp["wk"]
@@ -313,24 +370,83 @@ def decode_forward(params: Params, spec: ModelSpec,
         v = _split_heads(v, spec.num_kv_heads, d)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        # Scatter the new K/V token into its page (cache [Nkv,P,page,D]).
-        k_pages_l = k_pages_l.at[:, dest_page, page_off].set(k.transpose(1, 0, 2))
-        v_pages_l = v_pages_l.at[:, dest_page, page_off].set(v.transpose(1, 0, 2))
-        attn = attn_fn(q, k_pages_l, v_pages_l, page_table, seq_lens,
-                       spec.q_per_kv)  # [B,Nh,D]
+        attn = attn_fn(q, k_cache, v_cache, layer, page_table, hist_lens,
+                       k, v, spec.q_per_kv)  # [B,Nh,D]
         attn = attn.reshape(b, -1)
         x = x + attn @ lp["wo"]
         h2 = rms_norm(x, lp["post_attn_norm"], spec.rms_norm_eps)
         ff = (jax.nn.silu((h2 @ lp["w_gate"]).astype(jnp.float32))
               .astype(jnp.bfloat16) * (h2 @ lp["w_up"]))
         x = x + ff @ lp["w_down"]
-        return x, (k_pages_l, v_pages_l)
+        return x, (k, v)
 
-    x, (k_cache, v_cache) = jax.lax.scan(
-        layer_fn, x, (params["layers"], k_cache, v_cache))
+    x, (k_new, v_new) = jax.lax.scan(
+        layer_fn, x, (params["layers"], jnp.arange(L)))
+    # One in-place scatter: [L,Nkv,B,D] at (dest_page[b], page_off[b]).
+    k_cache = k_cache.at[:, :, dest_page, page_off].set(
+        k_new.transpose(0, 2, 1, 3))
+    v_cache = v_cache.at[:, :, dest_page, page_off].set(
+        v_new.transpose(0, 2, 1, 3))
     x = rms_norm(x, params["final_norm"], spec.rms_norm_eps)
     head = (params["embed"].T if spec.tie_word_embeddings
             else params["lm_head"])
     logits = jnp.einsum("bh,hv->bv", x, head,
                         preferred_element_type=jnp.float32)
     return logits, k_cache, v_cache
+
+
+def decode_window_step(params: Params, spec: ModelSpec,
+                       k_cache: jax.Array, v_cache: jax.Array,
+                       k_buf: jax.Array, v_buf: jax.Array, m: jax.Array,
+                       tokens: jax.Array, positions: jax.Array,
+                       page_table: jax.Array, hist_lens: jax.Array,
+                       attention_impl=None
+                       ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One decode step INSIDE an M-step window: the caches are read-only
+    (gathered), this window's earlier tokens come from k_buf/v_buf
+    [L,Nkv,B,M,D], and the step's fresh K/V is returned ([L,B,Nkv,D]) for
+    the caller to append to the buffer — no cache writes here at all.
+
+    hist_lens [B]: tokens cache-resident BEFORE the window (fixed across
+    the window). Returns (logits [B,V], k_new, v_new).
+    """
+    b = tokens.shape[0]
+    d = spec.head_dim
+    x = params["embed"][tokens].astype(jnp.bfloat16)
+    cos, sin = rope_tables(positions, d, spec.rope_theta)
+    attn_fn = attention_impl or paged_window_attention_xla
+    L = spec.num_layers
+
+    def layer_fn(x, scan_in):
+        lp, layer, kb_l, vb_l = scan_in
+        h = rms_norm(x, lp["input_norm"], spec.rms_norm_eps)
+        q = h @ lp["wq"]
+        k = h @ lp["wk"]
+        v = h @ lp["wv"]
+        if spec.qkv_bias:
+            q = q + lp["bq"]
+            k = k + lp["bk"]
+            v = v + lp["bv"]
+        q = _split_heads(q, spec.num_heads, d)
+        k = _split_heads(k, spec.num_kv_heads, d)
+        v = _split_heads(v, spec.num_kv_heads, d)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        attn = attn_fn(q, k_cache, v_cache, layer, page_table, hist_lens,
+                       kb_l, vb_l, m, k, v, spec.q_per_kv)
+        attn = attn.reshape(b, -1)
+        x = x + attn @ lp["wo"]
+        h2 = rms_norm(x, lp["post_attn_norm"], spec.rms_norm_eps)
+        ff = (jax.nn.silu((h2 @ lp["w_gate"]).astype(jnp.float32))
+              .astype(jnp.bfloat16) * (h2 @ lp["w_up"]))
+        x = x + ff @ lp["w_down"]
+        return x, (k, v)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer_fn, x, (params["layers"], jnp.arange(L), k_buf, v_buf))
+    x = rms_norm(x, params["final_norm"], spec.rms_norm_eps)
+    head = (params["embed"].T if spec.tie_word_embeddings
+            else params["lm_head"])
+    logits = jnp.einsum("bh,hv->bv", x, head,
+                        preferred_element_type=jnp.float32)
+    return logits, k_new, v_new

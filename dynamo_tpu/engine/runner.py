@@ -42,6 +42,7 @@ from dynamo_tpu.engine.model import (
     param_specs,
     prefill_forward,
     decode_forward,
+    decode_window_step,
 )
 from dynamo_tpu.engine.sampler import sample_tokens
 from dynamo_tpu.runtime.logging import get_logger
@@ -146,7 +147,8 @@ class ModelRunner:
         self._window_cache: dict = {}
         self._rng = jax.random.key(seed + 1)
         self.tokens_dev = jnp.zeros((config.max_num_seqs,), jnp.int32)
-        self._attention_impl = self._pick_attention()
+        self._attention_impl, self._window_attention_impl = \
+            self._pick_attention()
 
     # -- setup ---------------------------------------------------------------
     def _sized_pages(self, device) -> None:
@@ -170,16 +172,17 @@ class ModelRunner:
                  cfg.page_size, self.num_pages * page_bytes / (1 << 30))
 
     def _pick_attention(self):
+        """Returns (single-step impl, window impl)."""
+        from dynamo_tpu.engine.model import paged_window_attention_xla
         backend = self.config.attention_backend
         if backend == "auto":
             # The bucketed XLA gather is the default. Measured on v5e
             # (qwen2.5-0.5b, bs32, M=16 windows, end-to-end decode_window
             # incl. readback — scripts/profile_decode.py): uniform-length
-            # batches favor xla (297 vs 323 ms/window at seq 800); the
-            # Pallas kernel wins only the mixed-length case its design
-            # targets (1x800+31x64: 277 vs 296 ms/window) — within run
-            # noise, so it stays opt-in. Correctness is CI-tested either
-            # way (tests/test_attention_pallas.py, CPU interpret + TPU).
+            # batches favor xla; the Pallas kernel wins only the
+            # mixed-length case its design targets, within run noise, so
+            # it stays opt-in. Correctness is CI-tested either way
+            # (tests/test_attention_pallas.py, CPU interpret + TPU).
             backend = "xla"
         if backend == "pallas":
             d = self.spec.head_dim
@@ -192,13 +195,16 @@ class ModelRunner:
                 # 128 % D == 0 and page_size*D % 128 == 0.
                 log.info("head_dim %d/page %d not packable to 128 lanes; "
                          "pallas kernel disabled", d, page)
-                return paged_decode_attention_xla
+                return paged_decode_attention_xla, paged_window_attention_xla
             try:
-                from dynamo_tpu.engine.attention import paged_decode_attention_pallas
-                return paged_decode_attention_pallas
+                from dynamo_tpu.engine.attention import (
+                    paged_decode_attention_pallas,
+                    paged_window_attention_pallas)
+                return (paged_decode_attention_pallas,
+                        paged_window_attention_pallas)
             except Exception:  # noqa: BLE001
                 log.exception("pallas attention unavailable; using xla")
-        return paged_decode_attention_xla
+        return paged_decode_attention_xla, paged_window_attention_xla
 
     # -- compiled steps -------------------------------------------------------
     def _get_prefill(self, bucket: int, batch: int, with_history: bool):
@@ -271,12 +277,13 @@ class ModelRunner:
         if fn is not None:
             return fn
         spec = self.spec
+        page = self.config.page_size
 
         def run_window(params, k_cache, v_cache, tokens_dev, packed, rng):
             mask = packed[:, PK_OVERRIDE] > 0
-            tokens = jnp.where(mask, packed[:, PK_TOKEN], tokens_dev)
-            positions = packed[:, PK_POS]
-            seq_lens = packed[:, PK_SEQLEN]
+            tokens0 = jnp.where(mask, packed[:, PK_TOKEN], tokens_dev)
+            positions0 = packed[:, PK_POS]
+            seq_lens0 = packed[:, PK_SEQLEN]
             top_k = packed[:, PK_TOPK]
             temp = jax.lax.bitcast_convert_type(packed[:, PK_TEMP],
                                                 jnp.float32)
@@ -284,29 +291,63 @@ class ModelRunner:
                                                  jnp.float32)
             cap = packed[:, PK_CAP]
             page_table = packed[:, PK_PREFIX:]
+            B = tokens0.shape[0]
+            L, nkv = spec.num_layers, spec.num_kv_heads
+            d = spec.head_dim
+            # Cache-resident history length is FIXED across the window: the
+            # window's own tokens live in a small in-window buffer and are
+            # committed to the pool by ONE scatter at the end. The caches
+            # are read-only inside the scan — carrying a multi-GB pool
+            # through scan ys/carries makes XLA copy it per step (measured:
+            # 50 ms/step at a 3 GB pool, vs flat ~1.5 ms this way).
+            hist_lens = jnp.maximum(seq_lens0 - 1, 0)
+            kbuf0 = jnp.zeros((L, nkv, B, window, d), k_cache.dtype)
+            vbuf0 = jnp.zeros((L, nkv, B, window, d), v_cache.dtype)
 
-            def step(carry, _):
-                k_cache, v_cache, tokens, positions, seq_lens, rng = carry
+            def step(carry, m):
+                tokens, positions, kbuf, vbuf, rng = carry
                 # A slot advances only while live AND within its allocated
-                # pages; at capacity it freezes in-graph (scatters go to the
-                # scratch page; the host emits LENGTH when it sees the cap).
-                live = (seq_lens > 0) & (positions < cap)
-                logits, k_cache, v_cache = decode_forward(
-                    params, spec, k_cache, v_cache, tokens, positions,
-                    page_table, seq_lens,
-                    attention_impl=self._attention_impl, write_mask=live)
+                # pages; at capacity it freezes in-graph (the host emits
+                # LENGTH when it sees the cap).
+                live = (seq_lens0 > 0) & (positions < cap)
+                logits, k_new, v_new = decode_window_step(
+                    params, spec, k_cache, v_cache, kbuf, vbuf, m, tokens,
+                    positions, page_table, hist_lens,
+                    attention_impl=self._window_attention_impl)
+                # Append this step's K/V ([L,B,Nkv,D] -> window col m).
+                kbuf = jax.lax.dynamic_update_slice(
+                    kbuf, k_new.transpose(0, 2, 1, 3)[:, :, :, None],
+                    (0, 0, 0, m, 0))
+                vbuf = jax.lax.dynamic_update_slice(
+                    vbuf, v_new.transpose(0, 2, 1, 3)[:, :, :, None],
+                    (0, 0, 0, m, 0))
                 rng, sub = jax.random.split(rng)
                 sampled = sample_tokens(logits, temp, top_k, top_p, sub)
-                adv = live.astype(jnp.int32)
                 tokens = jnp.where(live, sampled, tokens)
-                positions = positions + adv
-                seq_lens = seq_lens + adv
-                return (k_cache, v_cache, tokens, positions, seq_lens,
-                        rng), sampled
+                positions = positions + live.astype(jnp.int32)
+                return (tokens, positions, kbuf, vbuf, rng), sampled
 
-            (k_cache, v_cache, tokens, _, _, rng), toks = jax.lax.scan(
-                step, (k_cache, v_cache, tokens, positions, seq_lens, rng),
-                None, length=window)
+            (tokens, _, kbuf, vbuf, rng), toks = jax.lax.scan(
+                step, (tokens0, positions0, kbuf0, vbuf0, rng),
+                jnp.arange(window))
+            # Commit the window: scatter every (slot, step) entry into its
+            # page. Frozen/inactive entries land on the scratch page 0.
+            m_idx = jnp.arange(window)[:, None]                      # [M,1]
+            adv = jnp.clip(jnp.minimum(m_idx, cap[None, :] - positions0),
+                           0, None)
+            pos_m = positions0[None, :] + adv                        # [M,B]
+            live_m = (seq_lens0[None, :] > 0) & (pos_m < cap[None, :])
+            pidx = jnp.clip(pos_m // page, 0, page_table.shape[1] - 1)
+            dest = jnp.take_along_axis(
+                jnp.broadcast_to(page_table[None], (window, *page_table.shape)),
+                pidx[:, :, None], axis=2)[:, :, 0]                   # [M,B]
+            dest = jnp.where(live_m, dest, 0)
+            off = jnp.where(live_m, pos_m % page, 0)
+            # kbuf [L,Nkv,B,M,D] -> [L,Nkv,M,B,D] matching index arrays.
+            k_cache = k_cache.at[:, :, dest, off].set(
+                kbuf.transpose(0, 1, 3, 2, 4))
+            v_cache = v_cache.at[:, :, dest, off].set(
+                vbuf.transpose(0, 1, 3, 2, 4))
             return toks, tokens, k_cache, v_cache, rng
 
         fn = jax.jit(run_window, donate_argnums=(1, 2))
@@ -314,10 +355,19 @@ class ModelRunner:
         return fn
 
     # -- public API (blocking; called from the engine thread) -----------------
-    def prefill_batch(self, seqs: list[PrefillSeq]) -> np.ndarray:
+    def prefill_batch(self, seqs: list[PrefillSeq],
+                      slots: list[int] | None = None):
         """Prefill a batch of chunks (same compiled program per
-        (bucket, padded-batch, with_history) key); returns sampled first
-        tokens [len(seqs)].
+        (bucket, padded-batch, with_history) key).
+
+        With ``slots=None`` (tests, disagg prefill): blocks and returns the
+        sampled first tokens [len(seqs)] as numpy. With ``slots`` given
+        (the serving engine): the sampled tokens are ALSO scattered into
+        ``tokens_dev[slots]`` on-device — the decode windows chain from
+        them with no override upload — and the DEVICE array is returned so
+        the caller can fetch the values asynchronously (first-token
+        emission never blocks the dispatch pipeline on a host<->device
+        round trip).
 
         All rows must agree on with-history-ness; rows are padded to the next
         batch bucket (1,2,4,8) with inactive rows.
@@ -361,6 +411,16 @@ class ModelRunner:
                 self._rng)
         # Device handle (no transfer unless a caller converts it).
         self.last_prefill_logits = logits
+        if slots is not None:
+            idx = jnp.asarray(np.asarray(slots, np.int32))
+            with self.mesh:
+                self.tokens_dev = self.tokens_dev.at[idx].set(
+                    sampled[:len(seqs)])
+            try:
+                sampled.copy_to_host_async()
+            except Exception:  # noqa: BLE001 — not all backends support it
+                pass
+            return sampled
         return np.asarray(jax.device_get(sampled))[:len(seqs)]
 
     def prefill(self, tokens: np.ndarray, start_pos: int,
@@ -532,13 +592,14 @@ def _prefill_with_history(params, spec, k_cache, v_cache, tokens, positions,
     d = spec.head_dim
     nkv = spec.num_kv_heads
     page = k_cache.shape[3]
+    L = spec.num_layers
     x = params["embed"][tokens].astype(jnp.bfloat16)
     cos, sin = rope_tables(positions, d, spec.rope_theta)
     valid = jnp.arange(s)[None, :] < seq_lens[:, None]
     maxp = hist_table.shape[1]
 
     def layer_fn(x, scan_in):
-        lp, k_pages_l, v_pages_l = scan_in
+        lp, layer = scan_in
         h = rms_norm(x, lp["input_norm"], spec.rms_norm_eps)
         q = jnp.einsum("bsh,hd->bsd", h, lp["wq"],
                        preferred_element_type=jnp.bfloat16)
@@ -555,13 +616,6 @@ def _prefill_with_history(params, spec, k_cache, v_cache, tokens, positions,
         v = _split_heads(v, nkv, d)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        k_blocks = (k.reshape(b * (s // page), page, nkv, d)
-                    .transpose(2, 0, 1, 3))
-        v_blocks = (v.reshape(b * (s // page), page, nkv, d)
-                    .transpose(2, 0, 1, 3))
-        flat = page_table.reshape(-1)
-        k_pages_l = k_pages_l.at[:, flat].set(k_blocks)
-        v_pages_l = v_pages_l.at[:, flat].set(v_blocks)
         # In-chunk causal scores (grouped GQA, no repeat).
         qg = q.reshape(b, s, nkv, spec.q_per_kv, d)
         chunk_scores = jnp.einsum("bqngd,bknd->bngqk", qg, k,
@@ -570,9 +624,14 @@ def _prefill_with_history(params, spec, k_cache, v_cache, tokens, positions,
                   >= positions[:, None, None, None, :])
         chunk_scores = jnp.where(causal & valid[:, None, None, None, :],
                                  chunk_scores, -1e30)
-        # History scores over prior pages ([Nkv,P,page,D] cache).
-        k_hist = k_pages_l[:, hist_table].reshape(nkv, b, maxp * page, d)
-        v_hist = v_pages_l[:, hist_table].reshape(nkv, b, maxp * page, d)
+        # History over prior pages: layer-folded gather from the stacked
+        # cache (hist pages are disjoint from this chunk's pages, whose
+        # writes are deferred out of the scan).
+        idx_l = jnp.broadcast_to(layer, hist_table.shape)
+        k_hist = (k_cache[idx_l, :, hist_table]
+                  .transpose(2, 0, 1, 3, 4).reshape(nkv, b, maxp * page, d))
+        v_hist = (v_cache[idx_l, :, hist_table]
+                  .transpose(2, 0, 1, 3, 4).reshape(nkv, b, maxp * page, d))
         hist_scores = jnp.einsum("bqngd,nbld->bngql", qg, k_hist,
                                  preferred_element_type=jnp.float32)
         hist_valid = (jnp.arange(maxp * page)[None, :]
@@ -595,10 +654,17 @@ def _prefill_with_history(params, spec, k_cache, v_cache, tokens, positions,
         ff = jax.nn.silu(gate.astype(jnp.float32)).astype(jnp.bfloat16) * up
         x = x + jnp.einsum("bsi,ih->bsh", ff, lp["w_down"],
                            preferred_element_type=jnp.bfloat16)
-        return x, (k_pages_l, v_pages_l)
+        return x, (k, v)
 
-    x, (k_cache, v_cache) = jax.lax.scan(
-        layer_fn, x, (params["layers"], k_cache, v_cache))
+    x, (k_new, v_new) = jax.lax.scan(
+        layer_fn, x, (params["layers"], jnp.arange(L)))
+    k_blocks = (k_new.reshape(L, b * (s // page), page, nkv, d)
+                .transpose(0, 3, 1, 2, 4))
+    v_blocks = (v_new.reshape(L, b * (s // page), page, nkv, d)
+                .transpose(0, 3, 1, 2, 4))
+    flat = page_table.reshape(-1)
+    k_cache = k_cache.at[:, :, flat].set(k_blocks)
+    v_cache = v_cache.at[:, :, flat].set(v_blocks)
     x = rms_norm(x, params["final_norm"], spec.rms_norm_eps)
     last_idx = jnp.maximum(seq_lens - 1, 0)
     x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]

@@ -83,6 +83,7 @@ class CoordinatorClient:
         self.primary_lease_id: int | None = None
         self._lease_ttl_s = 10.0
         self._lease_recreated_callbacks: list = []
+        self._regrant_lock = asyncio.Lock()
         self._closed = False
 
     @classmethod
@@ -173,18 +174,32 @@ class CoordinatorClient:
                     continue
                 # Lease expired server-side (e.g. event-loop stall past TTL):
                 # re-grant and let registrants re-register.
-                log.error("primary lease %d expired; re-granting", lease_id)
                 try:
-                    lease_id = await self.lease_grant(self._lease_ttl_s)
-                    self.primary_lease_id = lease_id
-                    for cb in list(self._lease_recreated_callbacks):
-                        try:
-                            await cb(lease_id)
-                        except Exception:  # noqa: BLE001
-                            log.exception("lease-recreated callback failed")
+                    await self._regrant_primary()
+                    lease_id = self.primary_lease_id
                 except (ConnectionError, RuntimeError) as exc2:
                     log.error("lease re-grant failed: %s", exc2)
                     return
+
+    async def _regrant_primary(self) -> None:
+        """Re-grant the primary lease after server-side expiry and replay
+        the registration callbacks. Safe under concurrency: whoever loses
+        the lock re-checks liveness first."""
+        async with self._regrant_lock:
+            try:
+                await self._request({"m": "lease_keepalive",
+                                     "lease": self.primary_lease_id})
+                return  # someone else already re-granted
+            except RuntimeError:
+                pass
+            log.error("primary lease %s expired; re-granting",
+                      self.primary_lease_id)
+            self.primary_lease_id = await self.lease_grant(self._lease_ttl_s)
+            for cb in list(self._lease_recreated_callbacks):
+                try:
+                    await cb(self.primary_lease_id)
+                except Exception:  # noqa: BLE001
+                    log.exception("lease-recreated callback failed")
 
     async def _request(self, msg: dict) -> Any:
         if self._writer is None or self._writer.is_closing():
@@ -207,7 +222,9 @@ class CoordinatorClient:
     async def kv_put(self, key: str, value: Any, lease_id: int | None = None,
                      use_primary_lease: bool = False) -> int:
         if use_primary_lease:
-            lease_id = self.primary_lease_id
+            return await self._with_primary_lease(
+                lambda lease: self._request(
+                    {"m": "kv_put", "k": key, "v": value, "lease": lease}))
         return await self._request({"m": "kv_put", "k": key, "v": value,
                                     "lease": lease_id})
 
@@ -215,10 +232,26 @@ class CoordinatorClient:
                         use_primary_lease: bool = False) -> bool:
         """Atomic create; False if the key already exists (etcd.rs kv_create)."""
         if use_primary_lease:
-            lease_id = self.primary_lease_id
-        rev = await self._request({"m": "kv_create", "k": key, "v": value,
-                                   "lease": lease_id})
+            rev = await self._with_primary_lease(
+                lambda lease: self._request(
+                    {"m": "kv_create", "k": key, "v": value, "lease": lease}))
+        else:
+            rev = await self._request({"m": "kv_create", "k": key, "v": value,
+                                       "lease": lease_id})
         return rev is not None
+
+    async def _with_primary_lease(self, fn):
+        """Run a lease-attached request; if the primary lease expired while
+        we weren't looking (event-loop stall past the TTL), re-grant it and
+        retry once — registration must not fail just because the process
+        was briefly too busy to keep its lease alive."""
+        try:
+            return await fn(self.primary_lease_id)
+        except RuntimeError as exc:
+            if "not found" not in str(exc):
+                raise
+            await self._regrant_primary()
+            return await fn(self.primary_lease_id)
 
     async def kv_get(self, key: str) -> Any | None:
         result = await self._request({"m": "kv_get", "k": key})

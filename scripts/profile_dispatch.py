@@ -45,11 +45,13 @@ def main():
         from dynamo_tpu.engine.attention import paged_decode_attention_pallas
         b, nkv, qpk, dd, pages, page, maxp = 4, 8, 4, 128, 64, 16, 8
         q = jnp.zeros((b, nkv * qpk, dd), jnp.bfloat16)
-        kp = jnp.zeros((nkv, pages, page, dd), jnp.bfloat16)
+        kc = jnp.zeros((2, nkv, pages, page, dd), jnp.bfloat16)
+        ks = jnp.zeros((b, nkv, dd), jnp.bfloat16)
         pt = jnp.zeros((b, maxp), jnp.int32)
         sl = jnp.full((b,), 20, jnp.int32)
-        out = paged_decode_attention_pallas(q, kp, kp, pt, sl, qpk)
-        jax.block_until_ready(out)
+        out = paged_decode_attention_pallas(
+            q, kc, kc, jnp.asarray(0, jnp.int32), pt, sl, ks, ks, qpk)
+        out = np.asarray(out)
         print("pallas D=128 OK", out.shape)
     except Exception as e:  # noqa: BLE001
         print("pallas D=128 failed:", type(e).__name__, str(e)[:500])

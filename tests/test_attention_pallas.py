@@ -3,12 +3,12 @@
 Runs everywhere: on CPU the TPU kernel executes through Pallas interpret
 lowering; on a real TPU it compiles through Mosaic. Covers both kernel
 layouts — D=64 (lane-packed, 2 tokens per 128-lane row) and D=128
-(natural) — across ragged sequence lengths, GQA grouping, and page-table
+(natural) — across ragged sequence lengths, GQA grouping, layer indexing
+into the stacked cache, the deferred self-token column, and page-table
 indirection. Tolerances are bf16-input flash-vs-softmax differences.
 """
 
 import numpy as np
-import jax
 import jax.numpy as jnp
 import pytest
 
@@ -16,59 +16,102 @@ from dynamo_tpu.engine.attention import paged_decode_attention_pallas
 from dynamo_tpu.engine.model import paged_decode_attention_xla
 
 
-def _case(d, b, nkv, qpk, maxp, seq_lens, seed=0, page=16):
+def _case(d, b, nkv, qpk, maxp, seq_lens, seed=0, page=16, L=2):
     rng = np.random.default_rng(seed)
     nh = nkv * qpk
     npages = maxp * b + 2
     q = jnp.asarray(rng.standard_normal((b, nh, d)), jnp.bfloat16)
-    kp = jnp.asarray(rng.standard_normal((nkv, npages, page, d)),
+    kc = jnp.asarray(rng.standard_normal((L, nkv, npages, page, d)),
                      jnp.bfloat16)
-    vp = jnp.asarray(rng.standard_normal((nkv, npages, page, d)),
+    vc = jnp.asarray(rng.standard_normal((L, nkv, npages, page, d)),
                      jnp.bfloat16)
+    ks = jnp.asarray(rng.standard_normal((b, nkv, d)), jnp.bfloat16)
+    vs = jnp.asarray(rng.standard_normal((b, nkv, d)), jnp.bfloat16)
     pt = np.zeros((b, maxp), np.int32)
     for i in range(b):
         pt[i] = rng.permutation(np.arange(1, npages - 1))[:maxp]
     sl = jnp.asarray(seq_lens, jnp.int32)
-    return q, kp, vp, jnp.asarray(pt), sl
+    return q, kc, vc, jnp.asarray(pt), sl, ks, vs
+
+
+def _both(args, qpk, layer=1):
+    q, kc, vc, pt, sl, ks, vs = args
+    ly = jnp.asarray(layer, jnp.int32)
+    ref = np.asarray(
+        paged_decode_attention_xla(q, kc, vc, ly, pt, sl, ks, vs, qpk),
+        np.float32)
+    out = np.asarray(
+        paged_decode_attention_pallas(q, kc, vc, ly, pt, sl, ks, vs, qpk),
+        np.float32)
+    return ref, out
 
 
 @pytest.mark.parametrize("d", [64, 128])
 def test_pallas_matches_xla(d):
-    q, kp, vp, pt, sl = _case(d, b=4, nkv=2, qpk=4, maxp=8,
-                              seq_lens=[5, 17, 64, 128])
-    ref = np.asarray(paged_decode_attention_xla(q, kp, vp, pt, sl, 4),
-                     np.float32)
-    out = np.asarray(paged_decode_attention_pallas(q, kp, vp, pt, sl, 4),
-                     np.float32)
+    ref, out = _both(_case(d, b=4, nkv=2, qpk=4, maxp=8,
+                           seq_lens=[5, 17, 64, 128]), qpk=4)
     np.testing.assert_allclose(out, ref, atol=0.03, rtol=0.03)
 
 
 @pytest.mark.parametrize("d", [64, 128])
 def test_pallas_matches_xla_long_ragged(d):
-    """Sequence lengths crossing multiple DMA chunks (chunk = 128 tokens),
-    including non-chunk-aligned and single-token rows."""
-    q, kp, vp, pt, sl = _case(d, b=4, nkv=2, qpk=2, maxp=32,
-                              seq_lens=[1, 129, 300, 512], seed=3)
-    ref = np.asarray(paged_decode_attention_xla(q, kp, vp, pt, sl, 2),
-                     np.float32)
-    out = np.asarray(paged_decode_attention_pallas(q, kp, vp, pt, sl, 2),
-                     np.float32)
+    """Lengths crossing multiple DMA chunks (chunk = 128 tokens), including
+    zero-history (self-attention only) and non-chunk-aligned rows."""
+    ref, out = _both(_case(d, b=4, nkv=2, qpk=2, maxp=32,
+                           seq_lens=[0, 129, 300, 511], seed=3), qpk=2)
     np.testing.assert_allclose(out, ref, atol=0.03, rtol=0.03)
+
+
+@pytest.mark.parametrize("layer", [0, 1])
+def test_pallas_layer_indexing(layer):
+    """The kernel must read the requested layer of the stacked cache."""
+    args = _case(64, b=2, nkv=2, qpk=2, maxp=4, seq_lens=[30, 61], seed=4)
+    ref, out = _both(args, qpk=2, layer=layer)
+    np.testing.assert_allclose(out, ref, atol=0.03, rtol=0.03)
+    # Cross-check: the two layers genuinely differ.
+    other, _ = _both(args, qpk=2, layer=1 - layer)
+    assert np.max(np.abs(ref - other)) > 0.01
 
 
 def test_pallas_mqa_single_group():
     """MQA extreme: one KV head, 8 query heads."""
-    q, kp, vp, pt, sl = _case(64, b=2, nkv=1, qpk=8, maxp=8,
-                              seq_lens=[33, 90], seed=5)
-    ref = np.asarray(paged_decode_attention_xla(q, kp, vp, pt, sl, 8),
-                     np.float32)
-    out = np.asarray(paged_decode_attention_pallas(q, kp, vp, pt, sl, 8),
-                     np.float32)
+    ref, out = _both(_case(64, b=2, nkv=1, qpk=8, maxp=8,
+                           seq_lens=[33, 90], seed=5), qpk=8)
+    np.testing.assert_allclose(out, ref, atol=0.03, rtol=0.03)
+
+
+@pytest.mark.parametrize("m", [0, 3])
+def test_pallas_window_matches_xla(m):
+    """Window variant: history kernel + in-window buffer cols (j < m) +
+    self column must match the XLA window reference."""
+    from dynamo_tpu.engine.attention import paged_window_attention_pallas
+    from dynamo_tpu.engine.model import paged_window_attention_xla
+    rng = np.random.default_rng(7)
+    b, nkv, qpk, d, maxp, page, L, M = 4, 2, 2, 64, 8, 16, 2, 8
+    q = jnp.asarray(rng.standard_normal((b, nkv * qpk, d)), jnp.bfloat16)
+    npages = maxp * b + 2
+    kc = jnp.asarray(rng.standard_normal((L, nkv, npages, page, d)),
+                     jnp.bfloat16)
+    vc = jnp.asarray(rng.standard_normal((L, nkv, npages, page, d)),
+                     jnp.bfloat16)
+    kw = jnp.asarray(rng.standard_normal((nkv, b, M, d)), jnp.bfloat16)
+    vw = jnp.asarray(rng.standard_normal((nkv, b, M, d)), jnp.bfloat16)
+    ks = jnp.asarray(rng.standard_normal((b, nkv, d)), jnp.bfloat16)
+    vs = jnp.asarray(rng.standard_normal((b, nkv, d)), jnp.bfloat16)
+    pt = np.zeros((b, maxp), np.int32)
+    for i in range(b):
+        pt[i] = rng.permutation(np.arange(1, npages - 1))[:maxp]
+    pt = jnp.asarray(pt)
+    sl = jnp.asarray([0, 30, 64, 127], jnp.int32)
+    ly = jnp.asarray(1, jnp.int32)
+    mm = jnp.asarray(m, jnp.int32)
+    ref = np.asarray(paged_window_attention_xla(
+        q, kc, vc, ly, pt, sl, kw, vw, mm, ks, vs, qpk), np.float32)
+    out = np.asarray(paged_window_attention_pallas(
+        q, kc, vc, ly, pt, sl, kw, vw, mm, ks, vs, qpk), np.float32)
     np.testing.assert_allclose(out, ref, atol=0.03, rtol=0.03)
 
 
 def test_pallas_rejects_unpackable_head_dim():
     with pytest.raises(AssertionError):
-        q, kp, vp, pt, sl = _case(48, b=2, nkv=1, qpk=2, maxp=4,
-                                  seq_lens=[8, 8])
-        paged_decode_attention_pallas(q, kp, vp, pt, sl, 2)
+        _both(_case(48, b=2, nkv=1, qpk=2, maxp=4, seq_lens=[8, 8]), qpk=2)
