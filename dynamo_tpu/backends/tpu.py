@@ -51,6 +51,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument("--dp", type=int, default=1)
     parser.add_argument("--attention-backend", default="auto",
                         choices=["auto", "pallas", "xla"])
+    parser.add_argument("--host-cache-pages", type=int, default=0,
+                        help="G2 host-DRAM KV block cache capacity in "
+                             "pages (0 = disabled); evicted HBM pages "
+                             "offload here and onboard on prefix hits")
+    parser.add_argument("--kv-disk-cache-dir", default=None,
+                        help="G3 disk tier directory behind the host cache")
     parser.add_argument("--migration-limit", type=int, default=0)
     parser.add_argument("--coordinator-url", default=None)
     parser.add_argument("--mode", default="agg",
@@ -80,7 +86,9 @@ def build_engine_config(args) -> EngineConfig:
     return EngineConfig(
         model=spec, page_size=args.page_size, num_pages=args.num_pages,
         max_num_seqs=args.max_num_seqs, max_pages_per_seq=args.max_pages_per_seq,
-        tp=args.tp, dp=args.dp, attention_backend=args.attention_backend)
+        tp=args.tp, dp=args.dp, attention_backend=args.attention_backend,
+        host_cache_pages=args.host_cache_pages,
+        kv_disk_cache_dir=args.kv_disk_cache_dir)
 
 
 async def run(args: argparse.Namespace) -> None:

@@ -137,6 +137,14 @@ class EngineConfig:
     dtype: str = "bfloat16"
     # Attention backend: "auto" | "pallas" | "xla"
     attention_backend: str = "auto"
+    # KV tiering (reference KVBM G1..G3, block_manager.rs:72-82):
+    # host_cache_pages > 0 enables the G2 host-DRAM block cache — pages
+    # evicted from HBM are offloaded (async extract overlapping compute)
+    # and prefix hits on spilled blocks are onboarded by upload instead of
+    # recomputed. kv_disk_cache_dir adds the G3 disk tier behind it.
+    host_cache_pages: int = 0
+    kv_disk_cache_dir: str | None = None
+    disk_cache_pages: int = 4096
 
     def bucket_for(self, length: int) -> int:
         for b in self.prefill_buckets:

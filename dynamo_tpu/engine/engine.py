@@ -88,6 +88,24 @@ class TPUEngine(AsyncEngine):
         self.config = config
         self.runner = ModelRunner(config, params=params, devices=devices)
         self.allocator = PageAllocator(self.runner.num_pages, config.page_size)
+        # KV tiering (G2 host DRAM + optional G3 disk): HBM evictions are
+        # offloaded via async extracts; prefix hits on spilled blocks are
+        # onboarded by upload instead of recomputing the prefill.
+        self.host_cache = None
+        if config.host_cache_pages > 0 or config.kv_disk_cache_dir:
+            from dynamo_tpu.engine.kv_host_cache import (DiskKVCache,
+                                                         HostKVCache)
+            disk = (DiskKVCache(config.kv_disk_cache_dir,
+                                config.disk_cache_pages)
+                    if config.kv_disk_cache_dir else None)
+            # A disk tier with no G2 capacity still needs a small DRAM
+            # front (demotions flow through it).
+            capacity = config.host_cache_pages or 16
+            self.host_cache = HostKVCache(capacity, disk)
+            self.allocator.evict_hook = self._on_evict
+        self._evict_buffer: list[tuple[int, int]] = []
+        self._pending_spills: list[dict] = []
+        self.onboard_blocks = 0
         self.kv_publisher = kv_publisher
         self.metrics_publisher = metrics_publisher
         b = config.max_num_seqs
@@ -277,6 +295,7 @@ class TPUEngine(AsyncEngine):
         while self._running:
             self._run_jobs()
             self._resolve_ready_first()
+            self._resolve_spills()
             try:
                 admitted = self._admit()
             except Exception:  # noqa: BLE001
@@ -310,11 +329,87 @@ class TPUEngine(AsyncEngine):
                 self._publish()
             self._release_ready_pages()
             if not self._inflight and not admitted and not have_active:
+                self._resolve_spills(force=True)
                 time.sleep(0.002)  # fully idle
             elif not self._inflight and self._pending_first:
                 # Nothing left on the device but first tokens unfetched
                 # (e.g. a lone max_tokens=1 request): block on them now.
                 self._resolve_ready_first(force=True)
+
+    # -- KV tiering (G2/G3 offload + onboard) ---------------------------------
+    def _on_evict(self, block_hash: int, page: int) -> None:
+        self._evict_buffer.append((block_hash, page))
+
+    def _flush_spills(self) -> None:
+        """Dispatch one batched extract for pages evicted since the last
+        flush. MUST run before any program that writes KV pages (the
+        device stream then orders the read before the overwrite); the host
+        fetch resolves asynchronously."""
+        if not self._evict_buffer:
+            return
+        batch, self._evict_buffer = self._evict_buffer, []
+        hashes = [h for h, _ in batch]
+        pages = [p for _, p in batch]
+        try:
+            handle = self.runner.extract_pages_async(pages)
+        except Exception:  # noqa: BLE001 — offload is best-effort
+            log.exception("spill extract failed; blocks dropped from tiers")
+            return
+        self._pending_spills.append({"handle": handle, "hashes": hashes})
+
+    def _resolve_spills(self, force: bool = False) -> None:
+        if not self._pending_spills or self.host_cache is None:
+            return
+        for entry in list(self._pending_spills):
+            dev, _ = entry["handle"]
+            ready = getattr(dev, "is_ready", lambda: True)()
+            if not (ready or force):
+                continue
+            self._pending_spills.remove(entry)
+            try:
+                kv = self.runner.finalize_extract(entry["handle"])
+            except Exception:  # noqa: BLE001
+                log.exception("spill fetch failed; blocks dropped")
+                continue
+            for i, h in enumerate(entry["hashes"]):
+                self.host_cache.put(h, kv[:, :, :, i])
+
+    def _try_onboard(self, r: _Request, hashes: list[int],
+                     cached_pages: list[int]) -> tuple[list[int], int]:
+        """Extend the G1 prefix hit with consecutive G2/G3 blocks: upload
+        them into fresh pages (re-registered for sharing) instead of
+        recomputing. Returns (extra_pages, extra_tokens)."""
+        page = self.config.page_size
+        if self.host_cache is None:
+            return [], 0
+        # Never reuse past the second-to-last block (the last token must
+        # always be recomputed for logits), matching the G1 rule.
+        allowed = (len(r.tokens_all) - 1) // page - len(cached_pages)
+        blocks: list[tuple[int, np.ndarray]] = []
+        for h in hashes[len(cached_pages):]:
+            if len(blocks) >= allowed:
+                break
+            kv = self.host_cache.get(h)
+            if kv is None:
+                break
+            blocks.append((h, kv))
+        if not blocks:
+            return [], 0
+        pages = self.allocator.allocate(len(blocks))
+        if pages is None:
+            return [], 0
+        self._flush_spills()  # the allocation may itself have evicted
+        stacked = np.stack([kv for _, kv in blocks], axis=3)
+        try:
+            self.runner.insert_pages(stacked, pages)
+        except Exception:  # noqa: BLE001
+            log.exception("onboard upload failed; recomputing instead")
+            self.allocator.release(pages)
+            return [], 0
+        for (h, _), p in zip(blocks, pages):
+            self.allocator.register(p, h)
+        self.onboard_blocks += len(blocks)
+        return pages, len(blocks) * page
 
     def _release_ready_pages(self) -> None:
         """Release deferred pages whose potential writers are done. An
@@ -487,6 +582,7 @@ class TPUEngine(AsyncEngine):
         pages = self.allocator.allocate(total_pages)
         if pages is None:
             return False
+        self._flush_spills()
         self.runner.insert_pages(kv, pages)
         r.pages = pages
         r.injected = None
@@ -512,6 +608,10 @@ class TPUEngine(AsyncEngine):
             reuse_tokens = len(cached_pages) * page
         self.prefix_lookup_blocks += max(1, len(hashes))
         self.prefix_hit_blocks += len(cached_pages)
+        # Extend the prefix from the host tiers (G2/G3) before recomputing.
+        extra_pages, extra_tokens = self._try_onboard(r, hashes, cached_pages)
+        cached_pages = cached_pages + extra_pages
+        reuse_tokens += extra_tokens
         r.reuse_tokens = reuse_tokens
         total_prompt_pages = -(-len(prompt) // page)
         need = total_prompt_pages - len(cached_pages)
@@ -520,6 +620,9 @@ class TPUEngine(AsyncEngine):
             self.allocator.release(cached_pages)
             return None
         r.pages = cached_pages + new_pages
+        # Any evictions the allocations above caused must be extracted
+        # before the prefill program overwrites those pages.
+        self._flush_spills()
         rest = len(prompt) - reuse_tokens
         max_chunk = min(cfg.max_prefill_tokens, cfg.prefill_buckets[-1])
         if rest > max_chunk:
@@ -714,6 +817,7 @@ class TPUEngine(AsyncEngine):
             adv = min(M, max(0, cap - start))
             self.disp_positions[i] += adv
             self.disp_seq_lens[i] += adv
+        self._flush_spills()
         toks = self.runner.decode_window(packed, M)
         try:
             toks.copy_to_host_async()

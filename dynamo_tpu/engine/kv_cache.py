@@ -46,6 +46,11 @@ class PageAllocator:
         # Router event buffers.
         self.stored_events: list[int] = []
         self.removed_events: list[int] = []
+        # Offload hook (G2 tiering): called as hook(block_hash, page) when
+        # an inactive registered page is evicted, BEFORE the page can be
+        # handed out — the engine schedules a device->host extract so the
+        # block survives in the host tier.
+        self.evict_hook = None
 
     # -- queries --------------------------------------------------------------
     @property
@@ -83,6 +88,8 @@ class PageAllocator:
                 del self.cached[h]
                 del self.cached_by_page[page]
                 self.removed_events.append(h)
+                if self.evict_hook is not None:
+                    self.evict_hook(h, page)
             assert page not in self.refs, \
                 f"allocator invariant violated: page {page} already active"
             self.refs[page] = 1

@@ -1,0 +1,143 @@
+"""Multi-tier KV block cache: G2 host-DRAM + G3 disk.
+
+TPU-native counterpart of the reference KVBM's offload hierarchy
+(lib/llm/src/block_manager.rs:72-82 G1..G4; block_manager/offload.rs): G1
+is the in-HBM PageAllocator (kv_cache.py); pages evicted from G1 under
+pressure are OFFLOADED here instead of dropped — the engine extracts them
+to host asynchronously (overlapping the next windows' compute) and a
+prefix-cache hit on a spilled block ONBOARDS it with a device upload
+instead of recomputing the prefill.
+
+Blocks are keyed by the chained block hash (llm/tokens.py), so a block's
+content is immutable for its key: tiers never need invalidation, only
+capacity eviction (LRU). Entries are canonical-nkv host arrays
+[2, L, Nkv, page, D] (bf16), portable across tp configurations like the
+disaggregation parcels.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+
+import numpy as np
+
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("kv_host_cache")
+
+
+class DiskKVCache:
+    """G3: block files under a directory, LRU-evicted by capacity
+    (reference G3 disk pool, block_manager/offload.rs)."""
+
+    def __init__(self, directory: str, capacity_pages: int = 4096):
+        self.dir = directory
+        self.capacity = capacity_pages
+        os.makedirs(directory, exist_ok=True)
+        # hash -> path, insertion-ordered for LRU.
+        self._index: OrderedDict[int, str] = OrderedDict()
+        for name in sorted(os.listdir(directory)):
+            if name.endswith(".npy"):
+                try:
+                    self._index[int(name[:-4], 16)] = os.path.join(directory,
+                                                                   name)
+                except ValueError:
+                    continue
+        self.hits = 0
+        self.misses = 0
+
+    def __contains__(self, block_hash: int) -> bool:
+        return block_hash in self._index
+
+    def put(self, block_hash: int, kv: np.ndarray) -> None:
+        if block_hash in self._index:
+            self._index.move_to_end(block_hash)
+            return
+        path = os.path.join(self.dir, f"{block_hash & (2**64 - 1):016x}.npy")
+        # View bf16 as uint16 for npy portability.
+        np.save(path, kv.view(np.uint16))
+        self._index[block_hash] = path
+        while len(self._index) > self.capacity:
+            _, old = self._index.popitem(last=False)
+            try:
+                os.remove(old)
+            except OSError:
+                pass
+
+    def get(self, block_hash: int) -> np.ndarray | None:
+        import ml_dtypes
+        path = self._index.get(block_hash)
+        if path is None:
+            self.misses += 1
+            return None
+        try:
+            arr = np.load(path).view(ml_dtypes.bfloat16)
+        except (OSError, ValueError):
+            self._index.pop(block_hash, None)
+            self.misses += 1
+            return None
+        self._index.move_to_end(block_hash)
+        self.hits += 1
+        return arr
+
+
+class HostKVCache:
+    """G2: bounded host-DRAM block pool. Capacity overflow cascades to the
+    G3 disk tier when configured (reference offload_to_disk path)."""
+
+    def __init__(self, capacity_pages: int,
+                 disk: DiskKVCache | None = None):
+        self.capacity = capacity_pages
+        self.disk = disk
+        self._blocks: OrderedDict[int, np.ndarray] = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.spills_in = 0       # blocks offloaded into this tier
+        self.demotions = 0       # G2 -> G3 capacity evictions
+
+    def __len__(self) -> int:
+        return len(self._blocks)
+
+    def put(self, block_hash: int, kv: np.ndarray,
+            promotion: bool = False) -> None:
+        if block_hash in self._blocks:
+            self._blocks.move_to_end(block_hash)
+            return
+        # Own the memory: callers hand views into large batched extract
+        # buffers — storing the view would pin the whole base array and
+        # blow the capacity bound by the padding/replication factor.
+        self._blocks[block_hash] = np.ascontiguousarray(kv)
+        if not promotion:
+            self.spills_in += 1
+        while len(self._blocks) > self.capacity:
+            old_hash, old_kv = self._blocks.popitem(last=False)
+            if self.disk is not None:
+                self.disk.put(old_hash, old_kv)
+                self.demotions += 1
+
+    def get(self, block_hash: int) -> np.ndarray | None:
+        kv = self._blocks.get(block_hash)
+        if kv is not None:
+            self._blocks.move_to_end(block_hash)
+            self.hits += 1
+            return kv
+        if self.disk is not None:
+            kv = self.disk.get(block_hash)
+            if kv is not None:
+                # Promote back into DRAM (not an offload: stats stay true).
+                self.put(block_hash, kv, promotion=True)
+                self.hits += 1
+                return kv
+        self.misses += 1
+        return None
+
+    def stats(self) -> dict:
+        out = {"g2_blocks": len(self._blocks), "g2_hits": self.hits,
+               "g2_misses": self.misses, "g2_spills_in": self.spills_in,
+               "g2_demotions": self.demotions}
+        if self.disk is not None:
+            out.update({"g3_blocks": len(self.disk._index),
+                        "g3_hits": self.disk.hits,
+                        "g3_misses": self.disk.misses})
+        return out

@@ -490,23 +490,38 @@ class ModelRunner:
             b *= 2
         return b
 
-    def extract_pages(self, pages: list[int]) -> np.ndarray:
-        """Gather the given pages' K/V to host: [2, L, Nkv, n, page, D]
-        (bf16). The disaggregation data plane's source side (role of the
-        reference's NIXL reads, host-staged v0 — SURVEY.md §5.8)."""
+    def extract_pages_async(self, pages: list[int]):
+        """Dispatch the page gather and start the device->host copy WITHOUT
+        blocking (offload path: the extract is stream-ordered before any
+        later program that reuses the pages, and the host fetch overlaps
+        subsequent windows). Finalize with ``finalize_extract``."""
         n = len(pages)
         nb = self._page_bucket(n)
-        idx = np.zeros(nb, np.int32)  # pad rows gather the scratch page
+        idx = np.zeros(nb, np.int32)
         idx[:n] = pages
         with self.mesh:
             out = self._get_extract(nb)(self.k_cache, self.v_cache,
                                         jnp.asarray(idx))
+        try:
+            out.copy_to_host_async()
+        except Exception:  # noqa: BLE001
+            pass
+        return out, n
+
+    def finalize_extract(self, handle) -> np.ndarray:
+        out, n = handle
         out = np.asarray(jax.device_get(out))[:, :, :, :n]
         if self.kv_rep > 1:
-            # Canonicalize: replica heads are identical — keep the first of
-            # each group so parcels are portable across tp configurations.
             out = out[:, :, ::self.kv_rep]
         return out
+
+    def extract_pages(self, pages: list[int]) -> np.ndarray:
+        """Gather the given pages' K/V to host: [2, L, Nkv, n, page, D]
+        (bf16, canonical heads — replicas deduplicated so parcels are
+        portable across tp configurations). The disaggregation data
+        plane's source side (role of the reference's NIXL reads,
+        host-staged v0 — SURVEY.md §5.8)."""
+        return self.finalize_extract(self.extract_pages_async(pages))
 
     def insert_pages(self, kv: np.ndarray, pages: list[int]) -> None:
         """Write transferred K/V pages into this runner's cache. kv
