@@ -1,0 +1,147 @@
+"""Cross-process fault tolerance (VERDICT r2 #7; reference
+tests/fault_tolerance/test_request_migration.py:289,319): a coordinator
+and TWO real TPU-worker processes serve a stream; the worker serving it
+is SIGKILLed mid-stream and the request must complete on the survivor via
+the Migration operator, with exactly the requested number of tokens.
+"""
+
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import AsyncIterator
+
+import pytest
+from conftest import async_test
+
+from dynamo_tpu.llm.migration import Migration
+from dynamo_tpu.llm.protocols import PreprocessedRequest
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import AsyncEngine
+
+COORD_PORT = 4937
+COORD_URL = f"tcp://127.0.0.1:{COORD_PORT}"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spawn(args, log_path):
+    env = dict(os.environ)
+    env["DTPU_COORDINATOR_URL"] = COORD_URL
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    fh = open(log_path, "w")
+    return subprocess.Popen([sys.executable, "-m", *args], env=env,
+                            stdout=fh, stderr=subprocess.STDOUT, cwd=REPO)
+
+
+def _wait_ready(log_path, timeout=120.0) -> dict:
+    """Poll a worker log for its TPU_WORKER_READY line; returns fields."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with open(log_path) as fh:
+                for line in fh:
+                    if line.startswith("TPU_WORKER_READY"):
+                        fields = dict(kv.split("=", 1)
+                                      for kv in line.split()[1:])
+                        return fields
+        except FileNotFoundError:
+            pass
+        time.sleep(0.5)
+    raise TimeoutError(f"worker never became ready ({log_path})")
+
+
+class _VictimFirstEngine(AsyncEngine):
+    """First attempt goes DIRECT to the designated victim instance;
+    migration retries round-robin over whatever is alive."""
+
+    def __init__(self, client, victim_id: int):
+        self.client = client
+        self.victim_id = victim_id
+        self.attempts = 0
+
+    async def generate(self, request, context: Context) -> AsyncIterator:
+        self.attempts += 1
+        if self.attempts == 1:
+            stream = await self.client.direct(request, self.victim_id,
+                                              context=context)
+        else:
+            stream = await self.client.round_robin(request, context=context)
+        async for item in stream:
+            yield item
+
+
+@async_test
+async def test_sigkill_mid_stream_migrates_to_survivor(tmp_path):
+    procs = []
+    try:
+        coord = _spawn(["dynamo_tpu.runtime.coordinator", "--host",
+                        "127.0.0.1", "--port", str(COORD_PORT)],
+                       tmp_path / "coord.log")
+        procs.append(coord)
+        await asyncio.sleep(2)
+        w1 = _spawn(["dynamo_tpu.backends.tpu", "--model", "tiny-test",
+                     "--num-pages", "64"], tmp_path / "w1.log")
+        procs.append(w1)
+        w2 = _spawn(["dynamo_tpu.backends.tpu", "--model", "tiny-test",
+                     "--num-pages", "64"], tmp_path / "w2.log")
+        procs.append(w2)
+        loop = asyncio.get_running_loop()
+        f1 = await loop.run_in_executor(None, _wait_ready,
+                                        str(tmp_path / "w1.log"))
+        f2 = await loop.run_in_executor(None, _wait_ready,
+                                        str(tmp_path / "w2.log"))
+        pid_by_instance = {int(f1["worker"], 16): w1,
+                           int(f2["worker"], 16): w2}
+
+        rt = await DistributedRuntime.from_settings(
+            RuntimeConfig(coordinator_url=COORD_URL))
+        try:
+            ep = rt.namespace(None).component("tpu").endpoint("generate")
+            client = await ep.client()
+            ids = await client.wait_for_instances(timeout=30)
+            assert set(ids) == set(pid_by_instance), (ids, pid_by_instance)
+            victim_id = ids[0]
+            victim = pid_by_instance[victim_id]
+
+            inner = _VictimFirstEngine(client, victim_id)
+            migration = Migration(migration_limit=3, inner=inner)
+            req = PreprocessedRequest(model="tiny-test",
+                                      token_ids=list(range(1, 25)))
+            req.stop_conditions.max_tokens = 400
+            req.stop_conditions.ignore_eos = True
+
+            tokens = []
+            finish = None
+            killed = False
+            async for out in migration.generate(req, Context()):
+                tokens.extend(out.token_ids)
+                finish = out.finish_reason or finish
+                if not killed and len(tokens) >= 10:
+                    victim.send_signal(signal.SIGKILL)
+                    killed = True
+                if finish:
+                    break
+            assert killed, "stream finished before the kill fired"
+            assert victim.wait(timeout=10) is not None
+            assert inner.attempts >= 2, "no migration happened"
+            assert finish == "length"
+            assert len(tokens) == 400, (
+                f"expected exactly 400 tokens across migration, "
+                f"got {len(tokens)}")
+        finally:
+            await rt.close()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
