@@ -58,6 +58,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument("--kv-disk-cache-dir", default=None,
                         help="G3 disk tier directory behind the host cache")
     parser.add_argument("--migration-limit", type=int, default=0)
+    parser.add_argument("--tool-call-parser", default=None,
+                        help="tool-call format on the backward edge "
+                             "(hermes, llama3_json, mistral, nemotron_deci, "
+                             "phi4, default)")
+    parser.add_argument("--reasoning-parser", default=None,
+                        help="think-tag splitting (deepseek_r1, basic)")
     parser.add_argument("--coordinator-url", default=None)
     parser.add_argument("--mode", default="agg",
                         choices=["agg", "prefill", "decode"],
@@ -163,6 +169,8 @@ async def run(args: argparse.Namespace) -> None:
                 context_length=engine_cfg.max_model_len,
                 kv_cache_block_size=engine_cfg.page_size,
                 migration_limit=args.migration_limit,
+                tool_call_parser=args.tool_call_parser,
+                reasoning_parser=args.reasoning_parser,
                 runtime_config=ModelRuntimeConfig(
                     total_kv_blocks=engine.runner.num_pages,
                     max_num_seqs=engine_cfg.max_num_seqs))

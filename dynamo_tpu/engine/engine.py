@@ -33,7 +33,7 @@ from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.engine.kv_cache import PageAllocator
 from dynamo_tpu.engine.runner import (
     ModelRunner, PrefillSeq, PK_OVERRIDE, PK_TOKEN, PK_POS, PK_SEQLEN,
-    PK_TOPK, PK_TEMP, PK_TOPP, PK_CAP, PK_PREFIX)
+    PK_TOPK, PK_TEMP, PK_TOPP, PK_CAP, PK_LOGPROB, PK_PREFIX, TOP_LOGPROBS)
 from dynamo_tpu.engine.sampler import MAX_TOPK
 from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics, KvStats, WorkerStats
 from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput, PreprocessedRequest
@@ -172,6 +172,10 @@ class TPUEngine(AsyncEngine):
                 f"prompt length {len(req.token_ids)} exceeds max model len "
                 f"{self.config.max_model_len}")
         s = req.sampling_options
+        if s.logprobs is not None and s.logprobs > TOP_LOGPROBS:
+            log.warning("top_logprobs=%d exceeds cap %d; clamping",
+                        s.logprobs, TOP_LOGPROBS)
+            s.logprobs = TOP_LOGPROBS
         if s.top_k and s.top_k > MAX_TOPK:
             # The sampler prefilters to the top-MAX_TOPK candidates (no
             # full-vocab sort on TPU) — top-k beyond that, and the top-p
@@ -432,7 +436,7 @@ class TPUEngine(AsyncEngine):
 
     def _resolve_ready_first(self, force: bool = False) -> None:
         for entry in list(self._pending_first):
-            handle = entry["handle"]
+            handle = entry["handle"]["tokens"]
             ready = getattr(handle, "is_ready", lambda: True)()
             if not (ready or force):
                 continue
@@ -450,8 +454,14 @@ class TPUEngine(AsyncEngine):
                 self._resolve_first(entry)
 
     def _resolve_first(self, entry: dict) -> None:
+        h = entry["handle"]
+        want_lp = any(r.req.sampling_options.logprobs is not None
+                      for _, r, _, _ in entry["rows"])
         try:
-            vals = np.asarray(entry["handle"])
+            vals = np.asarray(h["tokens"])
+            lps = np.asarray(h["lp"]) if want_lp else None
+            top_vs = np.asarray(h["top_v"]) if want_lp else None
+            top_is = np.asarray(h["top_i"]) if want_lp else None
         except Exception as exc:  # noqa: BLE001 — device fault at fetch
             log.exception("first-token fetch failed")
             for _, r, slot, epoch in entry["rows"]:
@@ -465,7 +475,14 @@ class TPUEngine(AsyncEngine):
             tok = int(vals[row])
             r.generated += 1
             finish = self._check_finish(r, tok)
-            self._emit(r, [tok], finish)
+            lp_out = None
+            if r.req.sampling_options.logprobs is not None:
+                k = r.req.sampling_options.logprobs or 0
+                lp_out = ([float(lps[row])],
+                          [[{"token_id": int(top_is[row, j]),
+                             "logprob": float(top_vs[row, j])}
+                            for j in range(k)]])
+            self._emit(r, [tok], finish, lp_out)
             r.last_token = tok
             r.tokens_all.append(tok)
             if finish is not None:
@@ -634,11 +651,33 @@ class TPUEngine(AsyncEngine):
         return PrefillSeq(
             tokens=np.asarray(prompt[reuse_tokens:], np.int32),
             start_pos=reuse_tokens, chunk_pages=chunk_pages,
-            hist_pages=hist, sampling=self._sampling_of(r))
+            hist_pages=hist, sampling=self._sampling_of(r),
+            logprobs=r.req.sampling_options.logprobs is not None)
 
     def _prefill_chunked(self, r: _Request, slot: int) -> None:
         """Long prompt: prefill in page-aligned chunks with history."""
-        self._place_in_slot(r, slot, self._prefill_chunked_token(r))
+        token = self._prefill_chunked_token(r)
+        lp_out = None
+        if r.req.sampling_options.logprobs is not None:
+            lg = np.asarray(self.runner.last_prefill_logits[0], np.float32)
+            lp_out = self._host_logprobs(lg, token,
+                                         r.req.sampling_options.logprobs)
+        self._place_in_slot(r, slot, token, lp_out)
+
+    @staticmethod
+    def _host_logprobs(logits_row: np.ndarray, token: int,
+                       k: int) -> tuple[list, list]:
+        """Host-side logprobs for sync prefill paths (chunked prompts)."""
+        lg = logits_row.astype(np.float64)
+        m = float(lg.max())
+        lse = m + float(np.log(np.exp(lg - m).sum()))
+        alts = []
+        if k > 0:
+            idx = np.argpartition(-lg, k)[:k]
+            idx = idx[np.argsort(-lg[idx])]
+            alts = [{"token_id": int(t), "logprob": float(lg[t] - lse)}
+                    for t in idx]
+        return [float(lg[token] - lse)], [alts]
 
     def _prefill_chunked_token(self, r: _Request) -> int:
         cfg = self.config
@@ -688,7 +727,8 @@ class TPUEngine(AsyncEngine):
         self.top_p[slot] = tp
         self.overrides.pop(slot, None)
 
-    def _place_in_slot(self, r: _Request, slot: int, first_token: int) -> None:
+    def _place_in_slot(self, r: _Request, slot: int, first_token: int,
+                       lp_out: tuple[list, list] | None = None) -> None:
         prompt_len = len(r.tokens_all)
         # The prompt's complete blocks are now resident: register them for
         # prefix reuse + router events.
@@ -696,7 +736,7 @@ class TPUEngine(AsyncEngine):
             self.allocator.register(r.pages[idx], h)
         r.generated += 1
         finish = self._check_finish(r, first_token)
-        self._emit(r, [first_token], finish)
+        self._emit(r, [first_token], finish, lp_out)
         if finish is not None:
             self._pending_release.append((self._dispatch_serial, r.pages))
             r.pages = []
@@ -812,23 +852,36 @@ class TPUEngine(AsyncEngine):
             packed[i, PK_TEMP] = self.temperature[i:i + 1].view(np.int32)[0]
             packed[i, PK_TOPP] = self.top_p[i:i + 1].view(np.int32)[0]
             packed[i, PK_CAP] = cap
+            if r.req.sampling_options.logprobs is not None:
+                packed[i, PK_LOGPROB] = 1
             packed[i, PK_PREFIX:PK_PREFIX + len(r.pages)] = r.pages
             slots[i] = (r, r.epoch, start, cap)
             adv = min(M, max(0, cap - start))
             self.disp_positions[i] += adv
             self.disp_seq_lens[i] += adv
         self._flush_spills()
-        toks = self.runner.decode_window(packed, M)
-        try:
-            toks.copy_to_host_async()
-        except Exception:  # noqa: BLE001 — not all backends support it
-            pass
-        return _Window(toks=toks, slots=slots, frozen=frozen, size=M,
+        outs = self.runner.decode_window(packed, M)
+        for arr in outs:
+            try:
+                arr.copy_to_host_async()
+            except Exception:  # noqa: BLE001 — not all backends support it
+                pass
+        return _Window(toks=outs, slots=slots, frozen=frozen, size=M,
                        serial=self._dispatch_serial)
 
     def _process_window(self, w: _Window) -> None:
         page = self.config.page_size
-        toks = np.asarray(w.toks) if w.toks is not None else None
+        if w.toks is not None:
+            toks = np.asarray(w.toks[0])
+            want_lp = any(
+                snap is not None
+                and snap[0].req.sampling_options.logprobs is not None
+                for snap in w.slots)
+            lps = np.asarray(w.toks[1]) if want_lp else None
+            top_vs = np.asarray(w.toks[2]) if want_lp else None
+            top_is = np.asarray(w.toks[3]) if want_lp else None
+        else:
+            toks = None
         self._release_ready_pages()
         # Window processing walks host token chains; make sure every slot
         # this window touches has its first token resolved.
@@ -862,6 +915,8 @@ class TPUEngine(AsyncEngine):
                 self._finish_slot(i, register=True)
                 continue
             accepted: list[int] = []
+            lp_out = ([], []) if r.req.sampling_options.logprobs is not None \
+                else None
             finish = None
             inp = r.last_token
             for m in range(w.size):
@@ -879,6 +934,13 @@ class TPUEngine(AsyncEngine):
                     page_idx = (len(r.blocks.tokens) // page) - 1
                     self.allocator.register(r.pages[page_idx], new_block)
                 accepted.append(token)
+                if lp_out is not None:
+                    k = r.req.sampling_options.logprobs or 0
+                    lp_out[0].append(float(lps[m, i]))
+                    lp_out[1].append(
+                        [{"token_id": int(top_is[m, i, j]),
+                          "logprob": float(top_vs[m, i, j])}
+                         for j in range(k)])
                 r.tokens_all.append(token)
                 inp = token
                 finish = self._check_finish(r, token)
@@ -887,7 +949,7 @@ class TPUEngine(AsyncEngine):
             r.last_token = inp
             if finish is None and r.ctx.is_stopped:
                 finish = FinishReason.CANCELLED
-            self._emit(r, accepted, finish)
+            self._emit(r, accepted, finish, lp_out)
             if finish is not None:
                 self._finish_slot(i, register=True)
 
@@ -904,9 +966,13 @@ class TPUEngine(AsyncEngine):
         return None
 
     def _emit(self, r: _Request, tokens: list[int],
-              finish: FinishReason | None = None) -> None:
-        r.push(LLMEngineOutput(token_ids=tokens,
-                               finish_reason=finish).to_wire())
+              finish: FinishReason | None = None,
+              lp_out: tuple[list, list] | None = None) -> None:
+        out = LLMEngineOutput(token_ids=tokens, finish_reason=finish)
+        if lp_out is not None:
+            out.log_probs = lp_out[0]
+            out.top_log_probs = lp_out[1]
+        r.push(out.to_wire())
 
     def _finish_slot(self, slot: int, register: bool) -> None:
         r = self.slot_req[slot]

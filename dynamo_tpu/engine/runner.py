@@ -59,9 +59,22 @@ PK_TEMP = 5       # float32 bits
 PK_TOPP = 6       # float32 bits
 PK_CAP = 7        # position capacity = allocated pages * page_size; a slot
                   # freezes in-graph when its position reaches this
-PK_PREFIX = 8     # page table starts here
+PK_LOGPROB = 8    # 1 -> this slot wants logprobs (window computes them
+                  # when ANY slot asks; per-slot filtering is host-side)
+PK_PREFIX = 9     # page table starts here
+
+TOP_LOGPROBS = 8  # alternatives returned when logprobs are requested
 
 _PF_HDR = 8       # prefill packed-array header columns
+
+
+def _logprobs_of(logits: jax.Array, sampled: jax.Array):
+    """(chosen logprob [B], top values [B,K], top ids [B,K]) from raw
+    logits — log-softmax via one logsumexp, no full-vocab sort."""
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    chosen = jnp.take_along_axis(logits, sampled[:, None], axis=1)[:, 0]
+    top_v, top_i = jax.lax.top_k(logits, TOP_LOGPROBS)
+    return chosen - lse, top_v - lse[:, None], top_i
 
 
 @dataclasses.dataclass
@@ -72,6 +85,7 @@ class PrefillSeq:
     chunk_pages: np.ndarray     # pages covering the chunk
     hist_pages: np.ndarray | None  # pages before the chunk (None = fresh)
     sampling: tuple[float, int, float]  # (temperature, top_k, top_p)
+    logprobs: bool = False      # row wants first-token logprobs
 
 
 class ModelRunner:
@@ -219,8 +233,8 @@ class ModelRunner:
         # All host inputs travel in ONE packed int32 array (floats bitcast):
         # h2d transfers are latency-bound, so one transfer beats ten.
         # Columns: 0 start_pos, 1 n_tokens, 2 hist_len, 3 temp bits,
-        # 4 top_k, 5 top_p bits, then tokens[bucket], ptab[bucket_pages],
-        # htab[maxp if with_history].
+        # 4 top_k, 5 top_p bits, 6 logprobs flag, then tokens[bucket],
+        # ptab[bucket_pages], htab[maxp if with_history].
         def step(params, k_cache, v_cache, packed, rng):
             start = packed[:, 0]
             n = packed[:, 1]
@@ -248,7 +262,15 @@ class ModelRunner:
                     page_table, seq_lens)
             rng, sub = jax.random.split(rng)
             sampled = sample_tokens(logits, temp, top_k, top_p, sub)
-            return sampled, logits, k_cache, v_cache, rng
+            B = sampled.shape[0]
+            lp, top_v, top_i = jax.lax.cond(
+                jnp.any(packed[:, 6] > 0),
+                lambda _: _logprobs_of(logits, sampled),
+                lambda _: (jnp.zeros((B,), jnp.float32),
+                           jnp.zeros((B, TOP_LOGPROBS), jnp.float32),
+                           jnp.zeros((B, TOP_LOGPROBS), jnp.int32)),
+                None)
+            return sampled, lp, top_v, top_i, logits, k_cache, v_cache, rng
 
         fn = jax.jit(step, donate_argnums=(1, 2))
         self._prefill_cache[key] = fn
@@ -304,6 +326,8 @@ class ModelRunner:
             kbuf0 = jnp.zeros((L, nkv, B, window, d), k_cache.dtype)
             vbuf0 = jnp.zeros((L, nkv, B, window, d), v_cache.dtype)
 
+            want_lp = jnp.any(packed[:, PK_LOGPROB] > 0)
+
             def step(carry, m):
                 tokens, positions, kbuf, vbuf, rng = carry
                 # A slot advances only while live AND within its allocated
@@ -323,13 +347,24 @@ class ModelRunner:
                     (0, 0, 0, m, 0))
                 rng, sub = jax.random.split(rng)
                 sampled = sample_tokens(logits, temp, top_k, top_p, sub)
+                # Logprobs only when some slot asked (lax.cond executes one
+                # branch on TPU: zero cost otherwise).
+                B = sampled.shape[0]
+                lp, top_v, top_i = jax.lax.cond(
+                    want_lp,
+                    lambda _: _logprobs_of(logits, sampled),
+                    lambda _: (jnp.zeros((B,), jnp.float32),
+                               jnp.zeros((B, TOP_LOGPROBS), jnp.float32),
+                               jnp.zeros((B, TOP_LOGPROBS), jnp.int32)),
+                    None)
                 tokens = jnp.where(live, sampled, tokens)
                 positions = positions + live.astype(jnp.int32)
-                return (tokens, positions, kbuf, vbuf, rng), sampled
+                return (tokens, positions, kbuf, vbuf, rng), (
+                    sampled, lp, top_v, top_i)
 
-            (tokens, _, kbuf, vbuf, rng), toks = jax.lax.scan(
-                step, (tokens0, positions0, kbuf0, vbuf0, rng),
-                jnp.arange(window))
+            (tokens, _, kbuf, vbuf, rng), (toks, lps, top_vs, top_is) = \
+                jax.lax.scan(step, (tokens0, positions0, kbuf0, vbuf0, rng),
+                             jnp.arange(window))
             # Commit the window: scatter every (slot, step) entry into its
             # page. Frozen/inactive entries land on the scratch page 0.
             m_idx = jnp.arange(window)[:, None]                      # [M,1]
@@ -348,7 +383,7 @@ class ModelRunner:
                 kbuf.transpose(0, 1, 3, 2, 4))
             v_cache = v_cache.at[:, :, dest, off].set(
                 vbuf.transpose(0, 1, 3, 2, 4))
-            return toks, tokens, k_cache, v_cache, rng
+            return toks, lps, top_vs, top_is, tokens, k_cache, v_cache, rng
 
         fn = jax.jit(run_window, donate_argnums=(1, 2))
         self._window_cache[key] = fn
@@ -393,6 +428,7 @@ class ModelRunner:
             packed[i, 3] = np.float32(temp).view(np.int32)
             packed[i, 4] = top_k
             packed[i, 5] = np.float32(top_p).view(np.int32)
+            packed[i, 6] = int(s.logprobs)
             packed[i, _PF_HDR:_PF_HDR + n] = s.tokens
             # Pad page-table rows stay 0 = the allocator's RESERVED scratch
             # page, so padded block scatters land there — padding with a
@@ -406,9 +442,9 @@ class ModelRunner:
                 packed[i, 2] = s.start_pos
         fn = self._get_prefill(bucket, bp, with_history)
         with self.mesh:
-            sampled, logits, self.k_cache, self.v_cache, self._rng = fn(
-                self.params, self.k_cache, self.v_cache, jnp.asarray(packed),
-                self._rng)
+            (sampled, lp, top_v, top_i, logits, self.k_cache, self.v_cache,
+             self._rng) = fn(self.params, self.k_cache, self.v_cache,
+                             jnp.asarray(packed), self._rng)
         # Device handle (no transfer unless a caller converts it).
         self.last_prefill_logits = logits
         if slots is not None:
@@ -416,11 +452,13 @@ class ModelRunner:
             with self.mesh:
                 self.tokens_dev = self.tokens_dev.at[idx].set(
                     sampled[:len(seqs)])
-            try:
-                sampled.copy_to_host_async()
-            except Exception:  # noqa: BLE001 — not all backends support it
-                pass
-            return sampled
+            for arr in (sampled, lp, top_v, top_i):
+                try:
+                    arr.copy_to_host_async()
+                except Exception:  # noqa: BLE001
+                    pass
+            return {"tokens": sampled, "lp": lp, "top_v": top_v,
+                    "top_i": top_i}
         return np.asarray(jax.device_get(sampled))[:len(seqs)]
 
     def prefill(self, tokens: np.ndarray, start_pos: int,
@@ -448,17 +486,19 @@ class ModelRunner:
         """Dispatch one M-step decode window.
 
         packed [B, PK_PREFIX + bucket_pages] int32 (see PK_* columns).
-        Returns the [M,B] sampled-token device array (fetch with
-        np.asarray when needed; start an async copy early via
-        .copy_to_host_async()).
+        Returns (toks [M,B], lp [M,B], top_v [M,B,K], top_i [M,B,K])
+        device arrays (fetch with np.asarray when needed; start async
+        copies early via .copy_to_host_async()). The logprob arrays are
+        zeros unless some slot set PK_LOGPROB.
         """
         bucket_pages = packed.shape[1] - PK_PREFIX
         fn = self._get_window(window, bucket_pages)
         with self.mesh:
-            toks, self.tokens_dev, self.k_cache, self.v_cache, self._rng = fn(
+            (toks, lps, top_vs, top_is, self.tokens_dev, self.k_cache,
+             self.v_cache, self._rng) = fn(
                 self.params, self.k_cache, self.v_cache, self.tokens_dev,
                 jnp.asarray(packed), self._rng)
-        return toks
+        return toks, lps, top_vs, top_is
 
     # -- KV page transfer (disaggregation data plane) -------------------------
     def _get_extract(self, n: int):

@@ -38,6 +38,15 @@ class Backend(Operator):
                 if delta is not None:
                     pieces.append(delta)
             text = "".join(pieces)
+            if out.log_probs is not None:
+                # The OpenAI logprobs block needs per-token strings: decode
+                # each id standalone (and the top alternatives' ids).
+                out.token_texts = [self.tokenizer.decode([tid])
+                                   for tid in out.token_ids]
+                for alts in out.top_log_probs or []:
+                    for alt in alts:
+                        alt["token"] = self.tokenizer.decode(
+                            [alt["token_id"]])
             if text:
                 emit, matched = stops.append(text)
                 if matched:

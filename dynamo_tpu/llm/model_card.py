@@ -57,6 +57,10 @@ class ModelDeploymentCard:
     context_length: int = 8192
     kv_cache_block_size: int = 16  # reference default (docs/guides/backend.md)
     migration_limit: int = 0
+    # Backward-edge parsers (reference lib/parsers): names resolved by
+    # llm/parsers.py TOOL_FORMATS / REASONING_FORMATS; None = raw text.
+    tool_call_parser: str | None = None
+    reasoning_parser: str | None = None
     runtime_config: ModelRuntimeConfig = dataclasses.field(
         default_factory=ModelRuntimeConfig)
 
@@ -112,6 +116,8 @@ async def register_llm(
     context_length: int = 8192,
     kv_cache_block_size: int = 16,
     migration_limit: int = 0,
+    tool_call_parser: str | None = None,
+    reasoning_parser: str | None = None,
     runtime_config: ModelRuntimeConfig | None = None,
 ) -> ModelEntry:
     """Register a served model: ship the tokenizer to the object store and put
@@ -126,6 +132,7 @@ async def register_llm(
         name=model_name, model_type=model_type, tokenizer_key=tok_key,
         chat_template=chat_template, context_length=context_length,
         kv_cache_block_size=kv_cache_block_size, migration_limit=migration_limit,
+        tool_call_parser=tool_call_parser, reasoning_parser=reasoning_parser,
         runtime_config=runtime_config or ModelRuntimeConfig())
     entry = ModelEntry(model_name=model_name,
                        namespace=endpoint.component.namespace,

@@ -90,6 +90,15 @@ class OpenAIPreprocessor(Operator):
             stop=request.stop_list(),
             ignore_eos=bool(request.ignore_eos),
         )
+        # logprobs: chat uses bool logprobs + int top_logprobs; the legacy
+        # completion API uses an int. Normalize to "None = off, k = chosen
+        # token + k alternatives".
+        lp_req = getattr(request, "logprobs", None)
+        if isinstance(lp_req, bool):
+            logprobs_n = (getattr(request, "top_logprobs", None) or 0) \
+                if lp_req else None
+        else:
+            logprobs_n = lp_req
         sampling = SamplingOptions(
             temperature=request.temperature,
             top_p=request.top_p,
@@ -98,6 +107,7 @@ class OpenAIPreprocessor(Operator):
             presence_penalty=getattr(request, "presence_penalty", None),
             seed=request.seed,
             n=request.n,
+            logprobs=logprobs_n,
         )
         annotations: dict[str, Any] = {}
         if formatted_prompt is not None:
@@ -113,7 +123,10 @@ class OpenAIPreprocessor(Operator):
         """Full chat pipeline edge: forward preprocess, stream deltas back."""
         assert self.inner is not None, "preprocessor not linked to an engine"
         pre = self.preprocess_chat(request)
-        delta_gen = ChatDeltaGenerator(request, prompt_tokens=len(pre.token_ids))
+        delta_gen = ChatDeltaGenerator(
+            request, prompt_tokens=len(pre.token_ids),
+            tool_call_parser=self.card.tool_call_parser,
+            reasoning_parser=self.card.reasoning_parser)
         inner_iter = self.inner.generate(pre, context)
         async for out in inner_iter:
             engine_out = (out if isinstance(out, LLMEngineOutput)
@@ -138,9 +151,16 @@ class OpenAIPreprocessor(Operator):
 
 class ChatDeltaGenerator:
     """LLMEngineOutput stream -> OpenAI chat.completion.chunk dicts
-    (reference DeltaGenerator, preprocessor.rs:358-460)."""
+    (reference DeltaGenerator, preprocessor.rs:358-460). When the model
+    card names parsers, think-tags split into reasoning_content deltas and
+    tool-call payloads are jailed out of the content stream and emitted as
+    tool_calls at finish (finish_reason becomes "tool_calls")."""
 
-    def __init__(self, request: ChatCompletionRequest, prompt_tokens: int):
+    def __init__(self, request: ChatCompletionRequest, prompt_tokens: int,
+                 tool_call_parser: str | None = None,
+                 reasoning_parser: str | None = None):
+        from dynamo_tpu.llm.parsers import (StreamingReasoningParser,
+                                            StreamingToolCallParser)
         self.id = chat_completion_id()
         self.model = request.model
         self.created = now_unix()
@@ -149,6 +169,10 @@ class ChatDeltaGenerator:
         self.include_usage = bool(
             (request.stream_options or {}).get("include_usage"))
         self._first = True
+        self._reasoning = (StreamingReasoningParser(reasoning_parser)
+                           if reasoning_parser else None)
+        self._tools = (StreamingToolCallParser(tool_call_parser)
+                       if tool_call_parser else None)
 
     def _base(self) -> dict:
         return {"id": self.id, "object": "chat.completion.chunk",
@@ -161,12 +185,50 @@ class ChatDeltaGenerator:
         if self._first:
             delta["role"] = "assistant"
             self._first = False
-        if out.text:
-            delta["content"] = out.text
+        content = out.text or ""
+        reasoning = ""
+        if self._reasoning is not None and content:
+            content, reasoning = self._reasoning.feed(content)
+        if self._tools is not None and content:
+            content = self._tools.feed(content)
         finish = out.finish_reason.to_openai() if out.finish_reason else None
-        if delta or finish:
+        if finish:
+            if self._reasoning is not None:
+                tail_c, tail_r = self._reasoning.finish()
+                if self._tools is not None and tail_c:
+                    tail_c = self._tools.feed(tail_c)
+                content += tail_c
+                reasoning += tail_r
+            if self._tools is not None:
+                trailing, calls = self._tools.finish()
+                content += trailing
+                if calls:
+                    delta["tool_calls"] = [c.to_openai(i)
+                                           for i, c in enumerate(calls)]
+                    finish = "tool_calls"
+        if content:
+            delta["content"] = content
+        if reasoning:
+            delta["reasoning_content"] = reasoning
+        lp_block = None
+        if out.log_probs is not None:
+            entries = []
+            texts = out.token_texts or [""] * len(out.log_probs)
+            tops = out.top_log_probs or [[]] * len(out.log_probs)
+            for t_text, lp, alts in zip(texts, out.log_probs, tops):
+                entries.append({
+                    "token": t_text, "logprob": lp, "bytes": None,
+                    "top_logprobs": [
+                        {"token": a.get("token", ""),
+                         "logprob": a["logprob"], "bytes": None}
+                        for a in alts]})
+            lp_block = {"content": entries}
+        if delta or finish or lp_block:
+            # lp_block alone still emits: tokens whose text is held back
+            # (stop-prefix/tool jail) must not lose their logprobs.
             chunk = self._base()
             chunk["choices"] = [{"index": 0, "delta": delta,
+                                 "logprobs": lp_block,
                                  "finish_reason": finish}]
             chunks.append(chunk)
         if finish and self.include_usage:
@@ -194,12 +256,23 @@ class CompletionDeltaGenerator:
         self.completion_tokens += len(out.token_ids)
         finish = out.finish_reason.to_openai() if out.finish_reason else None
         chunks = []
-        if out.text or finish:
+        lp_block = None
+        if out.log_probs is not None:
+            # Legacy completions logprobs shape.
+            lp_block = {
+                "tokens": out.token_texts or [],
+                "token_logprobs": out.log_probs,
+                "top_logprobs": [
+                    {a.get("token", ""): a["logprob"] for a in alts}
+                    for alts in (out.top_log_probs or [])],
+                "text_offset": [],
+            }
+        if out.text or finish or lp_block:
             chunks.append({
                 "id": self.id, "object": "text_completion",
                 "created": self.created, "model": self.model,
                 "choices": [{"index": 0, "text": out.text or "",
-                             "finish_reason": finish, "logprobs": None}],
+                             "finish_reason": finish, "logprobs": lp_block}],
             })
         if finish and self.include_usage:
             chunks.append({
@@ -215,6 +288,9 @@ async def aggregate_chat_stream(chunks: AsyncIterator[dict],
     """Fold a chunk stream into a non-streaming chat.completion response
     (reference protocols/openai/chat_completions/aggregator.rs)."""
     content: list[str] = []
+    reasoning: list[str] = []
+    tool_calls: list[dict] = []
+    lp_entries: list[dict] = []
     role = "assistant"
     finish_reason = None
     cid = None
@@ -232,15 +308,28 @@ async def aggregate_chat_stream(chunks: AsyncIterator[dict],
             delta = choice.get("delta", {})
             if delta.get("content"):
                 content.append(delta["content"])
+            if delta.get("reasoning_content"):
+                reasoning.append(delta["reasoning_content"])
+            if delta.get("tool_calls"):
+                tool_calls.extend(delta["tool_calls"])
             if delta.get("role"):
                 role = delta["role"]
+            if choice.get("logprobs"):
+                lp_entries.extend(choice["logprobs"].get("content") or [])
             if choice.get("finish_reason"):
                 finish_reason = choice["finish_reason"]
+    message: dict[str, Any] = {"role": role, "content": "".join(content)}
+    if reasoning:
+        message["reasoning_content"] = "".join(reasoning)
+    if tool_calls:
+        message["tool_calls"] = tool_calls
+        message["content"] = message["content"] or None
     return {
         "id": cid, "object": "chat.completion", "created": created,
         "model": model,
-        "choices": [{"index": 0,
-                     "message": {"role": role, "content": "".join(content)},
+        "choices": [{"index": 0, "message": message,
+                     "logprobs": ({"content": lp_entries}
+                                  if lp_entries else None),
                      "finish_reason": finish_reason}],
         "usage": usage or usage_block(prompt_tokens, completion_tokens),
     }

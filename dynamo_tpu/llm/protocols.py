@@ -89,6 +89,12 @@ class LLMEngineOutput(BaseModel):
     finish_reason: FinishReason | None = None
     cum_log_prob: float | None = None
     log_probs: list[float] | None = None
+    # Per token: top alternatives [{token_id, logprob, token?}] (token text
+    # filled by the detokenizing Backend operator).
+    top_log_probs: list[list[dict[str, Any]]] | None = None
+    # Per-token decoded strings (filled by Backend when log_probs present;
+    # the OpenAI logprobs block needs per-token text, not just the delta).
+    token_texts: list[str] | None = None
     # Per-stream metrics annotation (reference LLMMetricAnnotation,
     # preprocessor.rs:58): first-token flag etc.
     metrics: dict[str, Any] | None = None

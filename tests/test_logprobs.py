@@ -1,0 +1,144 @@
+"""Logprobs through sampler, engine, and OpenAI protocols (VERDICT r2 #10).
+
+Greedy decode must report the chosen token's logprob as the max over the
+top alternatives, alternatives must be sorted descending, and the values
+must agree with a host-side log-softmax of the model's logits.
+"""
+
+import asyncio
+import math
+
+import numpy as np
+from conftest import async_test
+
+from dynamo_tpu.engine.config import EngineConfig, PRESETS
+from dynamo_tpu.engine.engine import TPUEngine
+from dynamo_tpu.llm.protocols import LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.runtime.context import Context
+
+SPEC = PRESETS["tiny-test"]
+
+
+def tiny_config(**kw) -> EngineConfig:
+    defaults = dict(model=SPEC, page_size=16, num_pages=64,
+                    max_pages_per_seq=16, max_num_seqs=4,
+                    prefill_buckets=(32, 64), max_prefill_tokens=64,
+                    attention_backend="xla")
+    defaults.update(kw)
+    return EngineConfig(**defaults)
+
+
+async def run(engine, prompt, max_tokens, logprobs):
+    req = PreprocessedRequest(model="m", token_ids=list(prompt))
+    req.stop_conditions.max_tokens = max_tokens
+    req.stop_conditions.ignore_eos = True
+    req.sampling_options.logprobs = logprobs
+    outs = []
+    async for raw in engine.generate(req, Context()):
+        outs.append(LLMEngineOutput.from_wire(raw))
+        if outs[-1].finish_reason:
+            break
+    return outs
+
+
+@async_test
+async def test_logprobs_emitted_per_token_with_top_alternatives():
+    engine = TPUEngine(tiny_config())
+    try:
+        rng = np.random.default_rng(0)
+        prompt = rng.integers(0, SPEC.vocab_size, size=20).tolist()
+        outs = await run(engine, prompt, 10, logprobs=4)
+        tokens, lps, tops = [], [], []
+        for o in outs:
+            tokens.extend(o.token_ids)
+            assert o.log_probs is not None
+            assert len(o.log_probs) == len(o.token_ids)
+            lps.extend(o.log_probs)
+            tops.extend(o.top_log_probs)
+        assert len(tokens) == 10
+        for tok, lp, alts in zip(tokens, lps, tops):
+            assert lp <= 0.0 and math.isfinite(lp)
+            assert len(alts) == 4
+            vals = [a["logprob"] for a in alts]
+            assert vals == sorted(vals, reverse=True)
+            # Greedy: the chosen token IS the best alternative.
+            assert alts[0]["token_id"] == tok
+            assert abs(alts[0]["logprob"] - lp) < 1e-3
+    finally:
+        engine.stop()
+
+
+@async_test
+async def test_logprobs_zero_alternatives_and_off():
+    engine = TPUEngine(tiny_config())
+    try:
+        rng = np.random.default_rng(1)
+        prompt = rng.integers(0, SPEC.vocab_size, size=20).tolist()
+        outs = await run(engine, prompt, 4, logprobs=0)
+        for o in outs:
+            if o.token_ids:
+                assert o.log_probs is not None
+                assert all(alts == [] for alts in o.top_log_probs)
+        outs = await run(engine, prompt, 4, logprobs=None)
+        for o in outs:
+            assert o.log_probs is None
+    finally:
+        engine.stop()
+
+
+@async_test
+async def test_logprobs_chunked_prefill_first_token():
+    """Long prompt (chunked prefill) reports a logprob for the first
+    token via the host-side path."""
+    engine = TPUEngine(tiny_config(max_prefill_tokens=32))
+    try:
+        rng = np.random.default_rng(2)
+        prompt = rng.integers(0, SPEC.vocab_size, size=100).tolist()
+        outs = await run(engine, prompt, 3, logprobs=2)
+        total_lps = sum(len(o.log_probs or []) for o in outs)
+        total_toks = sum(len(o.token_ids) for o in outs)
+        assert total_toks == 3
+        assert total_lps == 3
+    finally:
+        engine.stop()
+
+
+@async_test
+async def test_logprobs_values_match_host_log_softmax():
+    """Cross-check one decode step's reported logprob against a host
+    log-softmax of the model's own logits (teacher-forced)."""
+    import jax
+    import jax.numpy as jnp
+    from dynamo_tpu.engine.model import (decode_forward, prefill_forward,
+                                         paged_decode_attention_xla)
+    engine = TPUEngine(tiny_config())
+    try:
+        rng = np.random.default_rng(3)
+        prompt = rng.integers(0, SPEC.vocab_size, size=16).tolist()
+        outs = await run(engine, prompt, 3, logprobs=1)
+        tokens, lps = [], []
+        for o in outs:
+            tokens.extend(o.token_ids)
+            lps.extend(o.log_probs or [])
+        # Recompute step 2's distribution with the same params.
+        params = engine.runner.params
+        k = jnp.zeros((SPEC.num_layers, SPEC.num_kv_heads, 16, 16,
+                       SPEC.head_dim), jnp.bfloat16)
+        v = jnp.zeros_like(k)
+        seq = prompt + tokens[:1]
+        tok = np.zeros((1, 32), np.int32)
+        tok[0, :len(seq)] = seq
+        pos = np.zeros((1, 32), np.int32)
+        pos[0, :len(seq)] = np.arange(len(seq))
+        pos[0, len(seq):] = len(seq) - 1
+        logits, k, v = jax.jit(lambda p, k, v, t, po, pt, sl: prefill_forward(
+            p, SPEC, k, v, t, po, pt, sl))(
+            params, k, v, jnp.asarray(tok), jnp.asarray(pos),
+            jnp.asarray([[1, 2]], np.int32),
+            jnp.asarray([len(seq)], np.int32))
+        lg = np.asarray(logits[0], np.float64)
+        lse = lg.max() + np.log(np.exp(lg - lg.max()).sum())
+        expect = lg[tokens[1]] - lse
+        assert abs(lps[1] - expect) < 0.05, (lps[1], expect)
+    finally:
+        engine.stop()
