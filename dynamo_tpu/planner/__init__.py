@@ -1,0 +1,27 @@
+"""SLA planner: load prediction -> replica scaling decisions.
+
+Capability parity with the reference planner component
+(components/planner/src/dynamo/planner/utils/planner_core.py:55): a
+metrics-driven loop that predicts next-interval load per worker pool and
+asks a connector to scale prefill/decode replica counts, informed by a
+profiler-built capacity table (benchmarks/profiler/profile_sla.py:52).
+"""
+
+from dynamo_tpu.planner.connector import Connector, FakeConnector
+from dynamo_tpu.planner.core import Planner, PlannerConfig, PoolState
+from dynamo_tpu.planner.predictors import (
+    ConstantPredictor,
+    LinearTrendPredictor,
+    MovingAveragePredictor,
+    make_predictor,
+)
+from dynamo_tpu.planner.profiler import (
+    choose_capacity,
+    profile_sweep,
+)
+
+__all__ = [
+    "Connector", "FakeConnector", "Planner", "PlannerConfig", "PoolState",
+    "ConstantPredictor", "LinearTrendPredictor", "MovingAveragePredictor",
+    "make_predictor", "choose_capacity", "profile_sweep",
+]

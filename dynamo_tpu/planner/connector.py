@@ -1,0 +1,42 @@
+"""Scaling connectors: how planner decisions become replica changes.
+
+Reference: the planner drives a Kubernetes connector
+(components/planner/src/dynamo/planner/kube.py) that patches
+DynamoGraphDeployment replica counts. Here the connector is an interface:
+deployments provide one per substrate; FakeConnector records decisions for
+tests and dry runs.
+"""
+
+from __future__ import annotations
+
+import abc
+
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("planner.connector")
+
+
+class Connector(abc.ABC):
+    @abc.abstractmethod
+    async def scale(self, component: str, replicas: int) -> None:
+        """Set the desired replica count for a worker component."""
+
+    async def current(self, component: str) -> int | None:
+        """Observed replica count, if the substrate can report it."""
+        return None
+
+
+class FakeConnector(Connector):
+    """Records scale calls; optionally tracks a simulated replica count."""
+
+    def __init__(self, initial: dict[str, int] | None = None):
+        self.replicas: dict[str, int] = dict(initial or {})
+        self.calls: list[tuple[str, int]] = []
+
+    async def scale(self, component: str, replicas: int) -> None:
+        self.calls.append((component, replicas))
+        self.replicas[component] = replicas
+        log.info("scale %s -> %d", component, replicas)
+
+    async def current(self, component: str) -> int | None:
+        return self.replicas.get(component)

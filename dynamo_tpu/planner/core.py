@@ -1,0 +1,198 @@
+"""Planner core loop (reference planner_core.py:55 _Planner).
+
+Consumes the workers' ForwardPassMetrics stream (the same pub/sub plane
+the KV router reads), aggregates per-pool load, predicts one adjustment
+interval ahead, and asks the connector for replica counts:
+
+- decode pool: replicas sized so predicted concurrent requests fit within
+  per-worker slot capacity at a utilization headroom;
+- prefill pool (disaggregated deployments): replicas sized from predicted
+  prefill token throughput against the profiler-measured per-worker
+  capacity (profiler.choose_capacity).
+
+Guard rails mirror the reference: min/max replica bounds, scale-down
+hysteresis, and an adjustment cooldown so decisions don't flap.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import math
+import time
+
+from dynamo_tpu.llm.kv_router.protocols import (ForwardPassMetrics,
+                                                load_metrics_subject)
+from dynamo_tpu.planner.connector import Connector
+from dynamo_tpu.planner.predictors import make_predictor
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("planner")
+
+
+@dataclasses.dataclass
+class PlannerConfig:
+    namespace: str = "dynamo"
+    decode_component: str = "tpu"
+    prefill_component: str | None = None  # None = aggregated deployment
+    adjustment_interval_s: float = 10.0
+    predictor: str = "moving_average"
+    predictor_window: int = 6
+    # Decode sizing.
+    max_num_seqs_per_worker: int = 32
+    target_utilization: float = 0.8  # headroom before scaling up
+    # Prefill sizing (tokens/s one worker sustains within the TTFT SLA;
+    # normally filled from profiler.choose_capacity).
+    prefill_capacity_tok_s: float = 8000.0
+    min_replicas: int = 1
+    max_replicas: int = 8
+    # Consecutive under-loaded intervals required before scaling down.
+    scale_down_patience: int = 3
+
+
+class PoolState:
+    """Aggregated view of one worker pool from its metrics stream."""
+
+    def __init__(self, predictor: str, window: int):
+        self.workers: dict[int, ForwardPassMetrics] = {}
+        self.last_seen: dict[int, float] = {}
+        self.load_pred = make_predictor(predictor, window=window)
+        self.tok_pred = make_predictor(predictor, window=window)
+
+    def observe(self, worker_id: int, metrics: ForwardPassMetrics) -> None:
+        self.workers[worker_id] = metrics
+        self.last_seen[worker_id] = time.monotonic()
+
+    def snapshot(self, stale_s: float = 30.0) -> dict:
+        now = time.monotonic()
+        live = {w: m for w, m in self.workers.items()
+                if now - self.last_seen.get(w, 0) < stale_s}
+        active = sum(m.worker_stats.request_active_slots for m in live.values())
+        waiting = sum(m.worker_stats.num_requests_waiting for m in live.values())
+        return {"workers": len(live), "active": active, "waiting": waiting}
+
+
+class Planner:
+    def __init__(self, config: PlannerConfig, connector: Connector,
+                 runtime=None):
+        self.config = config
+        self.connector = connector
+        self._runtime = runtime
+        self.decode = PoolState(config.predictor, config.predictor_window)
+        self.prefill = (PoolState(config.predictor, config.predictor_window)
+                        if config.prefill_component else None)
+        self._below_decode = 0
+        self._below_prefill = 0
+        self._subs: list = []
+        self._tasks: list[asyncio.Task] = []
+        self.decisions: list[dict] = []
+
+    # -- metrics intake -------------------------------------------------------
+    async def start(self) -> None:
+        """Subscribe to the pools' metrics subjects (needs a runtime)."""
+        assert self._runtime is not None
+        client = self._runtime.require_coordinator()
+        cfg = self.config
+        pools = [(cfg.decode_component, self.decode)]
+        if self.prefill is not None:
+            pools.append((cfg.prefill_component, self.prefill))
+        for comp, pool in pools:
+            sub = await client.subscribe(
+                load_metrics_subject(cfg.namespace, comp))
+            self._subs.append(sub)
+            self._tasks.append(asyncio.create_task(self._intake(sub, pool)))
+        self._tasks.append(asyncio.create_task(self._loop()))
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for s in self._subs:
+            await s.cancel()
+
+    async def _intake(self, sub, pool: PoolState) -> None:
+        async for msg in sub:
+            payload = msg["payload"]
+            try:
+                m = ForwardPassMetrics.from_wire(payload)
+                pool.observe(m.worker_id or 0, m)
+            except (KeyError, TypeError, ValueError):
+                log.warning("malformed metrics payload: %r", payload)
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.config.adjustment_interval_s)
+            try:
+                await self.step()
+            except Exception:  # noqa: BLE001
+                log.exception("planner step failed")
+
+    # -- decisions ------------------------------------------------------------
+    def _bounded(self, n: int) -> int:
+        return max(self.config.min_replicas,
+                   min(self.config.max_replicas, n))
+
+    async def step(self) -> dict:
+        """One adjustment: observe, predict, decide, scale. Returns the
+        decision record (also appended to self.decisions)."""
+        cfg = self.config
+        snap = self.decode.snapshot()
+        demand = snap["active"] + snap["waiting"]
+        self.decode.load_pred.observe(demand)
+        predicted = self.decode.load_pred.predict()
+        capacity = cfg.max_num_seqs_per_worker * cfg.target_utilization
+        want = self._bounded(math.ceil(predicted / max(1e-9, capacity)))
+        current = (await self.connector.current(cfg.decode_component)
+                   or snap["workers"] or cfg.min_replicas)
+        decide = current
+        if want > current:
+            decide = want
+            self._below_decode = 0
+        elif want < current:
+            # Hysteresis: only shrink after sustained low demand.
+            self._below_decode += 1
+            if self._below_decode >= cfg.scale_down_patience:
+                decide = want
+                self._below_decode = 0
+        else:
+            self._below_decode = 0
+        record = {"pool": "decode", "demand": demand,
+                  "predicted": predicted, "current": current,
+                  "target": decide}
+        if decide != current:
+            await self.connector.scale(cfg.decode_component, decide)
+        self.decisions.append(record)
+
+        if self.prefill is not None:
+            psnap = self.prefill.snapshot()
+            # Prefill demand proxy: waiting requests * avg prompt length is
+            # not observable here; use queued prefill tokens when published,
+            # else waiting-request pressure against profiled throughput.
+            ptok = sum(
+                (m.worker_stats.num_requests_waiting or 0)
+                for m in self.prefill.workers.values()) * 512.0
+            self.prefill.tok_pred.observe(ptok)
+            ppred = self.prefill.tok_pred.predict()
+            pwant = self._bounded(
+                math.ceil(ppred / max(1e-9, cfg.prefill_capacity_tok_s))
+                or cfg.min_replicas)
+            pcur = (await self.connector.current(cfg.prefill_component)
+                    or psnap["workers"] or cfg.min_replicas)
+            pdecide = pcur
+            if pwant > pcur:
+                pdecide = pwant
+                self._below_prefill = 0
+            elif pwant < pcur:
+                self._below_prefill += 1
+                if self._below_prefill >= cfg.scale_down_patience:
+                    pdecide = pwant
+                    self._below_prefill = 0
+            else:
+                self._below_prefill = 0
+            precord = {"pool": "prefill", "demand": ptok,
+                       "predicted": ppred, "current": pcur,
+                       "target": pdecide}
+            if pdecide != pcur:
+                await self.connector.scale(cfg.prefill_component, pdecide)
+            self.decisions.append(precord)
+            return {"decode": record, "prefill": precord}
+        return {"decode": record}

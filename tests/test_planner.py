@@ -1,0 +1,203 @@
+"""Planner + profiler tests (VERDICT r2 #9)."""
+
+import asyncio
+
+import pytest
+from conftest import async_test
+
+from dynamo_tpu.llm.kv_router.protocols import (ForwardPassMetrics, KvStats,
+                                                WorkerStats)
+from dynamo_tpu.llm.mocker import MockerConfig, MockerEngine
+from dynamo_tpu.planner import (
+    ConstantPredictor,
+    FakeConnector,
+    LinearTrendPredictor,
+    MovingAveragePredictor,
+    Planner,
+    PlannerConfig,
+    choose_capacity,
+    make_predictor,
+    profile_sweep,
+)
+
+
+def metrics(active=0, waiting=0, total=32):
+    return ForwardPassMetrics(
+        worker_stats=WorkerStats(request_active_slots=active,
+                                 request_total_slots=total,
+                                 num_requests_waiting=waiting),
+        kv_stats=KvStats())
+
+
+# -- predictors --------------------------------------------------------------
+
+def test_constant_predictor():
+    p = ConstantPredictor()
+    assert p.predict() == 0.0
+    p.observe(5)
+    p.observe(9)
+    assert p.predict() == 9.0
+
+
+def test_moving_average_predictor():
+    p = MovingAveragePredictor(window=3)
+    for v in (1, 2, 3, 4):
+        p.observe(v)
+    assert abs(p.predict() - 3.0) < 1e-9  # window keeps 2,3,4
+
+
+def test_linear_trend_extrapolates_ramps():
+    p = LinearTrendPredictor(window=4)
+    for v in (10, 20, 30, 40):
+        p.observe(v)
+    assert p.predict() > 40  # ramp continues
+    flat = MovingAveragePredictor(window=4)
+    for v in (10, 20, 30, 40):
+        flat.observe(v)
+    assert flat.predict() < p.predict()
+
+
+def test_make_predictor_rejects_unknown():
+    with pytest.raises(ValueError):
+        make_predictor("prophet")
+
+
+# -- planner decisions -------------------------------------------------------
+
+@async_test
+async def test_scale_up_on_demand():
+    conn = FakeConnector({"tpu": 1})
+    planner = Planner(PlannerConfig(max_num_seqs_per_worker=8,
+                                    target_utilization=1.0,
+                                    predictor="constant"), conn)
+    planner.decode.observe(1, metrics(active=8, waiting=12))
+    out = await planner.step()
+    assert out["decode"]["target"] == 3  # 20 demand / 8 per worker
+    assert conn.replicas["tpu"] == 3
+
+
+@async_test
+async def test_scale_down_needs_patience():
+    conn = FakeConnector({"tpu": 4})
+    planner = Planner(PlannerConfig(max_num_seqs_per_worker=8,
+                                    target_utilization=1.0,
+                                    predictor="constant",
+                                    scale_down_patience=3), conn)
+    planner.decode.observe(1, metrics(active=4))
+    for i in range(2):
+        out = await planner.step()
+        assert out["decode"]["target"] == 4, f"shrank too early (step {i})"
+    out = await planner.step()
+    assert out["decode"]["target"] == 1
+    assert conn.calls == [("tpu", 1)]
+
+
+@async_test
+async def test_bounds_respected():
+    conn = FakeConnector({"tpu": 1})
+    planner = Planner(PlannerConfig(max_num_seqs_per_worker=1,
+                                    target_utilization=1.0,
+                                    predictor="constant",
+                                    max_replicas=4), conn)
+    planner.decode.observe(1, metrics(active=50, waiting=50))
+    out = await planner.step()
+    assert out["decode"]["target"] == 4  # capped
+
+
+@async_test
+async def test_prefill_pool_scales_from_profiled_capacity():
+    conn = FakeConnector({"tpu": 1, "prefill": 1})
+    cfg = PlannerConfig(prefill_component="prefill",
+                        prefill_capacity_tok_s=1000.0,
+                        predictor="constant")
+    planner = Planner(cfg, conn)
+    planner.decode.observe(1, metrics(active=1))
+    # 8 waiting requests * 512-token proxy = 4096 tok/s demand -> 5 workers.
+    planner.prefill.observe(2, metrics(waiting=8))
+    out = await planner.step()
+    assert out["prefill"]["target"] == 5
+    assert conn.replicas["prefill"] == 5
+
+
+@async_test
+async def test_planner_intake_over_coordinator():
+    """Metrics published by a worker reach the planner's pool state over
+    the real coordinator pub/sub plane."""
+    from dynamo_tpu.llm.kv_router.publisher import WorkerMetricsPublisher
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.coordinator import Coordinator
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    coord = Coordinator()
+    await coord.start()
+    rt = await DistributedRuntime.from_settings(
+        RuntimeConfig(coordinator_url=coord.url))
+    try:
+        conn = FakeConnector({"tpu": 1})
+        planner = Planner(PlannerConfig(namespace="test",
+                                        adjustment_interval_s=60,
+                                        predictor="constant"), conn,
+                          runtime=rt)
+        await planner.start()
+        pub = WorkerMetricsPublisher(rt, "test", "tpu", worker_id=7,
+                                     min_interval_s=0.0)
+        await pub.publish(metrics(active=5, waiting=2), force=True)
+        for _ in range(100):
+            if planner.decode.workers:
+                break
+            await asyncio.sleep(0.02)
+        assert 7 in planner.decode.workers
+        snap = planner.decode.snapshot()
+        assert snap == {"workers": 1, "active": 5, "waiting": 2}
+        await planner.stop()
+    finally:
+        await rt.close()
+        await coord.stop()
+
+
+# -- profiler ----------------------------------------------------------------
+
+@async_test
+async def test_profile_sweep_and_capacity_selection(tmp_path):
+    def factory():
+        eng = MockerEngine(MockerConfig(speedup_ratio=50.0))
+        eng.start()
+        return eng
+
+    table = await profile_sweep(
+        factory, [(64, 16, 2), (64, 16, 8)],
+        output_path=str(tmp_path / "profile.json"))
+    assert len(table["points"]) == 2
+    for p in table["points"]:
+        assert p["decode_tok_s"] > 0
+        assert p["ttft_p99_ms"] > 0
+    assert (tmp_path / "profile.json").exists()
+    # Generous SLA: highest-throughput point is selected.
+    cap = choose_capacity(table, ttft_sla_ms=60000, itl_sla_ms=60000)
+    assert cap["max_concurrency"] in (2, 8)
+    assert cap["decode_capacity_tok_s"] == max(
+        p["decode_tok_s"] for p in table["points"])
+    # Impossible SLA errors out.
+    with pytest.raises(ValueError):
+        choose_capacity(table, ttft_sla_ms=0.001, itl_sla_ms=0.001)
+
+
+@async_test
+async def test_planner_consumes_profiler_output(tmp_path):
+    """The documented wiring: sweep -> choose_capacity -> PlannerConfig."""
+    def factory():
+        eng = MockerEngine(MockerConfig(speedup_ratio=50.0))
+        eng.start()
+        return eng
+
+    table = await profile_sweep(factory, [(64, 16, 4)])
+    cap = choose_capacity(table, ttft_sla_ms=60000, itl_sla_ms=60000)
+    cfg = PlannerConfig(prefill_component="prefill",
+                        prefill_capacity_tok_s=cap["prefill_capacity_tok_s"],
+                        max_num_seqs_per_worker=cap["max_concurrency"],
+                        predictor="constant")
+    conn = FakeConnector({"tpu": 1, "prefill": 1})
+    planner = Planner(cfg, conn)
+    planner.decode.observe(1, metrics(active=3 * cap["max_concurrency"]))
+    out = await planner.step()
+    assert out["decode"]["target"] >= 3
