@@ -283,8 +283,21 @@ class TPUEngine(AsyncEngine):
             r.pages = []
         return first_token, kv, len(r.tokens_all)
 
+    async def embed(self, token_lists: list[list[int]],
+                    pooling: str = "last") -> list[list[float]]:
+        """Batch embeddings, computed on the engine thread between windows
+        (/v1/embeddings backend)."""
+        out = await self.run_job(
+            lambda: self.runner.embed(token_lists, pooling))
+        return [row.tolist() for row in out]
+
     def handler(self):
         async def handle(request, context):
+            if isinstance(request, dict) and request.get("embed"):
+                vectors = await self.embed(request["token_lists"],
+                                           request.get("pooling", "last"))
+                yield {"embeddings": vectors}
+                return
             async for out in self.generate(request, context):
                 yield out
 

@@ -395,6 +395,67 @@ def decode_forward(params: Params, spec: ModelSpec,
     return logits, k_cache, v_cache
 
 
+def embed_forward(params: Params, spec: ModelSpec, tokens: jax.Array,
+                  seq_lens: jax.Array, pooling: str = "last"
+                  ) -> jax.Array:
+    """Embedding forward: full transformer pass, pooled final hidden
+    states (no KV cache — embeddings are single-shot). tokens [B,S]
+    (padded), seq_lens [B]. pooling: "last" (final valid token) or
+    "mean" (masked mean). Returns L2-normalized [B,H] float32 — the
+    engine side of /v1/embeddings (reference embeddings path,
+    lib/llm/src/protocols/openai/embeddings*)."""
+    b, s = tokens.shape
+    d = spec.head_dim
+    x = params["embed"][tokens].astype(jnp.bfloat16)
+    positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+    cos, sin = rope_tables(positions, d, spec.rope_theta)
+    valid = jnp.arange(s)[None, :] < seq_lens[:, None]
+
+    def layer_fn(x, lp):
+        h = rms_norm(x, lp["input_norm"], spec.rms_norm_eps)
+        q = jnp.einsum("bsh,hd->bsd", h, lp["wq"],
+                       preferred_element_type=jnp.bfloat16)
+        k = jnp.einsum("bsh,hd->bsd", h, lp["wk"],
+                       preferred_element_type=jnp.bfloat16)
+        v = jnp.einsum("bsh,hd->bsd", h, lp["wv"],
+                       preferred_element_type=jnp.bfloat16)
+        if spec.qkv_bias:
+            q = q + lp["bq"]
+            k = k + lp["bk"]
+            v = v + lp["bv"]
+        q = _split_heads(q, spec.num_heads, d)
+        k = _split_heads(k, spec.num_kv_heads, d)
+        v = _split_heads(v, spec.num_kv_heads, d)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        attn = dense_causal_attention(q, k, v, positions, valid,
+                                      spec.q_per_kv)
+        x = x + jnp.einsum("bsd,dh->bsh", attn.reshape(b, s, -1), lp["wo"],
+                           preferred_element_type=jnp.bfloat16)
+        h2 = rms_norm(x, lp["post_attn_norm"], spec.rms_norm_eps)
+        ff = (jax.nn.silu(jnp.einsum(
+            "bsh,hi->bsi", h2, lp["w_gate"],
+            preferred_element_type=jnp.bfloat16).astype(jnp.float32))
+            .astype(jnp.bfloat16)
+            * jnp.einsum("bsh,hi->bsi", h2, lp["w_up"],
+                         preferred_element_type=jnp.bfloat16))
+        x = x + jnp.einsum("bsi,ih->bsh", ff, lp["w_down"],
+                           preferred_element_type=jnp.bfloat16)
+        return x, ()
+
+    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], spec.rms_norm_eps).astype(
+        jnp.float32)
+    if pooling == "mean":
+        m = valid[..., None].astype(jnp.float32)
+        pooled = (x * m).sum(axis=1) / jnp.maximum(m.sum(axis=1), 1.0)
+    else:
+        last = jnp.maximum(seq_lens - 1, 0)
+        pooled = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
+    return pooled / jnp.maximum(
+        jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12)
+
+
 def decode_window_step(params: Params, spec: ModelSpec,
                        k_cache: jax.Array, v_cache: jax.Array,
                        k_buf: jax.Array, v_buf: jax.Array, m: jax.Array,

@@ -500,6 +500,39 @@ class ModelRunner:
                 jnp.asarray(packed), self._rng)
         return toks, lps, top_vs, top_is
 
+    def embed(self, token_lists: list[list[int]],
+              pooling: str = "last") -> np.ndarray:
+        """Pooled, L2-normalized embeddings [n, H] for a batch of prompts
+        (compiled per (bucket, batch-bucket, pooling))."""
+        from dynamo_tpu.engine.model import embed_forward
+        cfg = self.config
+        spec = self.spec
+        if not token_lists or any(not t for t in token_lists):
+            raise ValueError("embeddings need at least one non-empty input")
+        n_max = max(len(t) for t in token_lists)
+        if n_max > cfg.prefill_buckets[-1]:
+            raise ValueError(
+                f"embedding input of {n_max} tokens exceeds the largest "
+                f"prefill bucket ({cfg.prefill_buckets[-1]})")
+        bucket = cfg.bucket_for(n_max)
+        bp = 1
+        while bp < len(token_lists):
+            bp *= 2
+        key = ("embed", bucket, bp, pooling)
+        fn = self._window_cache.get(key)
+        if fn is None:
+            fn = jax.jit(lambda p, t, sl: embed_forward(
+                p, spec, t, sl, pooling=pooling))
+            self._window_cache[key] = fn
+        toks = np.zeros((bp, bucket), np.int32)
+        lens = np.ones((bp,), np.int32)
+        for i, t in enumerate(token_lists):
+            toks[i, :len(t)] = t
+            lens[i] = len(t)
+        with self.mesh:
+            out = fn(self.params, jnp.asarray(toks), jnp.asarray(lens))
+        return np.asarray(jax.device_get(out))[:len(token_lists)]
+
     # -- KV page transfer (disaggregation data plane) -------------------------
     def _get_extract(self, n: int):
         key = ("extract", n)

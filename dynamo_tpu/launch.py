@@ -1,0 +1,191 @@
+"""Unified launcher: one process, pluggable input and output.
+
+Capability parity with reference dynamo-run (launch/dynamo-run/src/
+lib.rs:19-92): ``python -m dynamo_tpu.launch in=<http|text> out=<tpu|
+mocker|echo> [--model ...]`` assembles the whole pipeline statically —
+tokenizer, preprocessor, detokenizing backend, engine — with no
+coordinator, no registration, no network hop between frontend and engine.
+``out=dyn`` connects to a coordinator instead and serves whatever workers
+register (the distributed mode the separate frontend/worker mains also
+provide).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+
+from dynamo_tpu.llm.backend import Backend
+from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher, ServedModel
+from dynamo_tpu.llm.http_service import HttpService
+from dynamo_tpu.llm.model_card import (DEFAULT_CHAT_TEMPLATE,
+                                       ModelDeploymentCard, ModelEntry)
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.llm.protocols import ChatCompletionRequest
+from dynamo_tpu.llm.tokenizer import Tokenizer, make_test_tokenizer
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("launch")
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    io = {"in": "http", "out": "tpu"}
+    rest = []
+    for a in argv:
+        if a.startswith("in=") or a.startswith("out="):
+            k, v = a.split("=", 1)
+            io[k] = v
+        else:
+            rest.append(a)
+    parser = argparse.ArgumentParser(
+        description="dynamo-tpu unified launcher (in=http|text "
+                    "out=tpu|mocker|echo|dyn)")
+    parser.add_argument("--model", default="tiny-test")
+    parser.add_argument("--model-name", default=None)
+    parser.add_argument("--tokenizer", default=None)
+    parser.add_argument("--http-host", default="0.0.0.0")
+    parser.add_argument("--http-port", type=int, default=8000)
+    parser.add_argument("--num-pages", type=int, default=None)
+    parser.add_argument("--max-num-seqs", type=int, default=32)
+    parser.add_argument("--context-length", type=int, default=8192)
+    # Engine knobs shared with the worker (backends.tpu.build_engine_config).
+    parser.add_argument("--page-size", type=int, default=16)
+    parser.add_argument("--max-pages-per-seq", type=int, default=512)
+    parser.add_argument("--tp", type=int, default=1)
+    parser.add_argument("--dp", type=int, default=1)
+    parser.add_argument("--attention-backend", default="auto",
+                        choices=["auto", "pallas", "xla"])
+    parser.add_argument("--host-cache-pages", type=int, default=0)
+    parser.add_argument("--kv-disk-cache-dir", default=None)
+    parser.add_argument("--coordinator-url", default=None,
+                        help="out=dyn: control plane to discover workers on")
+    parser.add_argument("--tool-call-parser", default=None)
+    parser.add_argument("--reasoning-parser", default=None)
+    args = parser.parse_args(rest)
+    args.input = io["in"]
+    args.output = io["out"]
+    if args.input not in ("http", "text"):
+        parser.error(f"in= must be http or text, got {args.input!r}")
+    if args.output not in ("tpu", "mocker", "echo", "dyn"):
+        parser.error(f"out= must be tpu|mocker|echo|dyn, got {args.output!r}")
+    return args
+
+
+def _build_engine(args):
+    if args.output == "echo":
+        from dynamo_tpu.llm.engines import EchoEngine
+        return EchoEngine(token_delay_s=0.005), make_test_tokenizer()
+    if args.output == "mocker":
+        from dynamo_tpu.llm.mocker import MockerConfig, MockerEngine
+        eng = MockerEngine(MockerConfig(speedup_ratio=10.0))
+        eng.start()
+        return eng, make_test_tokenizer()
+    # out=tpu: the real engine, in-process.
+    from dynamo_tpu.backends.tpu import build_engine_config
+    from dynamo_tpu.engine.engine import TPUEngine
+    from dynamo_tpu.engine.weights import load_hf_weights
+    cfg = build_engine_config(args)
+    params = None
+    if os.path.isdir(args.model):
+        params = load_hf_weights(cfg.model, args.model)
+        tokenizer = Tokenizer.from_pretrained_dir(args.model)
+    elif args.tokenizer:
+        tokenizer = Tokenizer.from_file(args.tokenizer)
+    else:
+        tokenizer = make_test_tokenizer()
+    engine = TPUEngine(cfg, params=params)
+    engine.start()
+    return engine, tokenizer
+
+
+def build_local_served(args) -> tuple[ServedModel, object]:
+    """Static pipeline: Preprocessor -> Backend -> engine, no network."""
+    engine, tokenizer = _build_engine(args)
+    name = args.model_name or os.path.basename(args.model.rstrip("/"))
+    card = ModelDeploymentCard(
+        name=name, chat_template=DEFAULT_CHAT_TEMPLATE,
+        context_length=args.context_length,
+        tool_call_parser=args.tool_call_parser,
+        reasoning_parser=args.reasoning_parser)
+    entry = ModelEntry(model_name=name, namespace="local", component="local",
+                       endpoint="generate", model_type="chat", card=card)
+    backend = Backend(tokenizer, inner=engine)
+    pre = OpenAIPreprocessor(card, tokenizer, inner=backend)
+    served = ServedModel(entry, pre, client=None, router=None)
+    return served, engine
+
+
+async def run_text_repl(served: ServedModel) -> None:
+    """in=text: an interactive prompt loop on stdin (dynamo-run's text
+    input)."""
+    loop = asyncio.get_running_loop()
+    print("dynamo-tpu text console — empty line or EOF exits", flush=True)
+    while True:
+        line = await loop.run_in_executor(None, sys.stdin.readline)
+        if not line or not line.strip():
+            return
+        req = ChatCompletionRequest(
+            model=served.name,
+            messages=[{"role": "user", "content": line.strip()}],
+            max_tokens=64, stream=True)
+        async for chunk in served.preprocessor.generate(req, Context()):
+            for choice in chunk.get("choices", []):
+                piece = choice.get("delta", {}).get("content")
+                if piece:
+                    print(piece, end="", flush=True)
+        print(flush=True)
+
+
+async def run(args) -> None:
+    if args.output == "dyn":
+        cfg = RuntimeConfig.from_settings()
+        if args.coordinator_url:
+            cfg.coordinator_url = args.coordinator_url
+        runtime = await DistributedRuntime.from_settings(cfg)
+        manager = ModelManager()
+        watcher = ModelWatcher(runtime, manager)
+        await watcher.start()
+        engine = None
+    else:
+        runtime = await DistributedRuntime.detached(RuntimeConfig())
+        manager = ModelManager()
+        served, engine = build_local_served(args)
+        manager.models[served.name] = served
+        watcher = None
+    try:
+        if args.input == "text":
+            if args.output == "dyn":
+                raise SystemExit("in=text requires a local out= engine")
+            await run_text_repl(served)
+            return
+        service = HttpService(runtime, manager, host=args.http_host,
+                              port=args.http_port)
+        await service.start()
+        print(f"LAUNCH_READY in={args.input} out={args.output} "
+              f"port={service.port}", flush=True)
+        await runtime.wait_for_shutdown()
+        await service.stop()
+    finally:
+        if watcher is not None:
+            await watcher.stop()
+        if engine is not None:
+            stop = getattr(engine, "stop", None)
+            if stop is not None:
+                res = stop()
+                if asyncio.iscoroutine(res):
+                    await res
+        await runtime.close()
+
+
+def main() -> None:
+    asyncio.run(run(parse_args()))
+
+
+if __name__ == "__main__":
+    main()

@@ -137,6 +137,12 @@ class DisaggDecodeHandler:
 
     def handler(self):
         async def handle(request, context):
+            if isinstance(request, dict) and request.get("embed"):
+                # Embeddings don't involve the disagg path: serve locally.
+                vectors = await self.engine.embed(
+                    request["token_lists"], request.get("pooling", "last"))
+                yield {"embeddings": vectors}
+                return
             async for out in self.generate(request, context):
                 yield out
         return handle

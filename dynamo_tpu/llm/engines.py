@@ -44,6 +44,8 @@ class EchoEngine(AsyncEngine):
         """serve_endpoint-compatible async-generator handler."""
 
         async def handle(request, context):
+            if isinstance(request, dict) and request.get("embed"):
+                raise ValueError("echo engine does not serve embeddings")
             async for out in self.generate(request, context):
                 yield out
 

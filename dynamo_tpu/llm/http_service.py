@@ -32,6 +32,28 @@ from dynamo_tpu.runtime.logging import get_logger, parse_traceparent
 log = get_logger("http")
 
 
+def _response_object(full: dict, model: str, text: str | None) -> dict:
+    """OpenAI Responses-API response object from an aggregated chat result."""
+    usage = full.get("usage") or {}
+    return {
+        "id": f"resp-{full.get('id')}",
+        "object": "response",
+        "created_at": full.get("created"),
+        "model": model,
+        "status": "completed",
+        "output": [{
+            "type": "message", "role": "assistant",
+            "content": [{"type": "output_text", "text": text or ""}],
+        }],
+        "output_text": text or "",
+        "usage": {
+            "input_tokens": usage.get("prompt_tokens", 0),
+            "output_tokens": usage.get("completion_tokens", 0),
+            "total_tokens": usage.get("total_tokens", 0),
+        },
+    }
+
+
 def _error_body(message: str, err_type: str = "invalid_request_error",
                 code: int = 400) -> web.Response:
     return web.Response(
@@ -67,6 +89,8 @@ class HttpService:
         app = web.Application()
         app.router.add_post("/v1/chat/completions", self._chat)
         app.router.add_post("/v1/completions", self._completion)
+        app.router.add_post("/v1/embeddings", self._embeddings)
+        app.router.add_post("/v1/responses", self._responses)
         app.router.add_get("/v1/models", self._models)
         app.router.add_get("/health", self._health)
         app.router.add_get("/live", self._live)
@@ -232,6 +256,178 @@ class HttpService:
         finally:
             self._m_inflight.dec(route=route)
             self._m_duration.observe(time.monotonic() - started, route=route)
+
+    async def _embeddings(self, request: web.Request) -> web.Response:
+        """OpenAI /v1/embeddings (reference openai.rs embeddings route):
+        tokenizes the input(s) and asks an embedding-capable worker."""
+        route = "embeddings"
+        started = time.monotonic()
+        self._m_inflight.inc(route=route)
+        try:
+            try:
+                body = await request.json()
+                model = body["model"]
+                raw = body.get("input")
+                if raw is None:
+                    raise ValueError("missing 'input'")
+            except (json.JSONDecodeError, KeyError, ValueError) as exc:
+                self._m_requests.inc(route=route, status="400")
+                return _error_body(str(exc))
+            served = self.manager.get(model)
+            if served is None:
+                self._m_requests.inc(route=route, status="404")
+                return _error_body(f"model {model!r} not found",
+                                   "model_not_found", 404)
+            inputs = raw if isinstance(raw, list) else [raw]
+            if inputs and isinstance(inputs[0], int):
+                inputs = [inputs]  # a single pre-tokenized prompt
+            tokenizer = served.preprocessor.tokenizer
+            token_lists = [t if isinstance(t, list) else tokenizer.encode(t)
+                           for t in inputs]
+            limit = served.entry.card.context_length
+            if not token_lists or any(not t for t in token_lists):
+                self._m_requests.inc(route=route, status="400")
+                return _error_body("'input' must contain at least one "
+                                   "non-empty prompt")
+            if any(len(t) > limit for t in token_lists):
+                self._m_requests.inc(route=route, status="400")
+                return _error_body(
+                    f"input exceeds the model context length ({limit})")
+            try:
+                if served.client is None:
+                    # Static/local pipeline (unified launcher): reach the
+                    # in-process engine behind Preprocessor -> Backend.
+                    engine = served.preprocessor.inner.inner
+                    vectors = await engine.embed(
+                        token_lists, body.get("pooling", "last"))
+                else:
+                    stream = await served.client.round_robin(
+                        {"embed": True, "token_lists": token_lists,
+                         "pooling": body.get("pooling", "last")})
+                    vectors = None
+                    async for item in stream:
+                        if "embeddings" in item:
+                            vectors = item["embeddings"]
+                    if vectors is None:
+                        raise RuntimeError("worker returned no embeddings")
+            except NoInstancesError as exc:
+                self._m_requests.inc(route=route, status="503")
+                return _error_body(str(exc), "service_unavailable", 503)
+            self._m_requests.inc(route=route, status="200")
+            total = sum(len(t) for t in token_lists)
+            return web.json_response({
+                "object": "list", "model": model,
+                "data": [{"object": "embedding", "index": i, "embedding": v}
+                         for i, v in enumerate(vectors)],
+                "usage": {"prompt_tokens": total, "total_tokens": total},
+            })
+        except Exception as exc:  # noqa: BLE001
+            log.exception("embeddings handler failed")
+            self._m_requests.inc(route=route, status="500")
+            return _error_body(f"internal error: {exc}", "internal_error", 500)
+        finally:
+            self._m_inflight.dec(route=route)
+            self._m_duration.observe(time.monotonic() - started, route=route)
+
+    async def _responses(self, request: web.Request) -> web.Response:
+        """OpenAI /v1/responses (reference openai.rs:1023-1094 responses
+        route): adapts the Responses API onto the chat pipeline
+        (non-streaming)."""
+        route = "responses"
+        started = time.monotonic()
+        self._m_inflight.inc(route=route)
+        try:
+            try:
+                body = await request.json()
+                model = body["model"]
+                raw_input = body.get("input", "")
+            except (json.JSONDecodeError, KeyError) as exc:
+                self._m_requests.inc(route=route, status="400")
+                return _error_body(str(exc))
+            served = self.manager.get(model)
+            if served is None:
+                self._m_requests.inc(route=route, status="404")
+                return _error_body(f"model {model!r} not found",
+                                   "model_not_found", 404)
+            if isinstance(raw_input, str):
+                messages = [{"role": "user", "content": raw_input}]
+            else:
+                messages = [{"role": m.get("role", "user"),
+                             "content": m.get("content", "")}
+                            for m in raw_input]
+            if body.get("instructions"):
+                messages.insert(0, {"role": "system",
+                                    "content": body["instructions"]})
+            try:
+                chat_req = ChatCompletionRequest(
+                    model=model, messages=messages,
+                    max_tokens=body.get("max_output_tokens"),
+                    temperature=body.get("temperature"),
+                    top_p=body.get("top_p"),
+                    stream_options={"include_usage": True})
+            except ValidationError as exc:
+                self._m_requests.inc(route=route, status="400")
+                return _error_body(str(exc))
+            ctx = self._make_context(request)
+            chunks = served.preprocessor.generate(chat_req, ctx)
+            if body.get("stream"):
+                resp = await self._responses_sse(request, chunks, ctx, model)
+                self._m_requests.inc(route=route, status="200")
+                return resp
+            full = await aggregate_chat_stream(chunks, 0)
+            msg = full["choices"][0]["message"]
+            usage = full.get("usage") or {}
+            self._m_requests.inc(route=route, status="200")
+            return web.json_response(_response_object(full, model,
+                                                      msg.get("content")))
+        except NoInstancesError as exc:
+            self._m_requests.inc(route=route, status="503")
+            return _error_body(str(exc), "service_unavailable", 503)
+        except Exception as exc:  # noqa: BLE001
+            log.exception("responses handler failed")
+            self._m_requests.inc(route=route, status="500")
+            return _error_body(f"internal error: {exc}", "internal_error", 500)
+        finally:
+            self._m_inflight.dec(route=route)
+            self._m_duration.observe(time.monotonic() - started, route=route)
+
+    async def _responses_sse(self, request: web.Request, chunks,
+                             ctx: Context, model: str) -> web.StreamResponse:
+        """Responses-API streaming: response.output_text.delta events per
+        content delta, then response.completed with the final object."""
+        response = web.StreamResponse(
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache"})
+        await response.prepare(request)
+
+        async def send(event: str, data: dict) -> None:
+            await response.write(
+                f"event: {event}\ndata: {json.dumps(data)}\n\n".encode())
+
+        content: list[str] = []
+        meta: dict = {}
+        usage: dict = {}
+        try:
+            async for chunk in chunks:
+                meta = {k: chunk.get(k, meta.get(k))
+                        for k in ("id", "created")}
+                if chunk.get("usage"):
+                    usage = chunk["usage"]
+                for choice in chunk.get("choices", []):
+                    piece = choice.get("delta", {}).get("content")
+                    if piece:
+                        content.append(piece)
+                        await send("response.output_text.delta",
+                                   {"delta": piece})
+            full = {"id": meta.get("id"), "created": meta.get("created"),
+                    "usage": usage}
+            await send("response.completed",
+                       {"response": _response_object(full, model,
+                                                     "".join(content))})
+        except (ConnectionResetError, asyncio.CancelledError):
+            ctx.kill()
+            raise
+        return response
 
     async def _models(self, _request: web.Request) -> web.Response:
         return web.json_response({"object": "list",

@@ -176,6 +176,8 @@ class MockerEngine(AsyncEngine):
 
     def handler(self):
         async def handle(request, context):
+            if isinstance(request, dict) and request.get("embed"):
+                raise ValueError("mocker engine does not serve embeddings")
             async for out in self.generate(request, context):
                 yield out
 

@@ -1,0 +1,186 @@
+"""Stream recording + JSONL event recorder.
+
+Capability parity with reference perf.rs (TimestampedResponse,
+RecordedStream, record_stream — perf.rs:32-137) and recorder.rs (Recorder:
+an mpsc-fed background task appending JSONL — recorder.rs:26-256): capture
+response streams with arrival timestamps for offline latency analysis, and
+durably log events to JSONL without blocking the hot path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import time
+from typing import Any, AsyncIterator
+
+
+@dataclasses.dataclass
+class TimestampedResponse:
+    """One captured stream item (perf.rs:32)."""
+    data: Any
+    sequence: int
+    t: float  # seconds since the stream's start
+
+    def to_wire(self) -> dict:
+        return {"t": self.t, "seq": self.sequence, "data": self.data}
+
+
+class RecordedStream:
+    """A fully-captured response stream with timing analytics
+    (perf.rs:84-130)."""
+
+    def __init__(self, responses: list[TimestampedResponse],
+                 start_time: float, end_time: float):
+        self.responses = responses
+        self.start_time = start_time
+        self.end_time = end_time
+
+    @property
+    def response_count(self) -> int:
+        return len(self.responses)
+
+    @property
+    def total_duration_s(self) -> float:
+        return self.end_time - self.start_time
+
+    def ttft_s(self) -> float | None:
+        """Time to the first item carrying tokens (or any first item)."""
+        for r in self.responses:
+            data = r.data if isinstance(r.data, dict) else {}
+            if data.get("token_ids") or not isinstance(r.data, dict):
+                return r.t
+        return self.responses[0].t if self.responses else None
+
+    def inter_arrival_s(self) -> list[float]:
+        ts = [r.t for r in self.responses]
+        return [b - a for a, b in zip(ts, ts[1:])]
+
+    def token_count(self) -> int:
+        n = 0
+        for r in self.responses:
+            if isinstance(r.data, dict):
+                n += len(r.data.get("token_ids") or [])
+        return n
+
+    def analytics(self) -> dict:
+        gaps = sorted(self.inter_arrival_s())
+        return {
+            "responses": self.response_count,
+            "tokens": self.token_count(),
+            "duration_s": self.total_duration_s,
+            "ttft_s": self.ttft_s(),
+            "itl_mean_s": (sum(gaps) / len(gaps)) if gaps else None,
+            "itl_p99_s": gaps[min(len(gaps) - 1, int(len(gaps) * 0.99))]
+            if gaps else None,
+        }
+
+    def to_wire(self) -> dict:
+        return {"start": self.start_time, "end": self.end_time,
+                "responses": [r.to_wire() for r in self.responses]}
+
+
+async def record_stream(stream: AsyncIterator,
+                        passthrough: bool = False):
+    """Consume (or tee) a stream into a RecordedStream (perf.rs
+    record_stream). With passthrough=False, returns the RecordedStream;
+    with passthrough=True, returns an async generator yielding items while
+    recording — read `.recorded` after exhaustion."""
+    if not passthrough:
+        start = time.monotonic()
+        items: list[TimestampedResponse] = []
+        i = 0
+        async for item in stream:
+            items.append(TimestampedResponse(item, i,
+                                             time.monotonic() - start))
+            i += 1
+        return RecordedStream(items, 0.0, time.monotonic() - start)
+
+    holder = _RecordingTee(stream)
+    return holder
+
+
+class _RecordingTee:
+    def __init__(self, stream: AsyncIterator):
+        self._stream = stream
+        self.recorded: RecordedStream | None = None
+
+    def __aiter__(self):
+        return self._iter()
+
+    async def _iter(self):
+        start = time.monotonic()
+        items: list[TimestampedResponse] = []
+        i = 0
+        try:
+            async for item in self._stream:
+                items.append(TimestampedResponse(item, i,
+                                                 time.monotonic() - start))
+                i += 1
+                yield item
+        finally:
+            self.recorded = RecordedStream(items, 0.0,
+                                           time.monotonic() - start)
+
+
+class Recorder:
+    """JSONL event recorder (recorder.rs:26): events enqueue without
+    blocking; a background task appends them to the file, flushing per
+    batch. Call ``close`` to drain."""
+
+    def __init__(self, path: str, queue_size: int = 4096):
+        self.path = path
+        self._q: asyncio.Queue = asyncio.Queue(maxsize=queue_size)
+        self._task: asyncio.Task | None = None
+        self._closed = False
+        self.dropped = 0
+        self.written = 0
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._run())
+
+    def record(self, event: dict) -> None:
+        """Non-blocking enqueue; drops (and counts) when the sink can't
+        keep up rather than stalling the serving path."""
+        if self._closed:
+            return
+        try:
+            self._q.put_nowait({"ts": time.time(), **event})
+        except asyncio.QueueFull:
+            self.dropped += 1
+
+    async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        with open(self.path, "a") as fh:
+            while True:
+                event = await self._q.get()
+                stop = event is None
+                batch = [] if stop else [event]
+                while not self._q.empty():
+                    nxt = self._q.get_nowait()
+                    if nxt is None:
+                        stop = True
+                        break
+                    batch.append(nxt)
+                if batch:
+                    # Disk writes off the event loop: a contended disk must
+                    # not stall token streaming or lease keepalives.
+                    def write_batch(batch=batch):
+                        for e in batch:
+                            fh.write(json.dumps(e) + "\n")
+                        fh.flush()
+                    await loop.run_in_executor(None, write_batch)
+                    self.written += len(batch)
+                if stop:
+                    return
+
+    async def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._task is not None:
+            await self._q.put(None)
+            await self._task
+            self._task = None

@@ -69,7 +69,8 @@ class PoolState:
                 if now - self.last_seen.get(w, 0) < stale_s}
         active = sum(m.worker_stats.request_active_slots for m in live.values())
         waiting = sum(m.worker_stats.num_requests_waiting for m in live.values())
-        return {"workers": len(live), "active": active, "waiting": waiting}
+        return {"workers": len(live), "active": active, "waiting": waiting,
+                "live": live}
 
 
 class Planner:
@@ -81,8 +82,7 @@ class Planner:
         self.decode = PoolState(config.predictor, config.predictor_window)
         self.prefill = (PoolState(config.predictor, config.predictor_window)
                         if config.prefill_component else None)
-        self._below_decode = 0
-        self._below_prefill = 0
+        self._below: dict[str, int] = {"decode": 0, "prefill": 0}
         self._subs: list = []
         self._tasks: list[asyncio.Task] = []
         self.decisions: list[dict] = []
@@ -131,68 +131,55 @@ class Planner:
         return max(self.config.min_replicas,
                    min(self.config.max_replicas, n))
 
-    async def step(self) -> dict:
-        """One adjustment: observe, predict, decide, scale. Returns the
-        decision record (also appended to self.decisions)."""
+    async def _decide(self, pool_name: str, component: str, snap: dict,
+                      demand: float, predictor, capacity: float) -> dict:
+        """Shared observe -> predict -> bound -> hysteresis -> scale step
+        for one pool."""
         cfg = self.config
-        snap = self.decode.snapshot()
-        demand = snap["active"] + snap["waiting"]
-        self.decode.load_pred.observe(demand)
-        predicted = self.decode.load_pred.predict()
-        capacity = cfg.max_num_seqs_per_worker * cfg.target_utilization
+        predictor.observe(demand)
+        predicted = predictor.predict()
         want = self._bounded(math.ceil(predicted / max(1e-9, capacity)))
-        current = (await self.connector.current(cfg.decode_component)
-                   or snap["workers"] or cfg.min_replicas)
+        current = await self.connector.current(component)
+        if current is None:
+            current = snap["workers"] or cfg.min_replicas
         decide = current
         if want > current:
             decide = want
-            self._below_decode = 0
+            self._below[pool_name] = 0
         elif want < current:
             # Hysteresis: only shrink after sustained low demand.
-            self._below_decode += 1
-            if self._below_decode >= cfg.scale_down_patience:
+            self._below[pool_name] += 1
+            if self._below[pool_name] >= cfg.scale_down_patience:
                 decide = want
-                self._below_decode = 0
+                self._below[pool_name] = 0
         else:
-            self._below_decode = 0
-        record = {"pool": "decode", "demand": demand,
+            self._below[pool_name] = 0
+        record = {"pool": pool_name, "demand": demand,
                   "predicted": predicted, "current": current,
                   "target": decide}
         if decide != current:
-            await self.connector.scale(cfg.decode_component, decide)
+            await self.connector.scale(component, decide)
         self.decisions.append(record)
+        return record
 
-        if self.prefill is not None:
-            psnap = self.prefill.snapshot()
-            # Prefill demand proxy: waiting requests * avg prompt length is
-            # not observable here; use queued prefill tokens when published,
-            # else waiting-request pressure against profiled throughput.
-            ptok = sum(
-                (m.worker_stats.num_requests_waiting or 0)
-                for m in self.prefill.workers.values()) * 512.0
-            self.prefill.tok_pred.observe(ptok)
-            ppred = self.prefill.tok_pred.predict()
-            pwant = self._bounded(
-                math.ceil(ppred / max(1e-9, cfg.prefill_capacity_tok_s))
-                or cfg.min_replicas)
-            pcur = (await self.connector.current(cfg.prefill_component)
-                    or psnap["workers"] or cfg.min_replicas)
-            pdecide = pcur
-            if pwant > pcur:
-                pdecide = pwant
-                self._below_prefill = 0
-            elif pwant < pcur:
-                self._below_prefill += 1
-                if self._below_prefill >= cfg.scale_down_patience:
-                    pdecide = pwant
-                    self._below_prefill = 0
-            else:
-                self._below_prefill = 0
-            precord = {"pool": "prefill", "demand": ptok,
-                       "predicted": ppred, "current": pcur,
-                       "target": pdecide}
-            if pdecide != pcur:
-                await self.connector.scale(cfg.prefill_component, pdecide)
-            self.decisions.append(precord)
-            return {"decode": record, "prefill": precord}
-        return {"decode": record}
+    async def step(self) -> dict:
+        """One adjustment: observe, predict, decide, scale per pool.
+        Returns the decision records (also appended to self.decisions)."""
+        cfg = self.config
+        snap = self.decode.snapshot()
+        record = await self._decide(
+            "decode", cfg.decode_component, snap,
+            snap["active"] + snap["waiting"], self.decode.load_pred,
+            cfg.max_num_seqs_per_worker * cfg.target_utilization)
+        if self.prefill is None:
+            return {"decode": record}
+        psnap = self.prefill.snapshot()
+        # Prefill demand proxy: queued-request pressure (LIVE workers only
+        # — dead workers' last metrics must not inflate demand forever)
+        # times a nominal prompt length, against profiled throughput.
+        ptok = sum((m.worker_stats.num_requests_waiting or 0)
+                   for m in psnap["live"].values()) * 512.0
+        precord = await self._decide(
+            "prefill", cfg.prefill_component, psnap, ptok,
+            self.prefill.tok_pred, cfg.prefill_capacity_tok_s)
+        return {"decode": record, "prefill": precord}

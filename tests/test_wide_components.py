@@ -1,0 +1,243 @@
+"""Perf recorder, task tracker, unified launcher, /v1/embeddings,
+/v1/responses (VERDICT r2 missing #7-#10 block)."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+from conftest import async_test
+
+from dynamo_tpu.llm.recorder import Recorder, record_stream
+from dynamo_tpu.runtime.tracker import OnError, TaskTracker
+
+
+# -- recorder ----------------------------------------------------------------
+
+async def _fake_stream(n=5, delay=0.01):
+    for i in range(n):
+        await asyncio.sleep(delay)
+        yield {"token_ids": [i], "text": f"t{i}"}
+
+
+@async_test
+async def test_record_stream_capture_and_analytics():
+    rec = await record_stream(_fake_stream(5))
+    assert rec.response_count == 5
+    assert rec.token_count() == 5
+    a = rec.analytics()
+    assert a["tokens"] == 5
+    assert a["ttft_s"] > 0
+    assert a["itl_mean_s"] > 0.005
+
+
+@async_test
+async def test_record_stream_passthrough_tee():
+    tee = await record_stream(_fake_stream(4), passthrough=True)
+    seen = []
+    async for item in tee:
+        seen.append(item)
+    assert len(seen) == 4
+    assert tee.recorded is not None
+    assert tee.recorded.response_count == 4
+
+
+@async_test
+async def test_jsonl_recorder(tmp_path):
+    path = tmp_path / "events.jsonl"
+    r = Recorder(str(path))
+    r.start()
+    for i in range(20):
+        r.record({"kind": "token", "i": i})
+    await r.close()
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert len(lines) == 20
+    assert r.written == 20 and r.dropped == 0
+    assert all("ts" in ln for ln in lines)
+    r.record({"late": True})  # after close: ignored, no crash
+
+
+# -- task tracker ------------------------------------------------------------
+
+@async_test
+async def test_tracker_success_and_failure_counts():
+    tr = TaskTracker()
+
+    async def ok():
+        return 42
+
+    async def boom():
+        raise ValueError("nope")
+
+    h1 = tr.spawn("ok", ok)
+    assert await h1 == 42
+    h2 = tr.spawn("bad", boom, policy=OnError.LOG)
+    with pytest.raises(ValueError):
+        await h2
+    assert tr.succeeded == 1 and tr.failed == 1
+    assert h2.record.error.startswith("ValueError")
+
+
+@async_test
+async def test_tracker_retry_policy_recovers():
+    tr = TaskTracker()
+    attempts = {"n": 0}
+
+    async def flaky():
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise RuntimeError("transient")
+        return "done"
+
+    h = tr.spawn("flaky", flaky, policy=OnError.RETRY, max_retries=5,
+                 backoff_s=0.001)
+    assert await h == "done"
+    assert attempts["n"] == 3
+    assert tr.retried == 2 and tr.failed == 0
+
+
+@async_test
+async def test_tracker_critical_hook_fires():
+    fired = []
+    tr = TaskTracker(on_critical=lambda name, exc: fired.append(name))
+
+    async def die():
+        raise RuntimeError("fatal")
+
+    h = tr.spawn("core", die, policy=OnError.CRITICAL)
+    with pytest.raises(RuntimeError):
+        await h
+    assert fired == ["core"]
+
+
+@async_test
+async def test_tracker_shutdown_cancels():
+    tr = TaskTracker()
+
+    async def forever():
+        await asyncio.sleep(3600)
+
+    tr.spawn("sleeper", forever)
+    await asyncio.sleep(0.05)
+    assert tr.active_count == 1
+    await tr.shutdown()
+    assert tr.active_count == 0
+    with pytest.raises(RuntimeError):
+        tr.spawn("late", forever)
+
+
+# -- unified launcher (static pipeline, in-process) --------------------------
+
+def _launch_args(extra=None):
+    from dynamo_tpu.launch import parse_args
+    return parse_args(["in=http", "out=tpu", "--model", "tiny-test",
+                       "--num-pages", "64"] + (extra or []))
+
+
+def test_launch_arg_parsing():
+    from dynamo_tpu.launch import parse_args
+    a = parse_args(["in=text", "out=mocker"])
+    assert a.input == "text" and a.output == "mocker"
+    with pytest.raises(SystemExit):
+        parse_args(["in=grpc", "out=tpu"])
+    with pytest.raises(SystemExit):
+        parse_args(["out=cuda"])
+
+
+@async_test
+async def test_launcher_static_pipeline_end_to_end():
+    """build_local_served gives a working chat pipeline with no
+    coordinator and no network."""
+    from dynamo_tpu.launch import build_local_served
+    from dynamo_tpu.llm.protocols import ChatCompletionRequest
+    from dynamo_tpu.runtime.context import Context
+    served, engine = build_local_served(_launch_args())
+    try:
+        req = ChatCompletionRequest(
+            model=served.name,
+            messages=[{"role": "user", "content": "hello"}],
+            max_tokens=6, stream=True)
+        text = []
+        finish = None
+        async for chunk in served.preprocessor.generate(req, Context()):
+            for ch in chunk.get("choices", []):
+                piece = ch.get("delta", {}).get("content")
+                if piece:
+                    text.append(piece)
+                finish = ch.get("finish_reason") or finish
+        assert finish == "length"
+    finally:
+        engine.stop()
+
+
+# -- embeddings + responses over HTTP ----------------------------------------
+
+@async_test
+async def test_embeddings_and_responses_http():
+    from aiohttp import ClientSession
+    from dynamo_tpu.launch import build_local_served
+    from dynamo_tpu.llm.discovery import ModelManager
+    from dynamo_tpu.llm.http_service import HttpService
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    runtime = await DistributedRuntime.detached(RuntimeConfig())
+    served, engine = build_local_served(_launch_args())
+    manager = ModelManager()
+    manager.models[served.name] = served
+    service = HttpService(runtime, manager, host="127.0.0.1", port=0)
+    await service.start()
+    try:
+        base = f"http://127.0.0.1:{service.port}"
+        async with ClientSession() as http:
+            # /v1/embeddings: single and batch inputs, unit-norm vectors.
+            r = await http.post(f"{base}/v1/embeddings", json={
+                "model": served.name, "input": ["hello world", "goodbye"]})
+            assert r.status == 200, await r.text()
+            body = await r.json()
+            assert body["object"] == "list" and len(body["data"]) == 2
+            v0 = np.asarray(body["data"][0]["embedding"])
+            assert abs(np.linalg.norm(v0) - 1.0) < 1e-3
+            assert body["usage"]["prompt_tokens"] > 0
+            # Different inputs -> different vectors.
+            v1 = np.asarray(body["data"][1]["embedding"])
+            assert np.abs(v0 - v1).max() > 1e-4
+
+            # /v1/responses: string input + instructions.
+            r = await http.post(f"{base}/v1/responses", json={
+                "model": served.name, "input": "say hi",
+                "instructions": "you are terse",
+                "max_output_tokens": 6})
+            assert r.status == 200, await r.text()
+            body = await r.json()
+            assert body["object"] == "response"
+            assert body["status"] == "completed"
+            assert body["output"][0]["type"] == "message"
+            assert body["usage"]["output_tokens"] == 6
+
+            # /v1/responses streaming: SSE delta events + completed.
+            r = await http.post(f"{base}/v1/responses", json={
+                "model": served.name, "input": "stream please",
+                "max_output_tokens": 4, "stream": True})
+            assert r.status == 200
+            raw = (await r.read()).decode()
+            assert "event: response.output_text.delta" in raw
+            assert "event: response.completed" in raw
+
+            # Validation: empty input -> 400; bad field -> 400.
+            r = await http.post(f"{base}/v1/embeddings", json={
+                "model": served.name, "input": []})
+            assert r.status == 400
+            r = await http.post(f"{base}/v1/responses", json={
+                "model": served.name, "input": "x",
+                "temperature": "hot"})
+            assert r.status == 400
+
+            # Unknown model -> 404 in OpenAI error format.
+            r = await http.post(f"{base}/v1/embeddings", json={
+                "model": "nope", "input": "x"})
+            assert r.status == 404
+    finally:
+        await service.stop()
+        engine.stop()
+        await runtime.close()
