@@ -35,6 +35,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument("--busy-threshold", type=float, default=None,
                         help="reject (503) when all workers exceed this load")
     parser.add_argument("--coordinator-url", default=None)
+    parser.add_argument("--grpc-port", type=int, default=None,
+                        help="also serve the KServe v2 gRPC inference "
+                             "service on this port")
     return parser.parse_args(argv)
 
 
@@ -61,6 +64,13 @@ async def run(args: argparse.Namespace) -> None:
     await watcher.start()
     service = HttpService(runtime, manager, args.http_host, args.http_port)
     await service.start()
+    grpc_server = None
+    if args.grpc_port is not None:
+        from dynamo_tpu.grpc.kserve import make_server
+        grpc_server, bound = make_server(manager, args.http_host,
+                                         args.grpc_port)
+        await grpc_server.start()
+        log.info("KServe gRPC service on %s:%d", args.http_host, bound)
 
     import signal
     loop = asyncio.get_running_loop()
@@ -72,6 +82,8 @@ async def run(args: argparse.Namespace) -> None:
     try:
         await runtime.wait_for_shutdown()
     finally:
+        if grpc_server is not None:
+            await grpc_server.stop(grace=2)
         await service.stop()
         await watcher.stop()
         await runtime.close()

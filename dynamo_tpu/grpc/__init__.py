@@ -1,0 +1,6 @@
+"""KServe v2 gRPC frontend (reference lib/llm/src/grpc/service/kserve.rs).
+
+``kserve_pb2.py`` is generated from ``kserve.proto`` and committed;
+regenerate with ``protoc --python_out=dynamo_tpu/grpc
+--proto_path=dynamo_tpu/grpc dynamo_tpu/grpc/kserve.proto``.
+"""

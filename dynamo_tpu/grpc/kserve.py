@@ -1,0 +1,183 @@
+"""KServe v2 gRPC inference service.
+
+Capability parity with the reference KServe frontend
+(lib/llm/src/grpc/service/kserve.rs:85): liveness/readiness probes, model
+readiness/metadata from the model manager, and text generation over
+ModelInfer (unary) / ModelStreamInfer (server streaming): a BYTES
+"text_input" tensor in, "text_output" tensors out, generation parameters
+(max_tokens, temperature, top_p, streaming) via request parameters.
+
+grpc_tools isn't available in the image, so the service is registered
+through grpc.aio generic method handlers with the protoc-generated
+message classes — same wire format, no codegen'd stubs needed.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from dynamo_tpu.grpc import kserve_pb2 as pb
+from dynamo_tpu.llm.preprocessor import aggregate_chat_stream
+from dynamo_tpu.llm.protocols import ChatCompletionRequest
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("kserve")
+
+SERVICE = "inference.GRPCInferenceService"
+
+
+def _param(p: pb.InferParameter):
+    which = p.WhichOneof("parameter_choice")
+    return getattr(p, which) if which else None
+
+
+def _text_input(request: pb.ModelInferRequest) -> str:
+    for t in request.inputs:
+        if t.name == "text_input" and t.contents.bytes_contents:
+            return t.contents.bytes_contents[0].decode("utf-8", "replace")
+    raise ValueError("request has no 'text_input' BYTES tensor")
+
+
+def _chat_request(model: str, request: pb.ModelInferRequest,
+                  stream: bool) -> ChatCompletionRequest:
+    params = {k: _param(v) for k, v in request.parameters.items()}
+    return ChatCompletionRequest(
+        model=model,
+        messages=[{"role": "user", "content": _text_input(request)}],
+        max_tokens=int(params.get("max_tokens") or 64),
+        temperature=params.get("temperature"),
+        top_p=params.get("top_p"),
+        stream=stream,
+        stream_options={"include_usage": True})
+
+
+def _text_response(model: str, rid: str, text: str,
+                   finish: str | None = None) -> pb.ModelInferResponse:
+    resp = pb.ModelInferResponse(model_name=model, id=rid)
+    out = resp.outputs.add()
+    out.name = "text_output"
+    out.datatype = "BYTES"
+    out.shape.append(1)
+    out.contents.bytes_contents.append(text.encode())
+    if finish:
+        resp.parameters["finish_reason"].string_param = finish
+    return resp
+
+
+class KServeService:
+    def __init__(self, manager):
+        self.manager = manager
+
+    # -- probes ---------------------------------------------------------------
+    async def server_live(self, request, context):
+        return pb.ServerLiveResponse(live=True)
+
+    async def server_ready(self, request, context):
+        return pb.ServerReadyResponse(ready=True)
+
+    async def model_ready(self, request, context):
+        return pb.ModelReadyResponse(
+            ready=self.manager.get(request.name) is not None)
+
+    async def model_metadata(self, request, context):
+        served = self.manager.get(request.name)
+        if served is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND,
+                                f"model {request.name!r} not found")
+        meta = pb.ModelMetadataResponse(name=request.name,
+                                        platform="dynamo-tpu")
+        inp = meta.inputs.add()
+        inp.name, inp.datatype = "text_input", "BYTES"
+        inp.shape.append(1)
+        out = meta.outputs.add()
+        out.name, out.datatype = "text_output", "BYTES"
+        out.shape.append(1)
+        return meta
+
+    # -- inference ------------------------------------------------------------
+    async def model_infer(self, request, context):
+        served = self.manager.get(request.model_name)
+        if served is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND,
+                                f"model {request.model_name!r} not found")
+        try:
+            chat_req = _chat_request(request.model_name, request, stream=False)
+        except ValueError as exc:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+        ctx = Context()
+        chunks = served.preprocessor.generate(chat_req, ctx)
+        full = await aggregate_chat_stream(chunks, 0)
+        msg = full["choices"][0]["message"]
+        return _text_response(request.model_name, request.id,
+                              msg.get("content") or "",
+                              full["choices"][0].get("finish_reason"))
+
+    async def model_stream_infer(self, request_iterator, context):
+        async for request in request_iterator:
+            served = self.manager.get(request.model_name)
+            if served is None:
+                yield pb.ModelStreamInferResponse(
+                    error_message=f"model {request.model_name!r} not found")
+                continue
+            try:
+                chat_req = _chat_request(request.model_name, request,
+                                         stream=True)
+            except ValueError as exc:
+                yield pb.ModelStreamInferResponse(error_message=str(exc))
+                continue
+            ctx = Context()
+            try:
+                async for chunk in served.preprocessor.generate(chat_req,
+                                                                ctx):
+                    for choice in chunk.get("choices", []):
+                        piece = choice.get("delta", {}).get("content")
+                        finish = choice.get("finish_reason")
+                        if piece or finish:
+                            yield pb.ModelStreamInferResponse(
+                                infer_response=_text_response(
+                                    request.model_name, request.id,
+                                    piece or "", finish))
+            except Exception as exc:  # noqa: BLE001 — ship to caller
+                log.exception("stream infer failed")
+                yield pb.ModelStreamInferResponse(
+                    error_message=f"{type(exc).__name__}: {exc}")
+
+
+def make_server(manager, host: str = "0.0.0.0",
+                port: int = 0) -> tuple[grpc.aio.Server, int]:
+    """Build (not yet started) grpc.aio server with the KServe service
+    registered via generic handlers."""
+    svc = KServeService(manager)
+    rpcs = {
+        "ServerLive": grpc.unary_unary_rpc_method_handler(
+            svc.server_live,
+            request_deserializer=pb.ServerLiveRequest.FromString,
+            response_serializer=pb.ServerLiveResponse.SerializeToString),
+        "ServerReady": grpc.unary_unary_rpc_method_handler(
+            svc.server_ready,
+            request_deserializer=pb.ServerReadyRequest.FromString,
+            response_serializer=pb.ServerReadyResponse.SerializeToString),
+        "ModelReady": grpc.unary_unary_rpc_method_handler(
+            svc.model_ready,
+            request_deserializer=pb.ModelReadyRequest.FromString,
+            response_serializer=pb.ModelReadyResponse.SerializeToString),
+        "ModelMetadata": grpc.unary_unary_rpc_method_handler(
+            svc.model_metadata,
+            request_deserializer=pb.ModelMetadataRequest.FromString,
+            response_serializer=pb.ModelMetadataResponse.SerializeToString),
+        "ModelInfer": grpc.unary_unary_rpc_method_handler(
+            svc.model_infer,
+            request_deserializer=pb.ModelInferRequest.FromString,
+            response_serializer=pb.ModelInferResponse.SerializeToString),
+        "ModelStreamInfer": grpc.stream_stream_rpc_method_handler(
+            svc.model_stream_infer,
+            request_deserializer=pb.ModelInferRequest.FromString,
+            response_serializer=(
+                pb.ModelStreamInferResponse.SerializeToString)),
+    }
+    server = grpc.aio.server()
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE, rpcs),))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    return server, bound

