@@ -98,6 +98,9 @@ class KvPushRouter(AsyncEngine):
                 self.sequences.add_request(
                     payload["worker_id"], payload["request_id"],
                     payload["blocks"], payload["prefill_tokens"])
+            elif kind == "mark":
+                self.sequences.mark_prefill_complete(
+                    payload["worker_id"], payload["request_id"])
             elif kind == "free":
                 self.sequences.free(payload["worker_id"], payload["request_id"])
 
@@ -152,10 +155,23 @@ class KvPushRouter(AsyncEngine):
             "kind": "add", "worker_id": worker_id, "request_id": request_id,
             "blocks": new_blocks, "prefill_tokens": prefill_tokens})
         req.estimated_prefix_hit_blocks = overlap
+        prefill_done = False
         try:
             stream = await self.client.generate(
                 req.to_wire(), context=context, instance_id=worker_id)
             async for item in stream:
+                if not prefill_done and isinstance(item, dict) \
+                        and item.get("token_ids"):
+                    # First token: the worker finished this request's
+                    # prefill — drop its outstanding-prefill load.
+                    prefill_done = True
+                    self.sequences.mark_prefill_complete(worker_id,
+                                                         request_id)
+                    # Fire-and-forget: replica sync must not add a
+                    # coordinator round trip to every request's TTFT.
+                    asyncio.ensure_future(self._publish_sync({
+                        "kind": "mark", "worker_id": worker_id,
+                        "request_id": request_id}))
                 yield item
         finally:
             self.sequences.free(worker_id, request_id)

@@ -88,8 +88,16 @@ class KvScheduler:
             overlap = overlaps.get(w, 0)
             potential_prefill = max(0, request_blocks - overlap)
             potential_active = self._predicted_blocks(w) + request_blocks
+            # Outstanding prefill work separately from decode residency
+            # (reference sequence.rs:225 + prefill_counter.rs): a worker
+            # still chewing through big prompts is a bad target even when
+            # its resident-block metrics look fine (they lag the publish
+            # interval, and under disaggregation prefill cost never shows
+            # up as local blocks at all).
+            pending_prefill = (self.sequences.prefill_tokens(w)
+                               / max(1, self.config.block_size))
             logit = (self.config.overlap_score_weight * potential_prefill
-                     + potential_active)
+                     + potential_active + pending_prefill)
             logits.append(logit)
         if self.config.temperature <= 0.0:
             best = min(range(len(workers)), key=lambda i: logits[i])

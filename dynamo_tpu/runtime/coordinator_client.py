@@ -24,11 +24,37 @@ class WatchStream:
     Reference: PrefixWatcher from kv_get_and_watch_prefix (etcd.rs:310)."""
 
     def __init__(self, client: "CoordinatorClient", watch_id: int,
-                 snapshot: list[dict]):
+                 snapshot: list[dict], prefix: str = ""):
         self._client = client
         self.watch_id = watch_id
         self.snapshot = snapshot
+        self.prefix = prefix
         self.events: asyncio.Queue[dict] = asyncio.Queue()
+        # Keys this watch has reported as present — lets a reconnect
+        # synthesize DELETE events for keys that vanished with the old
+        # coordinator (consumers like instance discovery only remove
+        # entries on deletes).
+        self.known_keys: set[str] = {item["k"] for item in snapshot}
+        # While a reconnect replays the snapshot, live events buffer here
+        # so a pre-replay put can't be overwritten by the older snapshot.
+        self.paused = False
+        self._buffer: list[dict] = []
+
+    def deliver(self, event: dict) -> None:
+        if event["event"] == "put":
+            self.known_keys.add(event["key"])
+        else:
+            self.known_keys.discard(event["key"])
+        if self.paused:
+            self._buffer.append(event)
+        else:
+            self.events.put_nowait(event)
+
+    def flush(self) -> None:
+        self.paused = False
+        for ev in self._buffer:
+            self.events.put_nowait(ev)
+        self._buffer.clear()
 
     def __aiter__(self) -> AsyncIterator[dict]:
         return self._iter()
@@ -48,9 +74,11 @@ class WatchStream:
 class Subscription:
     """A pub/sub subscription stream (reference: NATS subscribe)."""
 
-    def __init__(self, client: "CoordinatorClient", sub_id: int):
+    def __init__(self, client: "CoordinatorClient", sub_id: int,
+                 subject: str = ""):
         self._client = client
         self.sub_id = sub_id
+        self.subject = subject
         self.messages: asyncio.Queue[dict] = asyncio.Queue()
 
     def __aiter__(self) -> AsyncIterator[dict]:
@@ -79,12 +107,17 @@ class CoordinatorClient:
         self._subs: dict[int, Subscription] = {}
         self._reader_task: asyncio.Task | None = None
         self._keepalive_task: asyncio.Task | None = None
+        self._reconnect_task: asyncio.Task | None = None
         self._send_lock = asyncio.Lock()
         self.primary_lease_id: int | None = None
         self._lease_ttl_s = 10.0
         self._lease_recreated_callbacks: list = []
         self._regrant_lock = asyncio.Lock()
         self._closed = False
+        # False between a detected disconnect and a completed reconnect:
+        # _request fails fast instead of writing into a dead socket whose
+        # reply future nobody would ever resolve.
+        self._connected = True
 
     @classmethod
     async def connect(cls, host: str, port: int, lease_ttl_s: float = 10.0,
@@ -116,6 +149,8 @@ class CoordinatorClient:
         self._closed = True
         if self._keepalive_task:
             self._keepalive_task.cancel()
+        if self._reconnect_task:
+            self._reconnect_task.cancel()
         if revoke_lease and self.primary_lease_id is not None:
             try:
                 await self._request({"m": "lease_revoke", "lease": self.primary_lease_id})
@@ -141,18 +176,110 @@ class CoordinatorClient:
                 elif "w" in msg:
                     watch = self._watches.get(msg["w"])
                     if watch:
-                        watch.events.put_nowait(
+                        watch.deliver(
                             {"event": msg["ev"], "key": msg["k"], "value": msg.get("v")})
                 elif "s" in msg:
                     sub = self._subs.get(msg["s"])
                     if sub:
                         sub.messages.put_nowait(
                             {"subject": msg["subject"], "payload": msg["payload"]})
-        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+        except asyncio.CancelledError:
+            self._connected = False
             for fut in self._pending.values():
                 if not fut.done():
                     fut.set_exception(ConnectionError("coordinator connection lost"))
             self._pending.clear()
+        except Exception:  # noqa: BLE001 — ANY read failure is a disconnect
+            # (ConnectionError subclasses, plain OSError like ETIMEDOUT,
+            # or a corrupt-frame decode error).
+            self._connected = False
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("coordinator connection lost"))
+            self._pending.clear()
+            if not self._closed:
+                # Coordinator went away (restart/crash): reconnect in the
+                # background and rebuild this client's server-side state.
+                self._reconnect_task = asyncio.ensure_future(
+                    self._reconnect())
+
+    async def _reconnect(self, retry_delay: float = 0.25,
+                         max_delay: float = 5.0) -> None:
+        """Survive a coordinator restart: redial (forever, with capped
+        backoff, until closed), re-grant the primary lease, replay
+        registrations (lease-recreated callbacks), and re-establish every
+        live watch and subscription — synthesizing DELETE events for keys
+        that vanished with the old coordinator. Server-side queue contents
+        do not survive (stated posture: the coordinator is a restartable
+        but non-persistent control plane)."""
+        if self._keepalive_task:
+            self._keepalive_task.cancel()
+        log.warning("coordinator connection lost; reconnecting to %s:%d",
+                    self.host, self.port)
+        delay = retry_delay
+        while not self._closed:
+            try:
+                self._reader, self._writer = await asyncio.open_connection(
+                    self.host, self.port)
+                break
+            except OSError:
+                await asyncio.sleep(delay)
+                delay = min(max_delay, delay * 1.5)
+        if self._closed:
+            return
+        # Fail anything that slipped into the pending map while the old
+        # socket was dying, then open for business on the new one.
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(
+                    ConnectionError("coordinator connection lost"))
+        self._pending.clear()
+        self._reader_task = asyncio.create_task(self._read_loop())
+        self._connected = True
+        try:
+            self.primary_lease_id = await self.lease_grant(self._lease_ttl_s)
+            self._keepalive_task = asyncio.create_task(
+                self._keepalive_loop(self.primary_lease_id,
+                                     self._lease_ttl_s / 3))
+            # Re-establish watches first so replayed registrations (ours and
+            # other clients') flow into them as put events. Live events
+            # buffer while each watch's snapshot replays, so a fresh put
+            # can't be clobbered by the older snapshot value.
+            for watch in list(self._watches.values()):
+                watch.paused = True
+                result = await self._request(
+                    {"m": "watch", "k": watch.prefix, "wid": watch.watch_id})
+                new_keys = {item["k"] for item in result["snapshot"]}
+                for key in sorted(watch.known_keys - new_keys):
+                    watch.events.put_nowait(
+                        {"event": "delete", "key": key, "value": None})
+                for item in result["snapshot"]:
+                    watch.events.put_nowait(
+                        {"event": "put", "key": item["k"],
+                         "value": item["v"]})
+                watch.known_keys = new_keys
+                watch.flush()
+            for sub in list(self._subs.values()):
+                await self._request({"m": "subscribe", "subject": sub.subject,
+                                     "sid": sub.sub_id})
+            for cb in list(self._lease_recreated_callbacks):
+                try:
+                    await cb(self.primary_lease_id)
+                except Exception:  # noqa: BLE001
+                    log.exception("reconnect registration replay failed")
+            log.info("coordinator reconnected; state replayed "
+                     "(%d watches, %d subs, %d registrations)",
+                     len(self._watches), len(self._subs),
+                     len(self._lease_recreated_callbacks))
+        except Exception:  # noqa: BLE001
+            # Replay failed (server rejected or died again): force the read
+            # loop down so the disconnect path schedules a fresh reconnect
+            # — a half-replayed client must not linger looking healthy.
+            log.exception("reconnect state replay failed; forcing redial")
+            for watch in list(self._watches.values()):
+                watch.flush()
+            if self._writer is not None:
+                self._writer.close()
 
     def on_lease_recreated(self, callback) -> None:
         """Register an async callback invoked (with the new lease id) after the
@@ -166,6 +293,8 @@ class CoordinatorClient:
             try:
                 await self._request({"m": "lease_keepalive", "lease": lease_id})
             except ConnectionError:
+                # The read loop schedules the reconnect (which restarts a
+                # fresh keepalive task); this one just winds down.
                 log.warning("coordinator connection lost; keepalive stopped")
                 return
             except RuntimeError as exc:
@@ -202,7 +331,8 @@ class CoordinatorClient:
                     log.exception("lease-recreated callback failed")
 
     async def _request(self, msg: dict) -> Any:
-        if self._writer is None or self._writer.is_closing():
+        if (self._writer is None or self._writer.is_closing()
+                or not self._connected):
             raise ConnectionError("not connected")
         rid = next(self._ids)
         msg["i"] = rid
@@ -270,7 +400,7 @@ class CoordinatorClient:
         # Client allocates the watch id and registers the stream BEFORE the
         # request, so events racing the watch response are never dropped.
         wid = next(self._ids)
-        watch = WatchStream(self, wid, [])
+        watch = WatchStream(self, wid, [], prefix=prefix)
         self._watches[wid] = watch
         try:
             result = await self._request({"m": "watch", "k": prefix, "wid": wid})
@@ -278,6 +408,7 @@ class CoordinatorClient:
             self._watches.pop(wid, None)
             raise
         watch.snapshot = result["snapshot"]
+        watch.known_keys = {item["k"] for item in watch.snapshot}
         return watch
 
     # -- NATS-shaped API ------------------------------------------------------
@@ -286,7 +417,7 @@ class CoordinatorClient:
 
     async def subscribe(self, subject: str) -> Subscription:
         sid = next(self._ids)
-        sub = Subscription(self, sid)
+        sub = Subscription(self, sid, subject=subject)
         self._subs[sid] = sub
         try:
             await self._request({"m": "subscribe", "subject": subject, "sid": sid})

@@ -1,0 +1,152 @@
+"""Coordinator restart recovery (VERDICT r2 weak #10).
+
+Posture: the coordinator is a RESTARTABLE, NON-PERSISTENT control plane —
+all state (leases, keys, subscriptions) dies with the process, and every
+client is responsible for reconnecting and replaying its own
+registrations. This test kills the coordinator under a serving worker,
+starts a fresh one on the same port, and asserts the worker re-registers
+(instance + model card), a frontend-style watcher sees it again, and a
+request flows end to end afterwards.
+"""
+
+import asyncio
+import socket
+
+from conftest import async_test
+
+from dynamo_tpu.llm.engines import EchoEngine
+from dynamo_tpu.llm.model_card import MODEL_ROOT, register_llm
+from dynamo_tpu.llm.tokenizer import make_test_tokenizer
+from dynamo_tpu.llm.protocols import PreprocessedRequest
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.coordinator import Coordinator
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@async_test
+async def test_coordinator_restart_recovers_registrations():
+    port = _free_port()
+    coord = Coordinator("127.0.0.1", port)
+    await coord.start()
+    url = f"tcp://127.0.0.1:{port}"
+    rt = await DistributedRuntime.from_settings(
+        RuntimeConfig(coordinator_url=url, lease_ttl_s=1.0))
+    server = None
+    rt2 = None
+    try:
+        engine = EchoEngine()
+        ep = rt.namespace("test").component("echo").endpoint("generate")
+        server = await ep.serve_endpoint(engine.handler(),
+                                         graceful_shutdown=False)
+        await register_llm(rt, ep, "echo-model", make_test_tokenizer())
+        client0 = rt.require_coordinator()
+        assert await client0.kv_get_prefix("instances/")
+        assert await client0.kv_get_prefix(MODEL_ROOT)
+
+        # Kill the control plane; all server-side state is lost.
+        await coord.stop()
+        await asyncio.sleep(0.5)
+        coord2 = Coordinator("127.0.0.1", port)
+        await coord2.start()
+        try:
+            # The worker's client reconnects, re-grants its lease, and
+            # replays instance + model-card registrations.
+            inst = None
+            for _ in range(100):
+                try:
+                    inst = await client0.kv_get_prefix("instances/")
+                except ConnectionError:
+                    inst = None
+                if inst:
+                    break
+                await asyncio.sleep(0.1)
+            assert inst, "instance registration did not come back"
+            cards = await client0.kv_get_prefix(MODEL_ROOT)
+            assert cards, "model card did not come back"
+
+            # A fresh frontend-style runtime can discover and call it.
+            rt2 = await DistributedRuntime.from_settings(
+                RuntimeConfig(coordinator_url=url, lease_ttl_s=1.0))
+            c_ep = rt2.namespace("test").component("echo").endpoint("generate")
+            client = await c_ep.client()
+            await client.wait_for_instances(timeout=10)
+            req = PreprocessedRequest(model="echo-model",
+                                      token_ids=[1, 2, 3])
+            req.stop_conditions.max_tokens = 3
+            stream = await client.round_robin(req.to_wire())
+            toks = []
+            async for out in stream:
+                toks.extend(out.get("token_ids", []))
+                if out.get("finish_reason"):
+                    break
+            assert toks == [1, 2, 3]
+            await client.close()
+        finally:
+            await coord2.stop()
+    finally:
+        if rt2 is not None:
+            await rt2.close()
+        if server is not None:
+            await server.shutdown()
+        await rt.close()
+
+
+@async_test
+async def test_watch_survives_coordinator_restart():
+    """An existing prefix watch keeps delivering events after a restart
+    (re-established with the new coordinator; replayed snapshot arrives
+    as puts)."""
+    port = _free_port()
+    coord = Coordinator("127.0.0.1", port)
+    await coord.start()
+    url = f"tcp://127.0.0.1:{port}"
+    rt_w = await DistributedRuntime.from_settings(
+        RuntimeConfig(coordinator_url=url, lease_ttl_s=1.0))
+    rt_o = await DistributedRuntime.from_settings(
+        RuntimeConfig(coordinator_url=url, lease_ttl_s=1.0))
+    try:
+        watcher = rt_w.require_coordinator()
+        other = rt_o.require_coordinator()
+        watch = await watcher.watch_prefix("things/")
+        await other.kv_put("things/a", {"v": 1})
+        ev = await asyncio.wait_for(watch.events.get(), timeout=5)
+        assert ev["key"] == "things/a"
+
+        await coord.stop()
+        await asyncio.sleep(0.5)
+        coord2 = Coordinator("127.0.0.1", port)
+        await coord2.start()
+        try:
+            # Give both clients time to reconnect, then publish a new key
+            # from the other client; the old watch must see it.
+            for _ in range(100):
+                try:
+                    await other.kv_put("things/b", {"v": 2})
+                    break
+                except ConnectionError:
+                    await asyncio.sleep(0.1)
+            seen = {}
+            for _ in range(50):
+                try:
+                    ev = await asyncio.wait_for(watch.events.get(),
+                                                timeout=0.2)
+                    seen[ev["key"]] = ev["event"]
+                except asyncio.TimeoutError:
+                    pass
+                if "things/b" in seen:
+                    break
+            assert seen.get("things/b") == "put"
+            # things/a died with the old coordinator and nobody re-put it:
+            # the reconnect synthesizes its delete so consumers drop it.
+            assert seen.get("things/a") == "delete"
+        finally:
+            await coord2.stop()
+    finally:
+        await rt_w.close()
+        await rt_o.close()
