@@ -28,6 +28,9 @@ class ModelSpec:
     qkv_bias: bool = False  # Qwen2 style
     tie_word_embeddings: bool = False
     max_position_embeddings: int = 8192
+    # MoE (Mixtral family): num_experts == 0 means dense FFN.
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
 
     def __post_init__(self):
         if self.head_dim is None:
@@ -43,7 +46,10 @@ class ModelSpec:
         d = self.head_dim
         attn = h * (self.num_heads * d) + 2 * h * (self.num_kv_heads * d) \
             + (self.num_heads * d) * h
-        mlp = 3 * h * i
+        if self.num_experts:
+            mlp = self.num_experts * 3 * h * i + h * self.num_experts
+        else:
+            mlp = 3 * h * i
         per_layer = attn + mlp + 2 * h
         embed = v * h * (1 if self.tie_word_embeddings else 2)
         return self.num_layers * per_layer + embed + h
@@ -74,6 +80,8 @@ class ModelSpec:
             qkv_bias=cfg.get("model_type") == "qwen2",
             tie_word_embeddings=cfg.get("tie_word_embeddings", False),
             max_position_embeddings=cfg.get("max_position_embeddings", 8192),
+            num_experts=cfg.get("num_local_experts", 0),
+            num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
         )
 
 
@@ -130,9 +138,14 @@ class EngineConfig:
     # (measured on v5e: depth 1->8 at M=8 = 3.6K->10.1K tok/s at bs32;
     # docs/PERF_NOTES.md).
     pipeline_depth: int = 8
-    # Parallelism
+    # Parallelism: tp shards heads/FFN (and MoE experts), pp shards the
+    # stacked LAYER axis of parameters + KV cache across a "pp" mesh axis
+    # (layer-sharded memory distribution; XLA streams each layer's weights
+    # to where the activations are — microbatched true pipelining is a
+    # future optimization), dp replicates.
     tp: int = 1
     dp: int = 1
+    pp: int = 1
     # Numerics
     dtype: str = "bfloat16"
     # Attention backend: "auto" | "pallas" | "xla"

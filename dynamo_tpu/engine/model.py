@@ -30,20 +30,28 @@ def param_shapes(spec: ModelSpec) -> dict:
     h, d = spec.hidden_size, spec.head_dim
     nh, nkv, L = spec.num_heads, spec.num_kv_heads, spec.num_layers
     i = spec.intermediate_size
+    layers: dict = {
+        "input_norm": (L, h),
+        "post_attn_norm": (L, h),
+        "wq": (L, h, nh * d),
+        "wk": (L, h, nkv * d),
+        "wv": (L, h, nkv * d),
+        "wo": (L, nh * d, h),
+    }
+    if spec.num_experts:
+        E = spec.num_experts
+        layers["moe_gate"] = (L, h, E)
+        layers["moe_w_gate"] = (L, E, h, i)
+        layers["moe_w_up"] = (L, E, h, i)
+        layers["moe_w_down"] = (L, E, i, h)
+    else:
+        layers["w_gate"] = (L, h, i)
+        layers["w_up"] = (L, h, i)
+        layers["w_down"] = (L, i, h)
     shapes = {
         "embed": (spec.vocab_size, h),
         "final_norm": (h,),
-        "layers": {
-            "input_norm": (L, h),
-            "post_attn_norm": (L, h),
-            "wq": (L, h, nh * d),
-            "wk": (L, h, nkv * d),
-            "wv": (L, h, nkv * d),
-            "wo": (L, nh * d, h),
-            "w_gate": (L, h, i),
-            "w_up": (L, h, i),
-            "w_down": (L, i, h),
-        },
+        "layers": layers,
     }
     if spec.qkv_bias:
         shapes["layers"]["bq"] = (L, nh * d)
@@ -56,29 +64,77 @@ def param_shapes(spec: ModelSpec) -> dict:
 
 def param_specs(spec: ModelSpec) -> dict:
     """PartitionSpecs: column-parallel qkv/gate/up, row-parallel o/down
-    (Megatron layout — XLA adds the psum at row-parallel outputs)."""
+    (Megatron layout — XLA adds the psum at row-parallel outputs). The
+    stacked LAYER axis shards over "pp" (layer-sharded pipeline axis);
+    MoE expert weights shard their EXPERT axis over "tp" (expert
+    parallelism: each device computes its resident experts, XLA reduces
+    the combine)."""
+    layers: dict = {
+        "input_norm": P("pp", None),
+        "post_attn_norm": P("pp", None),
+        "wq": P("pp", None, "tp"),
+        "wk": P("pp", None, "tp"),
+        "wv": P("pp", None, "tp"),
+        "wo": P("pp", "tp", None),
+    }
+    if spec.num_experts:
+        layers["moe_gate"] = P("pp", None, None)
+        layers["moe_w_gate"] = P("pp", "tp", None, None)
+        layers["moe_w_up"] = P("pp", "tp", None, None)
+        layers["moe_w_down"] = P("pp", "tp", None, None)
+    else:
+        layers["w_gate"] = P("pp", None, "tp")
+        layers["w_up"] = P("pp", None, "tp")
+        layers["w_down"] = P("pp", "tp", None)
     specs = {
         "embed": P(None, "tp"),
         "final_norm": P(None),
-        "layers": {
-            "input_norm": P(None, None),
-            "post_attn_norm": P(None, None),
-            "wq": P(None, None, "tp"),
-            "wk": P(None, None, "tp"),
-            "wv": P(None, None, "tp"),
-            "wo": P(None, "tp", None),
-            "w_gate": P(None, None, "tp"),
-            "w_up": P(None, None, "tp"),
-            "w_down": P(None, "tp", None),
-        },
+        "layers": layers,
     }
     if spec.qkv_bias:
-        specs["layers"]["bq"] = P(None, "tp")
-        specs["layers"]["bk"] = P(None, "tp")
-        specs["layers"]["bv"] = P(None, "tp")
+        specs["layers"]["bq"] = P("pp", "tp")
+        specs["layers"]["bk"] = P("pp", "tp")
+        specs["layers"]["bv"] = P("pp", "tp")
     if not spec.tie_word_embeddings:
         specs["lm_head"] = P(None, "tp")
     return specs
+
+
+def ffn_block(h2: jax.Array, lp: dict, spec: ModelSpec) -> jax.Array:
+    """Feed-forward over normalized hidden states [..., H]: dense SwiGLU,
+    or Mixtral-style top-k MoE when spec.num_experts > 0.
+
+    MoE formulation (TPU-first): router top-k softmax gating; every
+    RESIDENT expert computes the whole token batch and the combine
+    contracts over the expert axis — with experts sharded over "tp" each
+    device runs E/tp experts and XLA inserts the psum, i.e. expert
+    parallelism without a dynamic all-to-all (serving batches are small;
+    capacity-based dispatch kernels are a future optimization)."""
+    if not spec.num_experts:
+        gate = jnp.einsum("...h,hi->...i", h2, lp["w_gate"],
+                          preferred_element_type=jnp.bfloat16)
+        up = jnp.einsum("...h,hi->...i", h2, lp["w_up"],
+                        preferred_element_type=jnp.bfloat16)
+        ff = jax.nn.silu(gate.astype(jnp.float32)).astype(jnp.bfloat16) * up
+        return jnp.einsum("...i,ih->...h", ff, lp["w_down"],
+                          preferred_element_type=jnp.bfloat16)
+    orig = h2.shape
+    x = h2.reshape(-1, orig[-1])                       # [T, H]
+    router = jnp.einsum("th,he->te", x, lp["moe_gate"],
+                        preferred_element_type=jnp.float32)
+    top_v, top_i = jax.lax.top_k(router, spec.num_experts_per_tok)
+    gates = jax.nn.softmax(top_v, axis=-1)             # Mixtral: over top-k
+    one_hot = jax.nn.one_hot(top_i, spec.num_experts, dtype=jnp.float32)
+    w_te = jnp.einsum("tk,tke->te", gates, one_hot)    # [T, E] sparse-ish
+    gate = jnp.einsum("th,ehi->eti", x, lp["moe_w_gate"],
+                      preferred_element_type=jnp.bfloat16)
+    up = jnp.einsum("th,ehi->eti", x, lp["moe_w_up"],
+                    preferred_element_type=jnp.bfloat16)
+    ff = jax.nn.silu(gate.astype(jnp.float32)).astype(jnp.bfloat16) * up
+    down = jnp.einsum("eti,eih->eth", ff, lp["moe_w_down"],
+                      preferred_element_type=jnp.float32)
+    out = jnp.einsum("eth,te->th", down, w_te)
+    return out.astype(jnp.bfloat16).reshape(orig)
 
 
 def init_params(spec: ModelSpec, key: jax.Array, dtype=jnp.bfloat16) -> Params:
@@ -284,13 +340,7 @@ def prefill_forward(params: Params, spec: ModelSpec,
         x = x + jnp.einsum("bsd,dh->bsh", attn, lp["wo"],
                            preferred_element_type=jnp.bfloat16)
         h2 = rms_norm(x, lp["post_attn_norm"], spec.rms_norm_eps)
-        gate = jnp.einsum("bsh,hi->bsi", h2, lp["w_gate"],
-                          preferred_element_type=jnp.bfloat16)
-        up = jnp.einsum("bsh,hi->bsi", h2, lp["w_up"],
-                        preferred_element_type=jnp.bfloat16)
-        ff = jax.nn.silu(gate.astype(jnp.float32)).astype(jnp.bfloat16) * up
-        x = x + jnp.einsum("bsi,ih->bsh", ff, lp["w_down"],
-                           preferred_element_type=jnp.bfloat16)
+        x = x + ffn_block(h2, lp, spec)
         return x, (k, v)
 
     # Cache writes are deferred out of the scan (ys are fresh allocations —
@@ -375,9 +425,7 @@ def decode_forward(params: Params, spec: ModelSpec,
         attn = attn.reshape(b, -1)
         x = x + attn @ lp["wo"]
         h2 = rms_norm(x, lp["post_attn_norm"], spec.rms_norm_eps)
-        ff = (jax.nn.silu((h2 @ lp["w_gate"]).astype(jnp.float32))
-              .astype(jnp.bfloat16) * (h2 @ lp["w_up"]))
-        x = x + ff @ lp["w_down"]
+        x = x + ffn_block(h2, lp, spec)
         return x, (k, v)
 
     x, (k_new, v_new) = jax.lax.scan(
@@ -433,14 +481,7 @@ def embed_forward(params: Params, spec: ModelSpec, tokens: jax.Array,
         x = x + jnp.einsum("bsd,dh->bsh", attn.reshape(b, s, -1), lp["wo"],
                            preferred_element_type=jnp.bfloat16)
         h2 = rms_norm(x, lp["post_attn_norm"], spec.rms_norm_eps)
-        ff = (jax.nn.silu(jnp.einsum(
-            "bsh,hi->bsi", h2, lp["w_gate"],
-            preferred_element_type=jnp.bfloat16).astype(jnp.float32))
-            .astype(jnp.bfloat16)
-            * jnp.einsum("bsh,hi->bsi", h2, lp["w_up"],
-                         preferred_element_type=jnp.bfloat16))
-        x = x + jnp.einsum("bsi,ih->bsh", ff, lp["w_down"],
-                           preferred_element_type=jnp.bfloat16)
+        x = x + ffn_block(h2, lp, spec)
         return x, ()
 
     x, _ = jax.lax.scan(layer_fn, x, params["layers"])
@@ -498,9 +539,7 @@ def decode_window_step(params: Params, spec: ModelSpec,
         attn = attn.reshape(b, -1)
         x = x + attn @ lp["wo"]
         h2 = rms_norm(x, lp["post_attn_norm"], spec.rms_norm_eps)
-        ff = (jax.nn.silu((h2 @ lp["w_gate"]).astype(jnp.float32))
-              .astype(jnp.bfloat16) * (h2 @ lp["w_up"]))
-        x = x + ff @ lp["w_down"]
+        x = x + ffn_block(h2, lp, spec)
         return x, (k, v)
 
     x, (k_new, v_new) = jax.lax.scan(

@@ -120,13 +120,23 @@ class ModelRunner:
                     f"num_kv_heads={spec.num_kv_heads} not divisible by "
                     f"tp={config.tp}")
             self.kv_rep = 1
+        if spec.num_layers % config.pp != 0:
+            raise ValueError(
+                f"num_layers={spec.num_layers} not divisible by "
+                f"pp={config.pp}")
+        if spec.num_experts and spec.num_experts % config.tp != 0:
+            raise ValueError(
+                f"num_experts={spec.num_experts} not divisible by "
+                f"tp={config.tp} (expert parallelism shards experts "
+                f"over tp)")
         self.spec = spec
         devices = devices if devices is not None else jax.devices()
-        total = config.dp * config.tp
+        total = config.dp * config.pp * config.tp
         if len(devices) < total:
             raise ValueError(f"need {total} devices, have {len(devices)}")
-        dev_array = np.array(devices[:total]).reshape(config.dp, config.tp)
-        self.mesh = Mesh(dev_array, ("dp", "tp"))
+        dev_array = np.array(devices[:total]).reshape(
+            config.dp, config.pp, config.tp)
+        self.mesh = Mesh(dev_array, ("dp", "pp", "tp"))
         self._sized_pages(devices[0])
 
         # Shard or init parameters.
@@ -145,9 +155,10 @@ class ModelRunner:
                                          self.kv_rep)
         self.params = jax.device_put(params, shardings)
 
-        # KV cache arrays [L, Nkv, P, page, D]: kv heads sharded over tp, and
+        # KV cache arrays [L, Nkv, P, page, D]: layers sharded over pp
+        # (pages live with their layer's stage), kv heads over tp, and
         # [page, D] contiguous per (head, page) for clean Pallas DMAs.
-        kv_spec = P(None, "tp", None, None, None)
+        kv_spec = P("pp", "tp", None, None, None)
         self.kv_sharding = NamedSharding(self.mesh, kv_spec)
         kv_shape = (spec.num_layers, spec.num_kv_heads, self.num_pages,
                     config.page_size, spec.head_dim)
@@ -177,10 +188,12 @@ class ModelRunner:
             free = stats["bytes_limit"] - stats["bytes_in_use"]
         except Exception:  # noqa: BLE001 — CPU tests have no memory_stats
             free = 2 << 30
-        param_bytes = self.spec.num_params() * 2 // max(1, cfg.tp * cfg.dp)
+        # Params shard over tp and pp only (dp replicates them).
+        param_bytes = self.spec.num_params() * 2 // max(1, cfg.tp * cfg.pp)
         budget = max(64 << 20, int((free - param_bytes) * cfg.hbm_kv_budget_frac))
+        # The cache shards over tp (heads) AND pp (layers).
         page_bytes = (self.spec.kv_bytes_per_token() * cfg.page_size
-                      // max(1, cfg.tp))
+                      // max(1, cfg.tp * cfg.pp))
         self.num_pages = max(16, budget // max(1, page_bytes))
         log.info("KV pool: %d pages of %d tokens (%.1f GiB)", self.num_pages,
                  cfg.page_size, self.num_pages * page_bytes / (1 << 30))
@@ -674,7 +687,7 @@ def _prefill_with_history(params, spec, k_cache, v_cache, tokens, positions,
     import jax
     import jax.numpy as jnp
     from dynamo_tpu.engine.model import (
-        _split_heads, apply_rope, rms_norm, rope_tables)
+        _split_heads, apply_rope, ffn_block, rms_norm, rope_tables)
 
     b, s = tokens.shape
     d = spec.head_dim
@@ -735,13 +748,7 @@ def _prefill_with_history(params, spec, k_cache, v_cache, tokens, positions,
         x = x + jnp.einsum("bsd,dh->bsh", attn, lp["wo"],
                            preferred_element_type=jnp.bfloat16)
         h2 = rms_norm(x, lp["post_attn_norm"], spec.rms_norm_eps)
-        gate = jnp.einsum("bsh,hi->bsi", h2, lp["w_gate"],
-                          preferred_element_type=jnp.bfloat16)
-        up = jnp.einsum("bsh,hi->bsi", h2, lp["w_up"],
-                        preferred_element_type=jnp.bfloat16)
-        ff = jax.nn.silu(gate.astype(jnp.float32)).astype(jnp.bfloat16) * up
-        x = x + jnp.einsum("bsi,ih->bsh", ff, lp["w_down"],
-                           preferred_element_type=jnp.bfloat16)
+        x = x + ffn_block(h2, lp, spec)
         return x, (k, v)
 
     x, (k_new, v_new) = jax.lax.scan(

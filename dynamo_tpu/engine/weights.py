@@ -43,9 +43,12 @@ def load_hf_weights(spec: ModelSpec, model_dir: str):
         return tensors[name].astype(bf16)
 
     L = spec.num_layers
-    layers: dict[str, list] = {k: [] for k in (
-        "input_norm", "post_attn_norm", "wq", "wk", "wv", "wo",
-        "w_gate", "w_up", "w_down")}
+    names = ["input_norm", "post_attn_norm", "wq", "wk", "wv", "wo"]
+    if spec.num_experts:
+        names += ["moe_gate", "moe_w_gate", "moe_w_up", "moe_w_down"]
+    else:
+        names += ["w_gate", "w_up", "w_down"]
+    layers: dict[str, list] = {k: [] for k in names}
     if spec.qkv_bias:
         for k in ("bq", "bk", "bv"):
             layers[k] = []
@@ -59,9 +62,24 @@ def load_hf_weights(spec: ModelSpec, model_dir: str):
         layers["wk"].append(get(p + "self_attn.k_proj.weight").T)
         layers["wv"].append(get(p + "self_attn.v_proj.weight").T)
         layers["wo"].append(get(p + "self_attn.o_proj.weight").T)
-        layers["w_gate"].append(get(p + "mlp.gate_proj.weight").T)
-        layers["w_up"].append(get(p + "mlp.up_proj.weight").T)
-        layers["w_down"].append(get(p + "mlp.down_proj.weight").T)
+        if spec.num_experts:
+            # Mixtral: block_sparse_moe.gate + experts.N.{w1,w3,w2} =
+            # (gate_proj, up_proj, down_proj).
+            m = p + "block_sparse_moe."
+            layers["moe_gate"].append(get(m + "gate.weight").T)
+            layers["moe_w_gate"].append(np.stack(
+                [get(f"{m}experts.{e}.w1.weight").T
+                 for e in range(spec.num_experts)]))
+            layers["moe_w_up"].append(np.stack(
+                [get(f"{m}experts.{e}.w3.weight").T
+                 for e in range(spec.num_experts)]))
+            layers["moe_w_down"].append(np.stack(
+                [get(f"{m}experts.{e}.w2.weight").T
+                 for e in range(spec.num_experts)]))
+        else:
+            layers["w_gate"].append(get(p + "mlp.gate_proj.weight").T)
+            layers["w_up"].append(get(p + "mlp.up_proj.weight").T)
+            layers["w_down"].append(get(p + "mlp.down_proj.weight").T)
         if spec.qkv_bias:
             layers["bq"].append(get(p + "self_attn.q_proj.bias"))
             layers["bk"].append(get(p + "self_attn.k_proj.bias"))

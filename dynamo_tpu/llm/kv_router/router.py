@@ -46,6 +46,7 @@ class KvPushRouter(AsyncEngine):
         self.scheduler = KvScheduler(config, self.sequences)
         self.replica_id = uuid.uuid4().hex[:8]
         self._tasks: list[asyncio.Task] = []
+        self._bg_tasks: set[asyncio.Task] = set()
         self._subs = []
 
     async def start(self) -> None:
@@ -168,10 +169,13 @@ class KvPushRouter(AsyncEngine):
                     self.sequences.mark_prefill_complete(worker_id,
                                                          request_id)
                     # Fire-and-forget: replica sync must not add a
-                    # coordinator round trip to every request's TTFT.
-                    asyncio.ensure_future(self._publish_sync({
+                    # coordinator round trip to every request's TTFT. Hold
+                    # a reference (the loop keeps tasks only weakly).
+                    t = asyncio.ensure_future(self._publish_sync({
                         "kind": "mark", "worker_id": worker_id,
                         "request_id": request_id}))
+                    self._bg_tasks.add(t)
+                    t.add_done_callback(self._bg_tasks.discard)
                 yield item
         finally:
             self.sequences.free(worker_id, request_id)

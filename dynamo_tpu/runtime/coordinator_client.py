@@ -53,6 +53,12 @@ class WatchStream:
     def flush(self) -> None:
         self.paused = False
         for ev in self._buffer:
+            # Re-apply to known_keys: a reconnect replay overwrites the
+            # set from its snapshot, which predates these buffered events.
+            if ev["event"] == "put":
+                self.known_keys.add(ev["key"])
+            else:
+                self.known_keys.discard(ev["key"])
             self.events.put_nowait(ev)
         self._buffer.clear()
 
