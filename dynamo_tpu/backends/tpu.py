@@ -49,6 +49,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument("--max-pages-per-seq", type=int, default=512)
     parser.add_argument("--tp", type=int, default=1)
     parser.add_argument("--dp", type=int, default=1)
+    parser.add_argument("--pp", type=int, default=1,
+                        help="layer-sharded pipeline axis")
+    parser.add_argument("--sp", type=int, default=1,
+                        help="sequence (context) parallelism for prefill")
     parser.add_argument("--attention-backend", default="auto",
                         choices=["auto", "pallas", "xla"])
     parser.add_argument("--host-cache-pages", type=int, default=0,
@@ -92,7 +96,9 @@ def build_engine_config(args) -> EngineConfig:
     return EngineConfig(
         model=spec, page_size=args.page_size, num_pages=args.num_pages,
         max_num_seqs=args.max_num_seqs, max_pages_per_seq=args.max_pages_per_seq,
-        tp=args.tp, dp=args.dp, attention_backend=args.attention_backend,
+        tp=args.tp, dp=args.dp, pp=getattr(args, "pp", 1),
+        sp=getattr(args, "sp", 1),
+        attention_backend=args.attention_backend,
         host_cache_pages=args.host_cache_pages,
         kv_disk_cache_dir=args.kv_disk_cache_dir)
 

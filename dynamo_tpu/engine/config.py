@@ -143,9 +143,16 @@ class EngineConfig:
     # (layer-sharded memory distribution; XLA streams each layer's weights
     # to where the activations are — microbatched true pipelining is a
     # future optimization), dp replicates.
+    # sp shards the SEQUENCE axis of prefill activations/attention over a
+    # mesh axis (all-to-all context parallelism via GSPMD: Q stays
+    # sequence-sharded, XLA gathers K/V — the quadratic score term is
+    # sp-sharded, which is what makes long-context prefill fit; a ring
+    # attention kernel is the bandwidth optimization path). Decode is
+    # unaffected (one token per step).
     tp: int = 1
     dp: int = 1
     pp: int = 1
+    sp: int = 1
     # Numerics
     dtype: str = "bfloat16"
     # Attention backend: "auto" | "pallas" | "xla"

@@ -304,17 +304,26 @@ def prefill_forward(params: Params, spec: ModelSpec,
                     k_cache: jax.Array, v_cache: jax.Array,
                     tokens: jax.Array, positions: jax.Array,
                     page_table: jax.Array, seq_lens: jax.Array,
+                    sp_shard: bool = False,
                     ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Process prompt chunks and write K/V into pages.
 
     tokens/positions [B,S] (S = bucket, multiple of page_size), page_table
     [B, S//page_size] (pages covering THIS chunk), seq_lens [B] (valid token
-    counts). Returns (last_token_logits [B,V], k_cache, v_cache).
+    counts). With sp_shard (requires tracing under the runner's mesh), the
+    SEQUENCE axis of activations is sharded over the "sp" mesh axis —
+    all-to-all context parallelism: queries stay sequence-sharded, XLA
+    gathers K/V, and the quadratic score tensor is sp-sharded, which is
+    what lets long-context prefill fit (SURVEY §5.7; ring attention is the
+    bandwidth optimization path). Returns (last_token_logits [B,V],
+    k_cache, v_cache).
     """
     b, s = tokens.shape
     d = spec.head_dim
     page = k_cache.shape[3]
     x = params["embed"][tokens].astype(jnp.bfloat16)  # [B,S,H]
+    if sp_shard:
+        x = jax.lax.with_sharding_constraint(x, P(None, "sp", None))
     cos, sin = rope_tables(positions, d, spec.rope_theta)
     valid = jnp.arange(s)[None, :] < seq_lens[:, None]
 

@@ -129,14 +129,19 @@ class ModelRunner:
                 f"num_experts={spec.num_experts} not divisible by "
                 f"tp={config.tp} (expert parallelism shards experts "
                 f"over tp)")
+        if config.sp > 1 and any(b % config.sp != 0
+                                 for b in config.prefill_buckets):
+            raise ValueError(
+                f"sp={config.sp}: every prefill bucket "
+                f"({config.prefill_buckets}) must be divisible by sp")
         self.spec = spec
         devices = devices if devices is not None else jax.devices()
-        total = config.dp * config.pp * config.tp
+        total = config.dp * config.pp * config.sp * config.tp
         if len(devices) < total:
             raise ValueError(f"need {total} devices, have {len(devices)}")
         dev_array = np.array(devices[:total]).reshape(
-            config.dp, config.pp, config.tp)
-        self.mesh = Mesh(dev_array, ("dp", "pp", "tp"))
+            config.dp, config.pp, config.sp, config.tp)
+        self.mesh = Mesh(dev_array, ("dp", "pp", "sp", "tp"))
         self._sized_pages(devices[0])
 
         # Shard or init parameters.
@@ -264,15 +269,16 @@ class ModelRunner:
                 jnp.arange(bucket)[None, :],
                 jnp.maximum(n - 1, 0)[:, None])
             seq_lens = n
+            sp_shard = self.config.sp > 1
             if with_history:
                 logits, k_cache, v_cache = _prefill_with_history(
                     params, spec, k_cache, v_cache, tokens, positions,
                     page_table, seq_lens, hist_table, hist_lens,
-                    self._attention_impl)
+                    self._attention_impl, sp_shard=sp_shard)
             else:
                 logits, k_cache, v_cache = prefill_forward(
                     params, spec, k_cache, v_cache, tokens, positions,
-                    page_table, seq_lens)
+                    page_table, seq_lens, sp_shard=sp_shard)
             rng, sub = jax.random.split(rng)
             sampled = sample_tokens(logits, temp, top_k, top_p, sub)
             B = sampled.shape[0]
@@ -681,7 +687,7 @@ def _replicate_kv_heads(params, spec, rep: int):
 
 def _prefill_with_history(params, spec, k_cache, v_cache, tokens, positions,
                           page_table, seq_lens, hist_table, hist_lens,
-                          attention_impl):
+                          attention_impl, sp_shard: bool = False):
     """Chunked prefill: like prefill_forward but queries also attend to the
     sequence's earlier pages (read via the paged path)."""
     import jax
@@ -695,6 +701,8 @@ def _prefill_with_history(params, spec, k_cache, v_cache, tokens, positions,
     page = k_cache.shape[3]
     L = spec.num_layers
     x = params["embed"][tokens].astype(jnp.bfloat16)
+    if sp_shard:
+        x = jax.lax.with_sharding_constraint(x, P(None, "sp", None))
     cos, sin = rope_tables(positions, d, spec.rope_theta)
     valid = jnp.arange(s)[None, :] < seq_lens[:, None]
     maxp = hist_table.shape[1]

@@ -59,6 +59,8 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument("--max-pages-per-seq", type=int, default=512)
     parser.add_argument("--tp", type=int, default=1)
     parser.add_argument("--dp", type=int, default=1)
+    parser.add_argument("--pp", type=int, default=1)
+    parser.add_argument("--sp", type=int, default=1)
     parser.add_argument("--attention-backend", default="auto",
                         choices=["auto", "pallas", "xla"])
     parser.add_argument("--host-cache-pages", type=int, default=0)
