@@ -82,6 +82,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument("--prefill-component", default=None,
                         help="component name prefill workers serve under "
                              "(default: 'prefill')")
+    parser.add_argument("--num-nodes", type=int, default=1,
+                        help="hosts in this worker group; >1 gates serving "
+                             "on a leader/worker barrier (rank 0 leads) so "
+                             "all replicas agree on model + mesh shape "
+                             "before any serves")
+    parser.add_argument("--node-rank", type=int, default=0)
     return parser.parse_args(argv)
 
 
@@ -132,6 +138,40 @@ async def run(args: argparse.Namespace) -> None:
             return TPUEngine(engine_cfg, params=params, kv_publisher=kv_pub,
                              metrics_publisher=metrics_pub)
 
+        if args.num_nodes > 1:
+            # Multi-node worker GROUP: each host runs its own single-host
+            # mesh (a dp-style replica set) and the leader/worker barrier
+            # coordinates bring-up — every host must agree on the model +
+            # mesh shape before any of them starts serving (reference
+            # multi-node bootstrap, leader_worker_barrier.rs). A SINGLE
+            # engine spanning hosts (one jax.distributed mesh) needs an
+            # SPMD follower driver that replays the leader's dispatch
+            # sequence on every process; refuse rather than hang on the
+            # first cross-host collective.
+            if os.environ.get("JAX_COORDINATOR_ADDRESS"):
+                raise SystemExit(
+                    "multi-host SINGLE-engine execution (jax.distributed "
+                    "mesh) requires the SPMD follower driver, which is not "
+                    "implemented; unset JAX_COORDINATOR_ADDRESS and use "
+                    "--num-nodes for a coordinated per-host replica group")
+            from dynamo_tpu.runtime.barrier import (LeaderBarrier,
+                                                    WorkerBarrier)
+            client = runtime.require_coordinator()
+            bid = f"engine-{model_name}"
+            shape = {"model": model_name, "tp": args.tp, "pp": args.pp,
+                     "sp": args.sp, "dp": args.dp}
+            if args.node_rank == 0:
+                peers = await LeaderBarrier(
+                    client, bid, args.num_nodes - 1).sync(shape)
+                log.info("multi-node group assembled: leader + %d peers",
+                         len(peers))
+            else:
+                leader = await WorkerBarrier(
+                    client, bid, str(args.node_rank)).sync(shape)
+                if leader != shape:
+                    raise SystemExit(
+                        f"node {args.node_rank} config {shape} does not "
+                        f"match leader {leader}")
         # Engine construction blocks for seconds (weight load + sharded
         # device_put + first compiles); run it off the event loop so the
         # coordinator lease keepalives keep flowing.

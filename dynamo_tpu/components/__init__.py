@@ -1,0 +1,2 @@
+"""Standalone service components (reference components/: planner lives in
+dynamo_tpu.planner; the metrics aggregator here)."""

@@ -1,0 +1,174 @@
+"""Metrics aggregator component.
+
+Capability parity with reference components/metrics: subscribes to the
+workers' ForwardPassMetrics pub/sub plane for one or more components and
+exposes the fleet view as Prometheus gauges (per-worker and aggregate) on
+an HTTP endpoint — the scrape target Grafana/planner dashboards read.
+
+Run: ``python -m dynamo_tpu.components.metrics --components tpu,prefill
+--port 9091``
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from aiohttp import web
+
+from dynamo_tpu.llm.kv_router.protocols import (ForwardPassMetrics,
+                                                load_metrics_subject)
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("metrics_aggregator")
+
+
+class MetricsAggregator:
+    def __init__(self, runtime, namespace: str, components: list[str],
+                 stale_s: float = 30.0):
+        self._runtime = runtime
+        self.namespace = namespace
+        self.components = components
+        self.stale_s = stale_s
+        self._subs: list = []
+        self._tasks: list[asyncio.Task] = []
+        # (component, worker) -> (last_update_monotonic, metrics)
+        self._last: dict[tuple[str, str], tuple[float, ForwardPassMetrics]] \
+            = {}
+        m = runtime.metrics.namespace(namespace)
+        self._g_fleet_active = m.gauge(
+            "fleet_active_slots", "Active slots across live workers",
+            ["component"])
+        self._g_fleet_waiting = m.gauge(
+            "fleet_waiting_requests", "Queued requests across live workers",
+            ["component"])
+        self._g_fleet_workers = m.gauge(
+            "fleet_live_workers", "Workers reporting within the staleness "
+            "window", ["component"])
+        self._g_active = m.gauge(
+            "worker_active_slots", "Active request slots per worker",
+            ["component", "worker"])
+        self._g_waiting = m.gauge(
+            "worker_waiting_requests", "Queued requests per worker",
+            ["component", "worker"])
+        self._g_kv = m.gauge(
+            "worker_kv_usage", "KV pool usage fraction per worker",
+            ["component", "worker"])
+        self._g_hit = m.gauge(
+            "worker_prefix_hit_rate", "Prefix cache hit rate per worker",
+            ["component", "worker"])
+
+    async def start(self) -> None:
+        client = self._runtime.require_coordinator()
+        for comp in self.components:
+            sub = await client.subscribe(
+                load_metrics_subject(self.namespace, comp))
+            self._subs.append(sub)
+            self._tasks.append(asyncio.create_task(self._intake(comp, sub)))
+        self._tasks.append(asyncio.create_task(self._reap_loop()))
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for s in self._subs:
+            await s.cancel()
+
+    async def _intake(self, comp: str, sub) -> None:
+        async for msg in sub:
+            try:
+                m = ForwardPassMetrics.from_wire(msg["payload"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            worker = f"{m.worker_id or 0:x}"
+            self._last[(comp, worker)] = (asyncio.get_running_loop().time(),
+                                          m)
+            ws, ks = m.worker_stats, m.kv_stats
+            self._g_active.set(ws.request_active_slots, component=comp,
+                               worker=worker)
+            self._g_waiting.set(ws.num_requests_waiting, component=comp,
+                                worker=worker)
+            self._g_kv.set(ks.gpu_cache_usage_perc, component=comp,
+                           worker=worker)
+            self._g_hit.set(ks.gpu_prefix_cache_hit_rate, component=comp,
+                            worker=worker)
+            self._refresh_fleet()
+
+    def _refresh_fleet(self) -> None:
+        """Fleet totals over non-stale workers; stale workers' per-worker
+        series are zeroed so a dead worker's last load doesn't haunt
+        dashboards forever."""
+        now = asyncio.get_running_loop().time()
+        totals: dict[str, list[int]] = {c: [0, 0, 0] for c in self.components}
+        for (comp, worker), (t, m) in list(self._last.items()):
+            if now - t > self.stale_s:
+                self._g_active.set(0, component=comp, worker=worker)
+                self._g_waiting.set(0, component=comp, worker=worker)
+                self._g_kv.set(0, component=comp, worker=worker)
+                del self._last[(comp, worker)]
+                continue
+            tot = totals.setdefault(comp, [0, 0, 0])
+            tot[0] += m.worker_stats.request_active_slots
+            tot[1] += m.worker_stats.num_requests_waiting
+            tot[2] += 1
+        for comp, (active, waiting, n) in totals.items():
+            self._g_fleet_active.set(active, component=comp)
+            self._g_fleet_waiting.set(waiting, component=comp)
+            self._g_fleet_workers.set(n, component=comp)
+
+    async def _reap_loop(self) -> None:
+        while True:
+            await asyncio.sleep(max(1.0, self.stale_s / 3))
+            self._refresh_fleet()
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description="dynamo-tpu metrics aggregator")
+    p.add_argument("--namespace", default=None)
+    p.add_argument("--components", default="tpu",
+                   help="comma-separated worker components to aggregate")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=9091)
+    p.add_argument("--coordinator-url", default=None)
+    return p.parse_args(argv)
+
+
+async def run(args) -> None:
+    cfg = RuntimeConfig.from_settings()
+    if args.coordinator_url:
+        cfg.coordinator_url = args.coordinator_url
+    if args.namespace:
+        cfg.namespace = args.namespace
+    runtime = await DistributedRuntime.from_settings(cfg)
+    agg = MetricsAggregator(runtime, cfg.namespace,
+                            [c.strip() for c in args.components.split(",")
+                             if c.strip()])
+    await agg.start()
+
+    async def metrics_route(_req):
+        return web.Response(body=runtime.metrics.expose(),
+                            content_type="text/plain")
+
+    app = web.Application()
+    app.router.add_get("/metrics", metrics_route)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, args.host, args.port)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+    print(f"METRICS_AGGREGATOR_READY port={port}", flush=True)
+    try:
+        await runtime.wait_for_shutdown()
+    finally:
+        await agg.stop()
+        await runner.cleanup()
+        await runtime.close()
+
+
+def main() -> None:
+    asyncio.run(run(parse_args()))
+
+
+if __name__ == "__main__":
+    main()

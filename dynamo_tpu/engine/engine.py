@@ -291,8 +291,22 @@ class TPUEngine(AsyncEngine):
             lambda: self.runner.embed(token_lists, pooling))
         return [row.tolist() for row in out]
 
+    async def clear_kv_blocks(self) -> int:
+        """Admin: drop the reusable (inactive) prefix cache; host tiers
+        flush too. Returns pages freed in HBM."""
+        def job():
+            n = self.allocator.clear_inactive()
+            if self.host_cache is not None:
+                self.host_cache.clear()
+            return n
+        return await self.run_job(job)
+
     def handler(self):
         async def handle(request, context):
+            if isinstance(request, dict) and request.get("clear_kv_blocks"):
+                freed = await self.clear_kv_blocks()
+                yield {"cleared": freed}
+                return
             if isinstance(request, dict) and request.get("embed"):
                 vectors = await self.embed(request["token_lists"],
                                            request.get("pooling", "last"))

@@ -167,6 +167,20 @@ class PageAllocator:
             else:
                 self.inactive[h] = page
 
+    def clear_inactive(self) -> int:
+        """Drop every INACTIVE prefix-cache registration (pages held by
+        live sequences are untouched) — the reference's clear_kv_blocks
+        admin operation. Returns the number of pages freed."""
+        n = 0
+        for h, page in list(self.inactive.items()):
+            del self.inactive[h]
+            self.cached.pop(h, None)
+            self.cached_by_page.pop(page, None)
+            self.removed_events.append(h)
+            self.free.append(page)
+            n += 1
+        return n
+
     def drain_events(self) -> tuple[list[int], list[int]]:
         stored, self.stored_events = self.stored_events, []
         removed, self.removed_events = self.removed_events, []

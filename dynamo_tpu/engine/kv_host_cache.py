@@ -132,6 +132,18 @@ class HostKVCache:
         self.misses += 1
         return None
 
+    def clear(self) -> None:
+        """Drop every tier (admin clear_kv_blocks): G2 memory and the G3
+        disk files behind it."""
+        self._blocks.clear()
+        if self.disk is not None:
+            for h, path in list(self.disk._index.items()):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+            self.disk._index.clear()
+
     def stats(self) -> dict:
         out = {"g2_blocks": len(self._blocks), "g2_hits": self.hits,
                "g2_misses": self.misses, "g2_spills_in": self.spills_in,

@@ -105,6 +105,9 @@ def make_prefill_handler(engine):
     (handlers.py:195-199)."""
 
     async def handle(request, context: Context) -> AsyncIterator[dict]:
+        if isinstance(request, dict) and request.get("clear_kv_blocks"):
+            yield {"cleared": await engine.clear_kv_blocks()}
+            return
         req = (request if isinstance(request, PreprocessedRequest)
                else PreprocessedRequest.from_wire(request))
         first_token, kv, prompt_len = await engine.run_job(
@@ -137,6 +140,22 @@ class DisaggDecodeHandler:
 
     def handler(self):
         async def handle(request, context):
+            if isinstance(request, dict) and request.get("clear_kv_blocks"):
+                # Clear our own pool AND fan out to the prefill workers
+                # this decode worker fronts (the frontend only discovers
+                # decode endpoints).
+                freed = await self.engine.clear_kv_blocks()
+                for iid in self.prefill_client.instance_ids():
+                    try:
+                        stream = await self.prefill_client.direct(
+                            {"clear_kv_blocks": True}, iid)
+                        async for item in stream:
+                            freed += item.get("cleared", 0)
+                    except Exception:  # noqa: BLE001 — best-effort admin
+                        log.warning("clear_kv_blocks failed on prefill %x",
+                                    iid, exc_info=True)
+                yield {"cleared": freed}
+                return
             if isinstance(request, dict) and request.get("embed"):
                 # Embeddings don't involve the disagg path: serve locally.
                 vectors = await self.engine.embed(

@@ -92,6 +92,7 @@ class HttpService:
         app.router.add_post("/v1/embeddings", self._embeddings)
         app.router.add_post("/v1/responses", self._responses)
         app.router.add_get("/v1/models", self._models)
+        app.router.add_post("/clear_kv_blocks", self._clear_kv_blocks)
         app.router.add_get("/health", self._health)
         app.router.add_get("/live", self._live)
         app.router.add_get("/metrics", self._metrics)
@@ -428,6 +429,33 @@ class HttpService:
             ctx.kill()
             raise
         return response
+
+    async def _clear_kv_blocks(self, _request: web.Request) -> web.Response:
+        """Admin route (reference openai.rs clear_kv_blocks): tell every
+        worker instance of every served model to drop its reusable prefix
+        cache."""
+        results: dict[str, dict] = {}
+        for name, served in self.manager.models.items():
+            per_model: dict[str, int] = {}
+            if served.client is None:
+                engine = served.preprocessor.inner.inner
+                clear = getattr(engine, "clear_kv_blocks", None)
+                if clear is not None:
+                    per_model["local"] = await clear()
+            else:
+                for iid in served.client.instance_ids():
+                    try:
+                        stream = await served.client.direct(
+                            {"clear_kv_blocks": True}, iid)
+                        async for item in stream:
+                            if "cleared" in item:
+                                per_model[f"{iid:x}"] = item["cleared"]
+                    except Exception as exc:  # noqa: BLE001 — report per-worker
+                        per_model[f"{iid:x}"] = -1
+                        log.warning("clear_kv_blocks failed on %x: %s",
+                                    iid, exc)
+            results[name] = per_model
+        return web.json_response({"cleared": results})
 
     async def _models(self, _request: web.Request) -> web.Response:
         return web.json_response({"object": "list",

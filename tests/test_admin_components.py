@@ -1,0 +1,165 @@
+"""clear_kv_blocks, metrics aggregator, multi-node barrier gating."""
+
+import asyncio
+
+import numpy as np
+from conftest import async_test
+
+from dynamo_tpu.engine.config import EngineConfig, PRESETS
+from dynamo_tpu.engine.engine import TPUEngine
+from dynamo_tpu.llm.protocols import PreprocessedRequest
+from dynamo_tpu.runtime.context import Context
+
+SPEC = PRESETS["tiny-test"]
+
+
+def tiny_config(**kw) -> EngineConfig:
+    defaults = dict(model=SPEC, page_size=16, num_pages=64,
+                    max_pages_per_seq=16, max_num_seqs=4,
+                    prefill_buckets=(32, 64), max_prefill_tokens=64,
+                    attention_backend="xla")
+    defaults.update(kw)
+    return EngineConfig(**defaults)
+
+
+async def collect(engine, prompt, max_tokens):
+    req = PreprocessedRequest(model="m", token_ids=list(prompt))
+    req.stop_conditions.max_tokens = max_tokens
+    req.stop_conditions.ignore_eos = True
+    toks = []
+    async for out in engine.generate(req, Context()):
+        toks.extend(out.get("token_ids", []))
+        if out.get("finish_reason"):
+            break
+    return toks
+
+
+@async_test
+async def test_clear_kv_blocks_drops_prefix_cache():
+    engine = TPUEngine(tiny_config())
+    try:
+        rng = np.random.default_rng(1)
+        prompt = rng.integers(0, SPEC.vocab_size, size=64).tolist()
+        await collect(engine, prompt, 4)
+        # Let deferred releases land so the pages are inactive.
+        for _ in range(100):
+            if engine.allocator.inactive:
+                break
+            await asyncio.sleep(0.02)
+        assert engine.allocator.inactive
+        freed = await engine.clear_kv_blocks()
+        assert freed > 0
+        assert not engine.allocator.inactive
+        # Serving still works, now with a cold cache.
+        hits_before = engine.prefix_hit_blocks
+        await collect(engine, prompt, 4)
+        assert engine.prefix_hit_blocks == hits_before  # no reuse: cleared
+    finally:
+        engine.stop()
+
+
+@async_test
+async def test_clear_kv_blocks_http_route():
+    from aiohttp import ClientSession
+    from dynamo_tpu.launch import build_local_served, parse_args
+    from dynamo_tpu.llm.discovery import ModelManager
+    from dynamo_tpu.llm.http_service import HttpService
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    runtime = await DistributedRuntime.detached(RuntimeConfig())
+    served, engine = build_local_served(parse_args(
+        ["in=http", "out=tpu", "--model", "tiny-test",
+         "--num-pages", "64"]))
+    manager = ModelManager()
+    manager.models[served.name] = served
+    service = HttpService(runtime, manager, host="127.0.0.1", port=0)
+    await service.start()
+    try:
+        base = f"http://127.0.0.1:{service.port}"
+        async with ClientSession() as http:
+            r = await http.post(f"{base}/v1/chat/completions", json={
+                "model": served.name,
+                "messages": [{"role": "user", "content": "warm the cache"}],
+                "max_tokens": 2})
+            assert r.status == 200
+            r = await http.post(f"{base}/clear_kv_blocks")
+            assert r.status == 200
+            body = await r.json()
+            assert served.name in body["cleared"]
+    finally:
+        await service.stop()
+        engine.stop()
+        await runtime.close()
+
+
+@async_test
+async def test_metrics_aggregator_exposes_worker_gauges():
+    from dynamo_tpu.components.metrics import MetricsAggregator
+    from dynamo_tpu.llm.kv_router.protocols import (ForwardPassMetrics,
+                                                    KvStats, WorkerStats)
+    from dynamo_tpu.llm.kv_router.publisher import WorkerMetricsPublisher
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.coordinator import Coordinator
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    coord = Coordinator()
+    await coord.start()
+    rt = await DistributedRuntime.from_settings(
+        RuntimeConfig(coordinator_url=coord.url))
+    try:
+        agg = MetricsAggregator(rt, "test", ["tpu"])
+        await agg.start()
+        pub = WorkerMetricsPublisher(rt, "test", "tpu", worker_id=0xAB,
+                                     min_interval_s=0.0)
+        await pub.publish(ForwardPassMetrics(
+            worker_stats=WorkerStats(request_active_slots=3,
+                                     request_total_slots=8,
+                                     num_requests_waiting=2),
+            kv_stats=KvStats(gpu_cache_usage_perc=0.5,
+                             gpu_prefix_cache_hit_rate=0.25)), force=True)
+        for _ in range(100):
+            text = rt.metrics.expose().decode()
+            if 'worker="ab"' in text:
+                break
+            await asyncio.sleep(0.02)
+        def line_for(metric):
+            return next(ln for ln in text.splitlines()
+                        if metric in ln and 'worker="ab"' in ln
+                        and not ln.startswith("#"))
+        assert line_for("worker_active_slots").endswith(" 3.0")
+        assert line_for("worker_waiting_requests").endswith(" 2.0")
+        assert line_for("worker_kv_usage").endswith(" 0.5")
+        await agg.stop()
+    finally:
+        await rt.close()
+        await coord.stop()
+
+
+@async_test
+async def test_multinode_barrier_gates_worker_group():
+    """Rank-0 leader + one peer assemble via the engine barrier with
+    matching shapes; a mismatched peer is rejected."""
+    from dynamo_tpu.runtime.barrier import LeaderBarrier, WorkerBarrier
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.coordinator import Coordinator
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    coord = Coordinator()
+    await coord.start()
+    rt0 = await DistributedRuntime.from_settings(
+        RuntimeConfig(coordinator_url=coord.url))
+    rt1 = await DistributedRuntime.from_settings(
+        RuntimeConfig(coordinator_url=coord.url))
+    try:
+        shape = {"model": "m", "tp": 4, "pp": 2, "sp": 1, "dp": 1}
+        leader = LeaderBarrier(rt0.require_coordinator(), "engine-m", 1)
+        worker = WorkerBarrier(rt1.require_coordinator(), "engine-m", "1")
+        peers, got = await asyncio.gather(
+            leader.sync(shape, timeout=10), worker.sync(shape, timeout=10))
+        assert got == shape
+        assert peers == {"1": shape}
+    finally:
+        await rt0.close()
+        await rt1.close()
+        await coord.stop()

@@ -230,6 +230,26 @@ async def test_conditional_disagg_short_prompt_stays_local():
 
 
 @async_test
+async def test_clear_kv_blocks_fans_out_to_prefill_workers():
+    """The admin clear on a decode worker clears its own pool AND every
+    discovered prefill worker's."""
+    s = await start_stack(max_local=8)
+    try:
+        prompt = _prompt(20, 24)
+        await run_request(s.caller, prompt, 4)  # remote prefill happened
+        stream = await s.caller.round_robin({"clear_kv_blocks": True})
+        cleared = None
+        async for item in stream:
+            if "cleared" in item:
+                cleared = item["cleared"]
+        assert cleared is not None and cleared >= 0
+        assert not s.p_engine.allocator.inactive
+        assert not s.d_engine.allocator.inactive
+    finally:
+        await stop_stack(s)
+
+
+@async_test
 async def test_disagg_config_dynamic_update():
     """The conditional threshold updates live from the coordinator KV store
     (reference DisaggRouterConf::from_etcd_with_watcher)."""
