@@ -91,7 +91,7 @@ async def main_async():
     from dynamo_tpu.engine.engine import TPUEngine
 
     import os
-    spec = PRESETS["qwen2.5-0.5b"]
+    spec = PRESETS[os.environ.get("BENCH_MODEL", "qwen2.5-0.5b")]
     page = 16
     maxp = 64  # up to 1024 tokens/seq
     config = EngineConfig(

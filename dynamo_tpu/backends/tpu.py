@@ -92,13 +92,12 @@ def parse_args(argv=None) -> argparse.Namespace:
 
 
 def build_engine_config(args) -> EngineConfig:
-    if args.model in PRESETS:
-        spec = PRESETS[args.model]
-    elif os.path.isdir(args.model):
-        spec = ModelSpec.from_hf_config(args.model)
-    else:
-        raise SystemExit(f"unknown model {args.model!r}; presets: "
-                         f"{sorted(PRESETS)} or a local HF model dir")
+    from dynamo_tpu.engine.hub import resolve_model
+    try:
+        spec, ckpt = resolve_model(args.model)
+    except FileNotFoundError as exc:
+        raise SystemExit(str(exc)) from exc
+    args.resolved_checkpoint = ckpt
     return EngineConfig(
         model=spec, page_size=args.page_size, num_pages=args.num_pages,
         max_num_seqs=args.max_num_seqs, max_pages_per_seq=args.max_pages_per_seq,
@@ -119,10 +118,11 @@ async def run(args: argparse.Namespace) -> None:
     try:
         engine_cfg = build_engine_config(args)
         model_name = args.model_name or engine_cfg.model.name
+        ckpt = args.resolved_checkpoint
         if args.tokenizer:
             tokenizer = Tokenizer.from_file(args.tokenizer)
-        elif os.path.isdir(args.model):
-            tokenizer = Tokenizer.from_pretrained_dir(args.model)
+        elif ckpt is not None:
+            tokenizer = Tokenizer.from_pretrained_dir(ckpt)
         else:
             tokenizer = make_test_tokenizer()
         ns = cfg.namespace
@@ -132,9 +132,9 @@ async def run(args: argparse.Namespace) -> None:
                                              runtime.instance_id)
         def build_engine() -> TPUEngine:
             params = None
-            if os.path.isdir(args.model):
+            if ckpt is not None:
                 from dynamo_tpu.engine.weights import load_hf_weights
-                params = load_hf_weights(engine_cfg.model, args.model)
+                params = load_hf_weights(engine_cfg.model, ckpt)
             return TPUEngine(engine_cfg, params=params, kv_publisher=kv_pub,
                              metrics_publisher=metrics_pub)
 

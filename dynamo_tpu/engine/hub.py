@@ -1,0 +1,68 @@
+"""Model source resolution: preset | local directory | HF hub id.
+
+Capability parity with reference lib/llm/src/hub.rs:311 and
+local_model.rs:429: a model argument resolves, in order, to a built-in
+preset, a local checkpoint directory, or a Hugging Face hub id — hub ids
+are served from the local HF cache when present and downloaded via
+``huggingface_hub.snapshot_download`` when the environment has network
+access (air-gapped TPU pods get a clear error naming the cache path to
+pre-populate instead of a hang).
+"""
+
+from __future__ import annotations
+
+import os
+
+from dynamo_tpu.engine.config import PRESETS, ModelSpec
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("hub")
+
+_CHECKPOINT_FILES = ("config.json",)
+
+
+def looks_like_checkpoint_dir(path: str) -> bool:
+    return os.path.isdir(path) and all(
+        os.path.exists(os.path.join(path, f)) for f in _CHECKPOINT_FILES)
+
+
+def resolve_model(model: str, revision: str | None = None,
+                  allow_download: bool = True) -> tuple[ModelSpec, str | None]:
+    """Resolve ``model`` to (spec, checkpoint_dir). checkpoint_dir is None
+    for presets (random-weight serving)."""
+    if model in PRESETS:
+        return PRESETS[model], None
+    if looks_like_checkpoint_dir(model):
+        return ModelSpec.from_hf_config(model), model
+    if os.path.sep in model and not model.count("/") == 1:
+        raise FileNotFoundError(
+            f"{model!r} is not a preset ({sorted(PRESETS)}), not a local "
+            f"checkpoint directory, and not a hub id")
+    # Treat as a hub id: local cache first, then (optionally) download.
+    from huggingface_hub import snapshot_download
+    from huggingface_hub.errors import LocalEntryNotFoundError
+    try:
+        path = snapshot_download(model, revision=revision,
+                                 local_files_only=True,
+                                 allow_patterns=["*.json", "*.safetensors",
+                                                 "tokenizer*"])
+        log.info("resolved %s from local HF cache: %s", model, path)
+        return ModelSpec.from_hf_config(path), path
+    except LocalEntryNotFoundError:
+        pass
+    if not allow_download:
+        raise FileNotFoundError(
+            f"{model!r} is not in the local HF cache and downloads are "
+            f"disabled; pre-populate the cache (HF_HOME="
+            f"{os.environ.get('HF_HOME', '~/.cache/huggingface')})")
+    try:
+        path = snapshot_download(model, revision=revision,
+                                 allow_patterns=["*.json", "*.safetensors",
+                                                 "tokenizer*"])
+    except Exception as exc:  # noqa: BLE001 — no-egress pods land here
+        raise FileNotFoundError(
+            f"could not download {model!r} ({type(exc).__name__}: {exc}); "
+            f"on air-gapped pods pre-populate the HF cache or pass a local "
+            f"checkpoint directory") from exc
+    log.info("downloaded %s -> %s", model, path)
+    return ModelSpec.from_hf_config(path), path

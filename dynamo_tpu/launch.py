@@ -93,10 +93,11 @@ def _build_engine(args):
     from dynamo_tpu.engine.engine import TPUEngine
     from dynamo_tpu.engine.weights import load_hf_weights
     cfg = build_engine_config(args)
+    ckpt = args.resolved_checkpoint
     params = None
-    if os.path.isdir(args.model):
-        params = load_hf_weights(cfg.model, args.model)
-        tokenizer = Tokenizer.from_pretrained_dir(args.model)
+    if ckpt is not None:
+        params = load_hf_weights(cfg.model, ckpt)
+        tokenizer = Tokenizer.from_pretrained_dir(ckpt)
     elif args.tokenizer:
         tokenizer = Tokenizer.from_file(args.tokenizer)
     else:

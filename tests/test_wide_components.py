@@ -126,6 +126,28 @@ async def test_tracker_shutdown_cancels():
         tr.spawn("late", forever)
 
 
+# -- hub resolution ----------------------------------------------------------
+
+def test_hub_resolves_presets_and_local_dirs(tmp_path):
+    from dynamo_tpu.engine.hub import resolve_model
+    spec, ckpt = resolve_model("tiny-test")
+    assert spec.name == "tiny-test" and ckpt is None
+    # A local checkpoint directory (config.json is the marker).
+    import json
+    (tmp_path / "config.json").write_text(json.dumps({
+        "vocab_size": 128, "hidden_size": 64, "intermediate_size": 128,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "num_key_value_heads": 2}))
+    spec, ckpt = resolve_model(str(tmp_path))
+    assert spec.num_layers == 2 and ckpt == str(tmp_path)
+
+
+def test_hub_unknown_model_errors_helpfully():
+    from dynamo_tpu.engine.hub import resolve_model
+    with pytest.raises(FileNotFoundError, match="cache"):
+        resolve_model("no-such-org/no-such-model", allow_download=False)
+
+
 # -- unified launcher (static pipeline, in-process) --------------------------
 
 def _launch_args(extra=None):
