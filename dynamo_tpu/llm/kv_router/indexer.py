@@ -20,7 +20,7 @@ from dynamo_tpu.llm.kv_router.protocols import KvCacheEvent, RouterEvent
 OverlapScores = dict[int, int]  # worker_id -> number of matched prefix blocks
 
 
-class RadixTree:
+class PyRadixTree:
     def __init__(self):
         # block_hash -> set of worker ids holding the block.
         self._blocks: dict[int, set[int]] = {}
@@ -90,6 +90,19 @@ class RadixTree:
 
     def workers(self) -> set[int]:
         return {w for w, hs in self._by_worker.items() if hs}
+
+
+# The C++ core (native/radix_tree.cpp, the role of the reference's Rust
+# RadixTree) is preferred when it builds; DTPU_NATIVE=0 or a failed build
+# falls back to the pure-Python implementation above. Interfaces are
+# identical and parity-tested (tests/test_native_radix.py).
+try:
+    from dynamo_tpu.native.radix import NativeRadixTree
+    from dynamo_tpu.native.radix import available as _native_available
+except Exception:  # noqa: BLE001 — any import/build issue -> Python
+    _native_available = False
+
+RadixTree = NativeRadixTree if _native_available else PyRadixTree
 
 
 class KvIndexer:

@@ -1,0 +1,52 @@
+"""Native (C++) runtime components with build-on-first-use + ctypes.
+
+The reference implements its runtime hot paths in Rust/C++; here the
+compute path is JAX/XLA and the host-side hot structures get C++ cores
+(radix_tree.cpp so far). No pybind11 in the image, so bindings are plain
+ctypes over a C ABI; the shared object compiles from source on first use
+(g++ is baked into the image) and callers fall back to the pure-Python
+implementation if compilation fails or DTPU_NATIVE=0.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("native")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def load_library(name: str) -> ctypes.CDLL | None:
+    """Load (building if needed) lib ``name`` (e.g. "radix_tree" ->
+    _radix_tree.so). Returns None when native is disabled or the build
+    fails."""
+    if os.environ.get("DTPU_NATIVE", "1").lower() in ("0", "false"):
+        return None
+    src = os.path.join(_DIR, f"{name}.cpp")
+    so = os.path.join(_DIR, f"_{name}.so")
+    if (not os.path.exists(so)
+            or os.path.getmtime(so) < os.path.getmtime(src)):
+        try:
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src,
+                 "-o", so + ".tmp"],
+                check=True, capture_output=True, text=True, timeout=120)
+            os.replace(so + ".tmp", so)
+            log.info("built native %s", so)
+        except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+                OSError) as exc:
+            detail = getattr(exc, "stderr", "") or str(exc)
+            log.warning("native build of %s failed (%s); using the Python "
+                        "implementation", name, detail[:500])
+            return None
+    try:
+        return ctypes.CDLL(so)
+    except OSError as exc:
+        log.warning("could not load %s (%s); using the Python "
+                    "implementation", so, exc)
+        return None

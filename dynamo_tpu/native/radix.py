@@ -1,0 +1,119 @@
+"""ctypes wrapper for the C++ radix index (radix_tree.cpp): same interface
+as the pure-Python RadixTree in llm/kv_router/indexer.py."""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Iterable
+
+from dynamo_tpu.native import load_library
+
+_lib = load_library("radix_tree")
+
+if _lib is not None:
+    _u64p = ctypes.POINTER(ctypes.c_uint64)
+    _u32p = ctypes.POINTER(ctypes.c_uint32)
+    _lib.radix_new.restype = ctypes.c_void_p
+    _lib.radix_free.argtypes = [ctypes.c_void_p]
+    _lib.radix_stored.argtypes = [ctypes.c_void_p, ctypes.c_uint64, _u64p,
+                                  ctypes.c_size_t]
+    _lib.radix_removed.argtypes = [ctypes.c_void_p, ctypes.c_uint64, _u64p,
+                                   ctypes.c_size_t]
+    _lib.radix_remove_worker.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    _lib.radix_bump_events.argtypes = [ctypes.c_void_p]
+    _lib.radix_event_count.argtypes = [ctypes.c_void_p]
+    _lib.radix_event_count.restype = ctypes.c_uint64
+    _lib.radix_num_blocks.argtypes = [ctypes.c_void_p]
+    _lib.radix_num_blocks.restype = ctypes.c_size_t
+    _lib.radix_find_matches.argtypes = [ctypes.c_void_p, _u64p,
+                                        ctypes.c_size_t, _u64p, _u32p,
+                                        ctypes.c_size_t]
+    _lib.radix_find_matches.restype = ctypes.c_size_t
+    _lib.radix_workers.argtypes = [ctypes.c_void_p, _u64p, ctypes.c_size_t]
+    _lib.radix_workers.restype = ctypes.c_size_t
+    _lib.radix_worker_block_count.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_uint64]
+    _lib.radix_worker_block_count.restype = ctypes.c_size_t
+    _lib.radix_worker_blocks.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                         _u64p, ctypes.c_size_t]
+    _lib.radix_worker_blocks.restype = ctypes.c_size_t
+
+available = _lib is not None
+
+_MASK = 2**64 - 1
+
+
+def _arr(hashes: list[int]):
+    n = len(hashes)
+    return (ctypes.c_uint64 * n)(*[h & _MASK for h in hashes]), n
+
+
+class NativeRadixTree:
+    """Drop-in for llm.kv_router.indexer.RadixTree backed by the C++ core.
+    Hash values are canonicalized to unsigned 64-bit (the Python tree
+    stores xxh3 ints, already unsigned)."""
+
+    MAX_WORKERS = 4096
+
+    def __init__(self):
+        assert _lib is not None
+        self._p = ctypes.c_void_p(_lib.radix_new())
+
+    def __del__(self):
+        p = getattr(self, "_p", None)
+        if p and _lib is not None:
+            _lib.radix_free(p)
+            self._p = None
+
+    @property
+    def event_count(self) -> int:
+        return _lib.radix_event_count(self._p)
+
+    def apply_event(self, event) -> None:
+        worker = event.worker_id & _MASK
+        ev = event.event
+        if ev.kind == "stored":
+            arr, n = _arr(list(ev.block_hashes))
+            _lib.radix_stored(self._p, worker, arr, n)
+        elif ev.kind == "removed":
+            arr, n = _arr(list(ev.block_hashes))
+            _lib.radix_removed(self._p, worker, arr, n)
+        elif ev.kind == "cleared":
+            _lib.radix_remove_worker(self._p, worker)
+            _lib.radix_bump_events(self._p)
+
+    def remove_worker(self, worker_id: int) -> None:
+        _lib.radix_remove_worker(self._p, worker_id & _MASK)
+
+    def find_matches(self, block_hashes: Iterable[int]) -> dict[int, int]:
+        hashes = list(block_hashes)
+        arr, n = _arr(hashes)
+        cap = self.MAX_WORKERS
+        workers = (ctypes.c_uint64 * cap)()
+        scores = (ctypes.c_uint32 * cap)()
+        m = _lib.radix_find_matches(self._p, arr, n, workers, scores, cap)
+        return {int(workers[i]): int(scores[i]) for i in range(m)}
+
+    def workers(self) -> set[int]:
+        cap = self.MAX_WORKERS
+        out = (ctypes.c_uint64 * cap)()
+        m = _lib.radix_workers(self._p, out, cap)
+        return {int(out[i]) for i in range(m)}
+
+    @property
+    def num_blocks(self) -> int:
+        return _lib.radix_num_blocks(self._p)
+
+    def dump_as_events(self) -> list:
+        from dynamo_tpu.llm.kv_router.protocols import (KvCacheEvent,
+                                                        RouterEvent)
+        out = []
+        for w in sorted(self.workers()):
+            cnt = _lib.radix_worker_block_count(self._p, w)
+            buf = (ctypes.c_uint64 * cnt)()
+            m = _lib.radix_worker_blocks(self._p, w, buf, cnt)
+            hashes = sorted(int(buf[i]) for i in range(m))
+            if hashes:
+                out.append(RouterEvent(worker_id=w,
+                                       event=KvCacheEvent.stored(hashes)))
+        return out

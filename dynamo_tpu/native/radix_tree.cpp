@@ -1,0 +1,160 @@
+// Native radix index of cached KV blocks per worker — the C++ core of the
+// KV-cache-aware router (the role the reference implements in Rust,
+// lib/llm/src/kv_router/indexer.rs RadixTree). Because block hashes chain
+// their whole prefix, the radix structure is implicit in the hashes: the
+// index maps block_hash -> holders and longest-prefix matching narrows the
+// holder set walking the request's hashes in order.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in the image). Build:
+//   g++ -O2 -shared -fPIC -std=c++17 radix_tree.cpp -o _radix.so
+
+#include <cstdint>
+#include <cstddef>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+struct RadixIndex {
+    std::unordered_map<uint64_t, std::unordered_set<uint64_t>> blocks;
+    std::unordered_map<uint64_t, std::unordered_set<uint64_t>> by_worker;
+    uint64_t event_count = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* radix_new() { return new RadixIndex(); }
+
+void radix_free(void* p) { delete static_cast<RadixIndex*>(p); }
+
+void radix_stored(void* p, uint64_t worker, const uint64_t* hashes,
+                  size_t n) {
+    auto* idx = static_cast<RadixIndex*>(p);
+    idx->event_count++;
+    auto& mine = idx->by_worker[worker];
+    for (size_t i = 0; i < n; i++) {
+        idx->blocks[hashes[i]].insert(worker);
+        mine.insert(hashes[i]);
+    }
+}
+
+void radix_removed(void* p, uint64_t worker, const uint64_t* hashes,
+                   size_t n) {
+    auto* idx = static_cast<RadixIndex*>(p);
+    idx->event_count++;
+    auto by = idx->by_worker.find(worker);
+    for (size_t i = 0; i < n; i++) {
+        auto it = idx->blocks.find(hashes[i]);
+        if (it != idx->blocks.end()) {
+            it->second.erase(worker);
+            if (it->second.empty()) idx->blocks.erase(it);
+        }
+        if (by != idx->by_worker.end()) by->second.erase(hashes[i]);
+    }
+}
+
+void radix_remove_worker(void* p, uint64_t worker) {
+    auto* idx = static_cast<RadixIndex*>(p);
+    auto by = idx->by_worker.find(worker);
+    if (by == idx->by_worker.end()) return;
+    for (uint64_t h : by->second) {
+        auto it = idx->blocks.find(h);
+        if (it != idx->blocks.end()) {
+            it->second.erase(worker);
+            if (it->second.empty()) idx->blocks.erase(it);
+        }
+    }
+    idx->by_worker.erase(by);
+}
+
+void radix_bump_events(void* p) {
+    static_cast<RadixIndex*>(p)->event_count++;
+}
+
+uint64_t radix_event_count(void* p) {
+    return static_cast<RadixIndex*>(p)->event_count;
+}
+
+size_t radix_num_blocks(void* p) {
+    return static_cast<RadixIndex*>(p)->blocks.size();
+}
+
+// Longest-prefix overlap per worker: a worker scores i+1 only if it holds
+// blocks 0..i contiguously. Writes up to cap (worker, score) pairs;
+// returns the pair count.
+size_t radix_find_matches(void* p, const uint64_t* hashes, size_t n,
+                          uint64_t* workers_out, uint32_t* scores_out,
+                          size_t cap) {
+    auto* idx = static_cast<RadixIndex*>(p);
+    std::unordered_map<uint64_t, uint32_t> scores;
+    std::vector<uint64_t> active;
+    bool first = true;
+    for (size_t i = 0; i < n; i++) {
+        auto it = idx->blocks.find(hashes[i]);
+        if (it == idx->blocks.end() || it->second.empty()) break;
+        if (first) {
+            active.assign(it->second.begin(), it->second.end());
+            first = false;
+        } else {
+            std::vector<uint64_t> next;
+            next.reserve(active.size());
+            for (uint64_t w : active)
+                if (it->second.count(w)) next.push_back(w);
+            active.swap(next);
+        }
+        if (active.empty()) break;
+        for (uint64_t w : active) scores[w]++;
+    }
+    size_t out = 0;
+    for (auto& kv : scores) {
+        if (out >= cap) break;
+        workers_out[out] = kv.first;
+        scores_out[out] = kv.second;
+        out++;
+    }
+    return out;
+}
+
+size_t radix_num_workers(void* p) {
+    auto* idx = static_cast<RadixIndex*>(p);
+    size_t n = 0;
+    for (auto& kv : idx->by_worker)
+        if (!kv.second.empty()) n++;
+    return n;
+}
+
+// Enumerate workers with blocks; writes up to cap ids, returns count.
+size_t radix_workers(void* p, uint64_t* out, size_t cap) {
+    auto* idx = static_cast<RadixIndex*>(p);
+    size_t n = 0;
+    for (auto& kv : idx->by_worker) {
+        if (kv.second.empty()) continue;
+        if (n >= cap) break;
+        out[n++] = kv.first;
+    }
+    return n;
+}
+
+size_t radix_worker_block_count(void* p, uint64_t worker) {
+    auto* idx = static_cast<RadixIndex*>(p);
+    auto it = idx->by_worker.find(worker);
+    return it == idx->by_worker.end() ? 0 : it->second.size();
+}
+
+size_t radix_worker_blocks(void* p, uint64_t worker, uint64_t* out,
+                           size_t cap) {
+    auto* idx = static_cast<RadixIndex*>(p);
+    auto it = idx->by_worker.find(worker);
+    if (it == idx->by_worker.end()) return 0;
+    size_t n = 0;
+    for (uint64_t h : it->second) {
+        if (n >= cap) break;
+        out[n++] = h;
+    }
+    return n;
+}
+
+}  // extern "C"

@@ -1,0 +1,78 @@
+"""C++ radix index == Python reference implementation (parity fuzz)."""
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.llm.kv_router.indexer import PyRadixTree
+from dynamo_tpu.llm.kv_router.protocols import KvCacheEvent, RouterEvent
+
+native = pytest.importorskip("dynamo_tpu.native.radix")
+if not native.available:
+    pytest.skip("native radix library unavailable", allow_module_level=True)
+
+
+def test_native_library_builds_and_loads():
+    t = native.NativeRadixTree()
+    assert t.num_blocks == 0
+
+
+def test_parity_fuzz():
+    """Random stored/removed/cleared event stream: every observable —
+    num_blocks, workers, find_matches over random prefixes, dump — must
+    match the Python tree exactly."""
+    rng = np.random.default_rng(0)
+    py = PyRadixTree()
+    cc = native.NativeRadixTree()
+    workers = [1, 2, 3, 0xDEADBEEF]
+    # Chains of hashes (prefix-structured like real block hashes).
+    chains = [[int(x) for x in rng.integers(1, 2**63, size=12)]
+              for _ in range(5)]
+    for step in range(400):
+        w = workers[rng.integers(0, len(workers))]
+        chain = chains[rng.integers(0, len(chains))]
+        k = int(rng.integers(1, len(chain) + 1))
+        op = rng.random()
+        if op < 0.55:
+            ev = KvCacheEvent.stored(chain[:k])
+        elif op < 0.9:
+            ev = KvCacheEvent.removed(chain[:k])
+        else:
+            ev = KvCacheEvent.cleared()
+        event = RouterEvent(worker_id=w, event=ev)
+        py.apply_event(event)
+        cc.apply_event(event)
+        if step % 20 == 0:
+            assert cc.num_blocks == py.num_blocks, f"step {step}"
+            assert cc.workers() == py.workers(), f"step {step}"
+            for chain2 in chains:
+                q = chain2[:int(rng.integers(1, len(chain2) + 1))]
+                assert cc.find_matches(q) == py.find_matches(q), \
+                    f"step {step}: query {q[:2]}..."
+    assert cc.event_count == py.event_count
+    # dump_as_events parity (sorted hashes per worker).
+    def norm(events):
+        return sorted((e.worker_id, tuple(e.event.block_hashes))
+                      for e in events)
+    assert norm(cc.dump_as_events()) == norm(py.dump_as_events())
+
+
+def test_remove_worker_parity():
+    py = PyRadixTree()
+    cc = native.NativeRadixTree()
+    for t in (py, cc):
+        t.apply_event(RouterEvent(worker_id=1,
+                                  event=KvCacheEvent.stored([10, 20, 30])))
+        t.apply_event(RouterEvent(worker_id=2,
+                                  event=KvCacheEvent.stored([10, 20])))
+        t.remove_worker(1)
+    assert cc.num_blocks == py.num_blocks == 2
+    assert cc.find_matches([10, 20, 30]) == py.find_matches([10, 20, 30]) \
+        == {2: 2}
+
+
+def test_python_fallback_flag(monkeypatch):
+    """DTPU_NATIVE=0 must yield the Python implementation."""
+    import importlib
+    monkeypatch.setenv("DTPU_NATIVE", "0")
+    import dynamo_tpu.native as nat
+    assert nat.load_library("radix_tree") is None
