@@ -1,0 +1,102 @@
+"""GGUF metadata + tokenizer loading.
+
+Capability parity with the reference's GGUF support (its tokenizer layer
+reads GGUF checkpoints for the llama.cpp engine path): parse the GGUF v2/v3
+container's metadata key-values (no tensor data needed) and rebuild a HF
+``tokenizers`` BPE tokenizer from ``tokenizer.ggml.tokens`` +
+``tokenizer.ggml.merges`` (gpt2-style byte-level BPE, the format GGUF chat
+models ship). The parser is self-contained — GGUF is a simple
+little-endian TLV container (spec: github.com/ggerganov/ggml/docs/gguf.md).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, BinaryIO
+
+GGUF_MAGIC = b"GGUF"
+
+# Metadata value type ids (gguf spec).
+_T_U8, _T_I8, _T_U16, _T_I16, _T_U32, _T_I32 = 0, 1, 2, 3, 4, 5
+_T_F32, _T_BOOL, _T_STRING, _T_ARRAY, _T_U64, _T_I64, _T_F64 = (
+    6, 7, 8, 9, 10, 11, 12)
+
+_SCALAR_FMT = {_T_U8: "<B", _T_I8: "<b", _T_U16: "<H", _T_I16: "<h",
+               _T_U32: "<I", _T_I32: "<i", _T_F32: "<f", _T_U64: "<Q",
+               _T_I64: "<q", _T_F64: "<d"}
+
+
+def _read(fh: BinaryIO, fmt: str):
+    size = struct.calcsize(fmt)
+    data = fh.read(size)
+    if len(data) != size:
+        raise ValueError("truncated GGUF file")
+    return struct.unpack(fmt, data)[0]
+
+
+def _read_string(fh: BinaryIO) -> str:
+    n = _read(fh, "<Q")
+    return fh.read(n).decode("utf-8", "replace")
+
+
+def _read_value(fh: BinaryIO, vtype: int) -> Any:
+    if vtype in _SCALAR_FMT:
+        return _read(fh, _SCALAR_FMT[vtype])
+    if vtype == _T_BOOL:
+        return bool(_read(fh, "<B"))
+    if vtype == _T_STRING:
+        return _read_string(fh)
+    if vtype == _T_ARRAY:
+        etype = _read(fh, "<I")
+        n = _read(fh, "<Q")
+        return [_read_value(fh, etype) for _ in range(n)]
+    raise ValueError(f"unknown GGUF value type {vtype}")
+
+
+def read_metadata(path: str) -> dict[str, Any]:
+    """Parse a GGUF file's metadata KVs (tensor info/data are skipped)."""
+    with open(path, "rb") as fh:
+        if fh.read(4) != GGUF_MAGIC:
+            raise ValueError(f"{path} is not a GGUF file")
+        version = _read(fh, "<I")
+        if version < 2:
+            raise ValueError(f"GGUF v{version} unsupported (need >= 2)")
+        _n_tensors = _read(fh, "<Q")
+        n_kv = _read(fh, "<Q")
+        meta: dict[str, Any] = {"gguf.version": version}
+        for _ in range(n_kv):
+            key = _read_string(fh)
+            vtype = _read(fh, "<I")
+            meta[key] = _read_value(fh, vtype)
+        return meta
+
+
+def tokenizer_from_gguf(path: str):
+    """Build a dynamo_tpu Tokenizer from a GGUF checkpoint's embedded
+    vocabulary (gpt2-style byte-level BPE)."""
+    from tokenizers import Tokenizer as HFTokenizer
+    from tokenizers import decoders, models, pre_tokenizers
+
+    from dynamo_tpu.llm.tokenizer import Tokenizer
+
+    meta = read_metadata(path)
+    model = meta.get("tokenizer.ggml.model")
+    tokens = meta.get("tokenizer.ggml.tokens")
+    if tokens is None:
+        raise ValueError(f"{path} has no tokenizer.ggml.tokens metadata")
+    if model != "gpt2":
+        raise ValueError(
+            f"GGUF tokenizer model {model!r} unsupported (gpt2-style "
+            f"byte-level BPE only; sentencepiece GGUFs should ship a "
+            f"tokenizer.json instead)")
+    merges_raw = meta.get("tokenizer.ggml.merges") or []
+    vocab = {tok: i for i, tok in enumerate(tokens)}
+    merges = [tuple(m.split(" ", 1)) for m in merges_raw if " " in m]
+    hf = HFTokenizer(models.BPE(vocab=vocab, merges=merges))
+    hf.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    hf.decoder = decoders.ByteLevel()
+    tok = Tokenizer(hf)
+    eos = meta.get("tokenizer.ggml.eos_token_id")
+    if eos is not None:
+        tok.eos_override = [int(eos)]
+    return tok

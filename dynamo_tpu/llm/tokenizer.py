@@ -24,9 +24,15 @@ class Tokenizer:
     def __init__(self, hf: HFTokenizer):
         self._hf = hf
         self._lock = threading.Lock()
+        # Explicit EOS ids (e.g. from GGUF metadata) override the
+        # name-convention discovery in eos_token_ids().
+        self.eos_override: list[int] | None = None
 
     @classmethod
     def from_file(cls, path: str) -> "Tokenizer":
+        if path.endswith(".gguf"):
+            from dynamo_tpu.llm.gguf import tokenizer_from_gguf
+            return tokenizer_from_gguf(path)
         return cls(HFTokenizer.from_file(path))
 
     @classmethod
@@ -61,6 +67,8 @@ class Tokenizer:
 
     def eos_token_ids(self) -> list[int]:
         """Best-effort EOS discovery from common conventions."""
+        if self.eos_override is not None:
+            return list(self.eos_override)
         ids = []
         for tok in ("</s>", "<|endoftext|>", "<|eot_id|>", "<|end_of_text|>",
                     "<|im_end|>", "<eos>"):

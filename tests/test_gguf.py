@@ -1,0 +1,109 @@
+"""GGUF metadata parsing + tokenizer reconstruction.
+
+The test writes a tiny but REAL GGUF v3 container (the little-endian TLV
+layout from the public spec) embedding a gpt2-style byte-level BPE vocab
+built by the same trainer the test tokenizer uses — round-tripping text
+through the GGUF-loaded tokenizer must match the original exactly.
+"""
+
+import struct
+
+import pytest
+
+from dynamo_tpu.llm.gguf import read_metadata, tokenizer_from_gguf
+from dynamo_tpu.llm.tokenizer import Tokenizer, make_test_tokenizer
+
+_T_U32, _T_STRING, _T_ARRAY = 4, 8, 9
+
+
+def _s(x: str) -> bytes:
+    b = x.encode()
+    return struct.pack("<Q", len(b)) + b
+
+
+def _kv_string(key: str, val: str) -> bytes:
+    return _s(key) + struct.pack("<I", _T_STRING) + _s(val)
+
+
+def _kv_u32(key: str, val: int) -> bytes:
+    return _s(key) + struct.pack("<I", _T_U32) + struct.pack("<I", val)
+
+
+def _kv_str_array(key: str, vals: list[str]) -> bytes:
+    out = _s(key) + struct.pack("<I", _T_ARRAY)
+    out += struct.pack("<I", _T_STRING) + struct.pack("<Q", len(vals))
+    for v in vals:
+        out += _s(v)
+    return out
+
+
+def write_gguf(path, kvs: list[bytes]) -> None:
+    with open(path, "wb") as fh:
+        fh.write(b"GGUF")
+        fh.write(struct.pack("<I", 3))       # version
+        fh.write(struct.pack("<Q", 0))       # tensor count
+        fh.write(struct.pack("<Q", len(kvs)))
+        for kv in kvs:
+            fh.write(kv)
+
+
+@pytest.fixture()
+def gguf_path(tmp_path):
+    """A GGUF carrying the test tokenizer's actual BPE vocab + merges."""
+    src = make_test_tokenizer()
+    import json
+    blob = json.loads(src.to_bytes())
+    vocab = blob["model"]["vocab"]
+    merges = blob["model"]["merges"]
+    tokens = [t for t, _ in sorted(vocab.items(), key=lambda kv: kv[1])]
+    merge_strs = [m if isinstance(m, str) else " ".join(m) for m in merges]
+    path = tmp_path / "model.gguf"
+    write_gguf(path, [
+        _kv_string("general.architecture", "llama"),
+        _kv_string("tokenizer.ggml.model", "gpt2"),
+        _kv_str_array("tokenizer.ggml.tokens", tokens),
+        _kv_str_array("tokenizer.ggml.merges", merge_strs),
+        _kv_u32("tokenizer.ggml.eos_token_id", 0),
+    ])
+    return str(path), src
+
+
+def test_read_metadata(gguf_path):
+    path, _ = gguf_path
+    meta = read_metadata(path)
+    assert meta["gguf.version"] == 3
+    assert meta["general.architecture"] == "llama"
+    assert meta["tokenizer.ggml.model"] == "gpt2"
+    assert isinstance(meta["tokenizer.ggml.tokens"], list)
+
+
+def test_gguf_tokenizer_roundtrip_matches_source(gguf_path):
+    path, src = gguf_path
+    tok = tokenizer_from_gguf(path)
+    for text in ("hello world", "the quick brown fox", "a b c"):
+        assert tok.encode(text) == src.encode(text), text
+        assert tok.decode(tok.encode(text)) == src.decode(src.encode(text))
+    assert tok.eos_token_ids() == [0]  # explicit override from metadata
+
+
+def test_from_file_dispatches_on_extension(gguf_path):
+    path, _ = gguf_path
+    tok = Tokenizer.from_file(path)
+    assert tok.encode("hello")
+
+
+def test_non_gguf_rejected(tmp_path):
+    bad = tmp_path / "x.gguf"
+    bad.write_bytes(b"NOPE" + b"\x00" * 16)
+    with pytest.raises(ValueError, match="not a GGUF"):
+        read_metadata(str(bad))
+
+
+def test_unsupported_tokenizer_model(tmp_path):
+    path = tmp_path / "sp.gguf"
+    write_gguf(path, [
+        _kv_string("tokenizer.ggml.model", "llama"),
+        _kv_str_array("tokenizer.ggml.tokens", ["a", "b"]),
+    ])
+    with pytest.raises(ValueError, match="unsupported"):
+        tokenizer_from_gguf(str(path))
