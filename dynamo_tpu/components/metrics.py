@@ -106,6 +106,7 @@ class MetricsAggregator:
                 self._g_active.set(0, component=comp, worker=worker)
                 self._g_waiting.set(0, component=comp, worker=worker)
                 self._g_kv.set(0, component=comp, worker=worker)
+                self._g_hit.set(0, component=comp, worker=worker)
                 del self._last[(comp, worker)]
                 continue
             tot = totals.setdefault(comp, [0, 0, 0])

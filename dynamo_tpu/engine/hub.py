@@ -40,7 +40,8 @@ def resolve_model(model: str, revision: str | None = None,
             f"checkpoint directory, and not a hub id")
     # Treat as a hub id: local cache first, then (optionally) download.
     from huggingface_hub import snapshot_download
-    from huggingface_hub.errors import LocalEntryNotFoundError
+    from huggingface_hub.errors import (HFValidationError,
+                                        LocalEntryNotFoundError)
     try:
         path = snapshot_download(model, revision=revision,
                                  local_files_only=True,
@@ -48,6 +49,10 @@ def resolve_model(model: str, revision: str | None = None,
                                                  "tokenizer*"])
         log.info("resolved %s from local HF cache: %s", model, path)
         return ModelSpec.from_hf_config(path), path
+    except HFValidationError as exc:
+        raise FileNotFoundError(
+            f"{model!r} is not a preset ({sorted(PRESETS)}), not a local "
+            f"checkpoint directory, and not a valid hub id ({exc})") from exc
     except LocalEntryNotFoundError:
         pass
     if not allow_download:

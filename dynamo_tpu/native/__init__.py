@@ -31,12 +31,13 @@ def load_library(name: str) -> ctypes.CDLL | None:
     so = os.path.join(_DIR, f"_{name}.so")
     if (not os.path.exists(so)
             or os.path.getmtime(so) < os.path.getmtime(src)):
+        tmp = f"{so}.{os.getpid()}.tmp"  # concurrent builders can't collide
         try:
             subprocess.run(
                 ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src,
-                 "-o", so + ".tmp"],
+                 "-o", tmp],
                 check=True, capture_output=True, text=True, timeout=120)
-            os.replace(so + ".tmp", so)
+            os.replace(tmp, so)
             log.info("built native %s", so)
         except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
                 OSError) as exc:
