@@ -75,17 +75,24 @@ class ModelManager:
 class ModelWatcher:
     def __init__(self, runtime, manager: ModelManager,
                  router_mode: str = "round_robin",
-                 kv_router_factory=None):
+                 kv_router_factory=None, store=None):
         self._runtime = runtime
         self.manager = manager
         self.router_mode = router_mode
         self._kv_router_factory = kv_router_factory
+        # Storage-pluggable discovery plane (reference key_value_store.rs
+        # trait): any runtime.storage.KeyValueStore carries the model-entry
+        # watch and tokenizer artifacts; default is the coordinator.
+        # Endpoint *connectivity* still comes from the runtime — a
+        # local store swaps out the config/discovery plane, not the
+        # request plane.
+        self._store = store
         self._task: asyncio.Task | None = None
         self._watch = None
         self._lock = asyncio.Lock()
 
     async def start(self) -> None:
-        client = self._runtime.require_coordinator()
+        client = self._store or self._runtime.require_coordinator()
         self._watch = await client.watch_prefix(MODEL_ROOT)
         for item in self._watch.snapshot:
             await self._on_put(item["k"], item["v"])
@@ -144,8 +151,8 @@ class ModelWatcher:
             await served.client.close()
 
     async def _build(self, entry: ModelEntry) -> ServedModel:
-        coordinator = self._runtime.require_coordinator()
-        tokenizer = await fetch_tokenizer(coordinator, entry.card)
+        store = self._store or self._runtime.require_coordinator()
+        tokenizer = await fetch_tokenizer(store, entry.card)
         endpoint = (self._runtime.namespace(entry.namespace)
                     .component(entry.component).endpoint(entry.endpoint))
         client = await endpoint.client()

@@ -44,6 +44,10 @@ def load_library(name: str) -> ctypes.CDLL | None:
             detail = getattr(exc, "stderr", "") or str(exc)
             log.warning("native build of %s failed (%s); using the Python "
                         "implementation", name, detail[:500])
+            try:
+                os.unlink(tmp)  # pid-unique names would otherwise accumulate
+            except OSError:
+                pass
             return None
     try:
         return ctypes.CDLL(so)

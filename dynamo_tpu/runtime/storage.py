@@ -1,0 +1,318 @@
+"""Pluggable key-value storage behind one async surface.
+
+Reference: ``lib/runtime/src/storage/key_value_store.rs:419`` defines a
+``KeyValueStore`` trait with etcd, NATS-KV, and in-memory implementations so
+components can run against whichever backend a deployment provides. The
+TPU-native equivalent keys the trait off the coordinator client's KV surface
+(``kv_put/kv_create/kv_get/kv_get_prefix/kv_delete/kv_delete_prefix/
+watch_prefix``), so ``CoordinatorClient`` *is* one implementation already —
+this module adds the other two:
+
+- ``MemoryStore`` — in-process, zero dependencies; the static/single-process
+  mode backend (reference ``key_value_store/mem.rs``).
+- ``FileStore`` — a directory of JSON documents with cross-process polling
+  watches; persistence without any server (fills the role of the reference's
+  NATS-KV bucket for single-node deployments).
+
+Consumers (``ModelWatcher``, disagg conf, planner state) take any object with
+this surface, so discovery and config watching are storage-pluggable exactly
+as in the reference.
+
+Watch contract (matches ``coordinator_client.WatchStream``): the returned
+stream has a ``snapshot`` list of ``{"k", "v"}`` items for keys present at
+registration, then async-iterates ``{"event": "put"|"delete", "key",
+"value"}`` events, and supports ``cancel()``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import os
+from typing import Any, AsyncIterator, Protocol, runtime_checkable
+
+
+@runtime_checkable
+class KeyValueStore(Protocol):
+    """Structural trait for KV backends (reference key_value_store.rs:419).
+
+    ``CoordinatorClient`` satisfies this natively; ``MemoryStore`` and
+    ``FileStore`` below are the server-free implementations."""
+
+    async def kv_put(self, key: str, value: Any, lease_id: int | None = None,
+                     use_primary_lease: bool = False) -> int: ...
+    async def kv_create(self, key: str, value: Any,
+                        lease_id: int | None = None,
+                        use_primary_lease: bool = False) -> bool: ...
+    async def kv_get(self, key: str) -> Any | None: ...
+    async def kv_get_prefix(self, prefix: str) -> list[dict]: ...
+    async def kv_delete(self, key: str) -> bool: ...
+    async def kv_delete_prefix(self, prefix: str) -> int: ...
+    async def watch_prefix(self, prefix: str): ...
+
+
+class LocalWatch:
+    """Watch stream produced by the local stores.
+
+    Mirrors the coordinator ``WatchStream`` shape (snapshot + event queue +
+    cancel) so consumers can't tell the difference."""
+
+    def __init__(self, snapshot: list[dict], prefix: str,
+                 on_cancel=None):
+        self.snapshot = snapshot
+        self.prefix = prefix
+        self.known_keys = {item["k"] for item in snapshot}
+        self.events: asyncio.Queue[dict] = asyncio.Queue()
+        self._on_cancel = on_cancel
+        self._cancelled = False
+
+    def deliver(self, event: dict) -> None:
+        if event["event"] == "put":
+            self.known_keys.add(event["key"])
+        else:
+            self.known_keys.discard(event["key"])
+        self.events.put_nowait(event)
+
+    def __aiter__(self) -> AsyncIterator[dict]:
+        return self._iter()
+
+    async def _iter(self) -> AsyncIterator[dict]:
+        while True:
+            yield await self.events.get()
+
+    async def cancel(self) -> None:
+        if self._cancelled:
+            return
+        self._cancelled = True
+        if self._on_cancel is not None:
+            self._on_cancel(self)
+
+
+class MemoryStore:
+    """In-process KV store with prefix watches (reference mem.rs).
+
+    Lease arguments are accepted for surface compatibility and ignored —
+    there is no liveness to track inside one process."""
+
+    def __init__(self):
+        self._data: dict[str, Any] = {}
+        self._objects: dict[str, bytes] = {}
+        self._rev = 0
+        self._watches: list[LocalWatch] = []
+
+    def _notify(self, event: str, key: str, value: Any) -> None:
+        for w in self._watches:
+            if key.startswith(w.prefix):
+                w.deliver({"event": event, "key": key, "value": value})
+
+    async def kv_put(self, key: str, value: Any, lease_id: int | None = None,
+                     use_primary_lease: bool = False) -> int:
+        self._rev += 1
+        self._data[key] = value
+        self._notify("put", key, value)
+        return self._rev
+
+    async def kv_create(self, key: str, value: Any,
+                        lease_id: int | None = None,
+                        use_primary_lease: bool = False) -> bool:
+        if key in self._data:
+            return False
+        await self.kv_put(key, value)
+        return True
+
+    async def kv_get(self, key: str) -> Any | None:
+        return self._data.get(key)
+
+    async def kv_get_prefix(self, prefix: str) -> list[dict]:
+        return [{"k": k, "v": v} for k, v in sorted(self._data.items())
+                if k.startswith(prefix)]
+
+    async def kv_delete(self, key: str) -> bool:
+        if key not in self._data:
+            return False
+        self._data.pop(key)
+        self._notify("delete", key, None)
+        return True
+
+    async def kv_delete_prefix(self, prefix: str) -> int:
+        keys = [k for k in self._data if k.startswith(prefix)]
+        for k in keys:
+            await self.kv_delete(k)
+        return len(keys)
+
+    async def watch_prefix(self, prefix: str) -> LocalWatch:
+        watch = LocalWatch(await self.kv_get_prefix(prefix), prefix,
+                           on_cancel=self._watches.remove)
+        self._watches.append(watch)
+        return watch
+
+    # Object store (reference NATS object store, nats.rs:174) — carries
+    # tokenizer artifacts so model cards resolve against this store too.
+    async def object_put(self, key: str, data: bytes) -> None:
+        self._objects[key] = bytes(data)
+
+    async def object_get(self, key: str) -> bytes | None:
+        return self._objects.get(key)
+
+
+def _encode_key(key: str) -> str:
+    return base64.urlsafe_b64encode(key.encode()).decode() + ".json"
+
+
+def _decode_key(name: str) -> str | None:
+    if not name.endswith(".json"):
+        return None
+    try:
+        return base64.urlsafe_b64decode(name[:-5].encode()).decode()
+    except (ValueError, UnicodeDecodeError):
+        return None
+
+
+class FileStore:
+    """KV store over a directory of JSON documents.
+
+    Cross-process capable: every mutation is an atomic rename, revisions
+    come from a lock-protected counter file, and watches poll the directory
+    (``poll_interval``) diffing per-key revisions — put and delete events
+    are synthesized from the diff, so two processes sharing the directory
+    see each other's changes without a server."""
+
+    def __init__(self, root: str, poll_interval: float = 0.05):
+        self.root = root
+        self.poll_interval = poll_interval
+        os.makedirs(root, exist_ok=True)
+        self._watches: list[LocalWatch] = []
+        self._poll_task: asyncio.Task | None = None
+
+    # -- revision counter (flock-protected, shared across processes) --------
+    def _next_rev(self) -> int:
+        import fcntl
+        path = os.path.join(self.root, "_rev")
+        fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            raw = os.read(fd, 64)
+            rev = int(raw) + 1 if raw else 1
+            os.lseek(fd, 0, os.SEEK_SET)
+            os.ftruncate(fd, 0)
+            os.write(fd, str(rev).encode())
+            return rev
+        finally:
+            os.close(fd)  # releases the flock
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, _encode_key(key))
+
+    def _read(self, path: str) -> dict | None:
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                return json.load(fh)
+        except (FileNotFoundError, json.JSONDecodeError):
+            # JSONDecodeError: racing a concurrent atomic rename is not
+            # possible (rename is atomic), but a torn manual edit is.
+            return None
+
+    def _scan(self, prefix: str) -> dict[str, dict]:
+        out = {}
+        for name in os.listdir(self.root):
+            key = _decode_key(name)
+            if key is None or not key.startswith(prefix):
+                continue
+            doc = self._read(os.path.join(self.root, name))
+            if doc is not None:
+                out[key] = doc
+        return out
+
+    async def kv_put(self, key: str, value: Any, lease_id: int | None = None,
+                     use_primary_lease: bool = False) -> int:
+        rev = self._next_rev()
+        doc = {"k": key, "v": value, "rev": rev}
+        tmp = self._path(key) + f".tmp{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh)
+        os.replace(tmp, self._path(key))
+        return rev
+
+    async def kv_create(self, key: str, value: Any,
+                        lease_id: int | None = None,
+                        use_primary_lease: bool = False) -> bool:
+        # O_EXCL reserves the key atomically across processes; the content
+        # lands with the follow-up put. A reader racing the gap sees an
+        # empty file, which _read treats as absent — same as not-yet-created.
+        try:
+            os.close(os.open(self._path(key),
+                             os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o644))
+        except FileExistsError:
+            return False
+        await self.kv_put(key, value)
+        return True
+
+    async def kv_get(self, key: str) -> Any | None:
+        doc = self._read(self._path(key))
+        return None if doc is None else doc["v"]
+
+    async def kv_get_prefix(self, prefix: str) -> list[dict]:
+        docs = self._scan(prefix)
+        return [{"k": k, "v": d["v"]} for k, d in sorted(docs.items())]
+
+    async def kv_delete(self, key: str) -> bool:
+        try:
+            os.remove(self._path(key))
+            return True
+        except FileNotFoundError:
+            return False
+
+    async def kv_delete_prefix(self, prefix: str) -> int:
+        n = 0
+        for key in list(self._scan(prefix)):
+            n += await self.kv_delete(key)
+        return n
+
+    async def watch_prefix(self, prefix: str) -> LocalWatch:
+        docs = self._scan(prefix)
+        watch = LocalWatch([{"k": k, "v": d["v"]}
+                            for k, d in sorted(docs.items())], prefix,
+                           on_cancel=self._drop_watch)
+        watch._seen = {k: d["rev"] for k, d in docs.items()}  # per-key revs
+        self._watches.append(watch)
+        if self._poll_task is None or self._poll_task.done():
+            self._poll_task = asyncio.create_task(self._poll_loop())
+        return watch
+
+    async def object_put(self, key: str, data: bytes) -> None:
+        obj_dir = os.path.join(self.root, "objects")
+        os.makedirs(obj_dir, exist_ok=True)
+        path = os.path.join(obj_dir, _encode_key(key))
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+        os.replace(tmp, path)
+
+    async def object_get(self, key: str) -> bytes | None:
+        try:
+            with open(os.path.join(self.root, "objects", _encode_key(key)),
+                      "rb") as fh:
+                return fh.read()
+        except FileNotFoundError:
+            return None
+
+    def _drop_watch(self, watch: LocalWatch) -> None:
+        self._watches.remove(watch)
+        if not self._watches and self._poll_task is not None:
+            self._poll_task.cancel()
+            self._poll_task = None
+
+    async def _poll_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.poll_interval)
+            for w in self._watches:
+                docs = self._scan(w.prefix)
+                seen = w._seen
+                for k, d in docs.items():
+                    if seen.get(k) != d["rev"]:
+                        w.deliver({"event": "put", "key": k, "value": d["v"]})
+                for k in list(seen):
+                    if k not in docs:
+                        w.deliver({"event": "delete", "key": k, "value": None})
+                w._seen = {k: d["rev"] for k, d in docs.items()}
