@@ -1,0 +1,208 @@
+"""Deployment doctor: one command that says what's broken.
+
+Reference: ``deploy/dynamo_check.py`` — a diagnostic script that probes the
+environment (imports, GPU, etcd/NATS connectivity, registered workers) and
+prints OK/WARN/FAIL per check. The TPU-native equivalent probes:
+
+- interpreter + required libraries
+- accelerator devices visible to JAX (without forcing a compile)
+- the native extension toolchain (C++ radix index builds/loads)
+- coordinator connectivity + KV/queue/pub-sub round-trips + latency
+- registered models and live endpoint instances (with TCP reachability)
+- an HTTP frontend, when given (``/health``, ``/v1/models``)
+
+Exit code 0 = no FAIL. Run: ``python -m dynamo_tpu.doctor
+[--coordinator-url tcp://...] [--frontend-url http://...]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+import time
+
+OK, WARN, FAIL, SKIP = "OK  ", "WARN", "FAIL", "skip"
+
+
+class Report:
+    def __init__(self):
+        self.rows: list[tuple[str, str, str]] = []
+
+    def add(self, status: str, check: str, detail: str = "") -> None:
+        self.rows.append((status, check, detail))
+        print(f"[{status}] {check}" + (f" — {detail}" if detail else ""),
+              flush=True)
+
+    @property
+    def failed(self) -> bool:
+        return any(s == FAIL for s, _, _ in self.rows)
+
+
+def check_imports(rep: Report) -> None:
+    rep.add(OK, "python", sys.version.split()[0])
+    for mod in ("jax", "numpy", "aiohttp", "grpc", "transformers"):
+        try:
+            m = __import__(mod)
+            rep.add(OK, f"import {mod}", getattr(m, "__version__", ""))
+        except ImportError as exc:
+            rep.add(FAIL, f"import {mod}", str(exc))
+
+
+def check_devices(rep: Report) -> None:
+    try:
+        import jax
+        devs = jax.devices()
+        plat = devs[0].platform if devs else "none"
+        status = OK if plat in ("tpu", "axon") else WARN
+        rep.add(status, "jax devices",
+                f"{len(devs)}x {plat} ({devs[0].device_kind})" if devs
+                else "no devices")
+    except Exception as exc:  # noqa: BLE001 — any backend-init failure
+        rep.add(FAIL, "jax devices", str(exc)[:200])
+
+
+def check_native(rep: Report) -> None:
+    try:
+        from dynamo_tpu.llm.kv_router.protocols import (KvCacheEvent,
+                                                        RouterEvent)
+        from dynamo_tpu.native import radix
+        if radix.available:
+            t = radix.NativeRadixTree()
+            t.apply_event(RouterEvent(worker_id=1,
+                                      event=KvCacheEvent.stored([11, 12])))
+            assert t.find_matches([11, 12]).get(1) == 2
+            rep.add(OK, "native radix (C++)", "built + loaded + sane")
+        else:
+            rep.add(WARN, "native radix (C++)",
+                    "unavailable; Python fallback in use (g++ missing?)")
+    except Exception as exc:  # noqa: BLE001
+        rep.add(FAIL, "native radix (C++)", str(exc)[:200])
+
+
+async def check_coordinator(rep: Report, url: str) -> None:
+    from dynamo_tpu.runtime.coordinator_client import CoordinatorClient
+    hostport = url.split("://", 1)[-1]
+    if ":" not in hostport:
+        rep.add(FAIL, "coordinator connect",
+                f"{url}: expected tcp://host:port")
+        return
+    host, port = hostport.rsplit(":", 1)
+    t0 = time.monotonic()
+    try:
+        client = await asyncio.wait_for(
+            CoordinatorClient.connect(host, int(port)), timeout=5)
+    except (OSError, ValueError, asyncio.TimeoutError) as exc:
+        rep.add(FAIL, "coordinator connect", f"{url}: {exc}")
+        return
+    rep.add(OK, "coordinator connect",
+            f"{url} in {1e3 * (time.monotonic() - t0):.1f} ms")
+    try:
+        key = f"doctor/{id(client):x}"
+        t0 = time.monotonic()
+        await client.kv_put(key, {"t": time.time()})
+        assert (await client.kv_get(key)) is not None
+        await client.kv_delete(key)
+        rep.add(OK, "coordinator KV round-trip",
+                f"{1e3 * (time.monotonic() - t0):.1f} ms")
+
+        sub = await client.subscribe("doctor.ping")
+        await client.publish("doctor.ping", {"n": 1})
+        try:
+            await asyncio.wait_for(sub.messages.get(), timeout=2)
+            rep.add(OK, "coordinator pub/sub", "")
+        except asyncio.TimeoutError:
+            rep.add(FAIL, "coordinator pub/sub", "published event not seen")
+        await sub.cancel()
+
+        q = f"doctor-q-{id(client):x}"
+        await client.queue_push(q, {"n": 1})
+        got = await client.queue_pop(q, timeout=2)
+        rep.add(OK if got else FAIL, "coordinator queue",
+                "" if got else "pushed item not popped")
+
+        models = await client.kv_get_prefix("models/")
+        names = sorted({m["v"].get("model_name", "?") for m in models})
+        rep.add(OK if models else WARN, "registered models",
+                ", ".join(names) if names else "none registered")
+
+        instances = await client.kv_get_prefix("instances/")
+        rep.add(OK if instances else WARN, "live instances",
+                f"{len(instances)} registered" if instances else "none")
+        for item in instances:
+            v = item["v"]
+            where = f"{v.get('host')}:{v.get('port')}"
+            path = item["k"].split("instances/", 1)[-1]
+            try:
+                _, w = await asyncio.wait_for(
+                    asyncio.open_connection(v.get("host"), v.get("port")),
+                    timeout=2)
+                w.close()
+                rep.add(OK, f"instance {path}", f"tcp {where} reachable")
+            except (OSError, asyncio.TimeoutError) as exc:
+                rep.add(FAIL, f"instance {path}", f"tcp {where}: {exc}")
+
+        disagg = await client.kv_get_prefix("disagg/")
+        if disagg:
+            rep.add(OK, "disagg config",
+                    "; ".join(f"{d['k']}={d['v']}" for d in disagg))
+    except (ConnectionError, OSError, asyncio.TimeoutError) as exc:
+        # Coordinator died mid-check: report it, keep the doctor alive so
+        # later checks (frontend) still run.
+        rep.add(FAIL, "coordinator", f"lost mid-check: {exc}")
+    finally:
+        await client.close()
+
+
+async def check_frontend(rep: Report, url: str) -> None:
+    import aiohttp
+    url = url.rstrip("/")
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"{url}/health",
+                                   timeout=aiohttp.ClientTimeout(5)) as r:
+                rep.add(OK if r.status == 200 else FAIL, "frontend /health",
+                        f"{r.status}")
+            async with session.get(f"{url}/v1/models",
+                                   timeout=aiohttp.ClientTimeout(5)) as r:
+                body = await r.json()
+                names = [m.get("id") for m in body.get("data", [])]
+                rep.add(OK if r.status == 200 else FAIL,
+                        "frontend /v1/models",
+                        ", ".join(names) if names else "no models")
+    except (aiohttp.ClientError, OSError, asyncio.TimeoutError) as exc:
+        rep.add(FAIL, "frontend", f"{url}: {exc}")
+
+
+async def run(args) -> int:
+    rep = Report()
+    check_imports(rep)
+    if not args.no_devices:
+        check_devices(rep)
+    check_native(rep)
+    if args.coordinator_url:
+        await check_coordinator(rep, args.coordinator_url)
+    else:
+        rep.add(SKIP, "coordinator", "no --coordinator-url / DTPU_COORDINATOR_URL")
+    if args.frontend_url:
+        await check_frontend(rep, args.frontend_url)
+    n_fail = sum(1 for s, _, _ in rep.rows if s == FAIL)
+    print(f"doctor: {len(rep.rows)} checks, {n_fail} failures", flush=True)
+    return 1 if rep.failed else 0
+
+
+def main() -> None:
+    import os
+    parser = argparse.ArgumentParser(description="dynamo-tpu deployment doctor")
+    parser.add_argument("--coordinator-url",
+                        default=os.environ.get("DTPU_COORDINATOR_URL"),
+                        help="probe this control plane (tcp://host:port)")
+    parser.add_argument("--frontend-url", default=None,
+                        help="probe this OpenAI frontend (http://host:port)")
+    parser.add_argument("--no-devices", action="store_true",
+                        help="skip jax device probe (avoids backend init)")
+    sys.exit(asyncio.run(run(parser.parse_args())))
+
+
+if __name__ == "__main__":
+    main()

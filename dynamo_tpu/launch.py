@@ -1,7 +1,8 @@
 """Unified launcher: one process, pluggable input and output.
 
 Capability parity with reference dynamo-run (launch/dynamo-run/src/
-lib.rs:19-92): ``python -m dynamo_tpu.launch in=<http|text> out=<tpu|
+lib.rs:19-92, input adapters entrypoint/input/{http,grpc,text,batch}.rs):
+``python -m dynamo_tpu.launch in=<http|grpc|text|batch> out=<tpu|
 mocker|echo> [--model ...]`` assembles the whole pipeline statically —
 tokenizer, preprocessor, detokenizing backend, engine — with no
 coordinator, no registration, no network hop between frontend and engine.
@@ -69,11 +70,23 @@ def parse_args(argv=None) -> argparse.Namespace:
                         help="out=dyn: control plane to discover workers on")
     parser.add_argument("--tool-call-parser", default=None)
     parser.add_argument("--reasoning-parser", default=None)
+    parser.add_argument("--input-file", default=None,
+                        help="in=batch: JSONL of prompts ({'prompt': ...} or "
+                             "{'messages': [...]}, optional max_tokens)")
+    parser.add_argument("--output-file", default=None,
+                        help="in=batch: JSONL results path "
+                             "(default <input-file>.results.jsonl)")
+    parser.add_argument("--batch-concurrency", type=int, default=8,
+                        help="in=batch: max in-flight requests")
+    parser.add_argument("--batch-max-tokens", type=int, default=128,
+                        help="in=batch: default max_tokens per prompt")
     args = parser.parse_args(rest)
     args.input = io["in"]
     args.output = io["out"]
-    if args.input not in ("http", "text"):
-        parser.error(f"in= must be http or text, got {args.input!r}")
+    if args.input not in ("http", "grpc", "text", "batch"):
+        parser.error(f"in= must be http|grpc|text|batch, got {args.input!r}")
+    if args.input == "batch" and not args.input_file:
+        parser.error("in=batch requires --input-file")
     if args.output not in ("tpu", "mocker", "echo", "dyn"):
         parser.error(f"out= must be tpu|mocker|echo|dyn, got {args.output!r}")
     return args
@@ -145,6 +158,80 @@ async def run_text_repl(served: ServedModel) -> None:
         print(flush=True)
 
 
+async def run_batch(served: ServedModel, args) -> None:
+    """in=batch: run a JSONL file of prompts through the pipeline with
+    bounded concurrency, write one JSONL result per prompt (reference
+    entrypoint/input/batch.rs: file of prompts -> completions + timing)."""
+    import json
+    import time
+
+    jobs = []
+    with open(args.input_file, "r", encoding="utf-8") as fh:
+        for line in fh:
+            if not line.strip():
+                continue
+            try:
+                jobs.append(json.loads(line))
+            except json.JSONDecodeError as exc:
+                jobs.append(ValueError(f"unparseable JSONL line: {exc}"))
+    out_path = args.output_file or args.input_file + ".results.jsonl"
+    sem = asyncio.Semaphore(args.batch_concurrency)
+
+    async def one(idx: int, job) -> dict:
+        # Per-job isolation: a malformed line or a failed generation yields
+        # an error row instead of losing the rest of the batch.
+        try:
+            if isinstance(job, Exception):
+                raise job
+            if not isinstance(job, dict):
+                raise ValueError(f"line is {type(job).__name__}, "
+                                 "expected a JSON object")
+            messages = job.get("messages") or [
+                {"role": "user", "content": job.get("prompt", "")}]
+            req = ChatCompletionRequest(
+                model=served.name, messages=messages,
+                max_tokens=int(job.get("max_tokens", args.batch_max_tokens)),
+                temperature=job.get("temperature", 0.0), stream=True)
+            text, n_tokens, finish = [], 0, None
+            async with sem:
+                t0 = time.monotonic()
+                t_first = None
+                async for chunk in served.preprocessor.generate(req,
+                                                                Context()):
+                    for choice in chunk.get("choices", []):
+                        piece = choice.get("delta", {}).get("content")
+                        if piece:
+                            if t_first is None:
+                                t_first = time.monotonic()
+                            text.append(piece)
+                            n_tokens += 1
+                        if choice.get("finish_reason"):
+                            finish = choice["finish_reason"]
+                elapsed = time.monotonic() - t0
+            return {"index": idx, "text": "".join(text),
+                    "finish_reason": finish, "tokens": n_tokens,
+                    "elapsed_s": round(elapsed, 4),
+                    "ttft_s": round((t_first or t0) - t0, 4)}
+        except Exception as exc:  # noqa: BLE001 — keep the batch going
+            return {"index": idx, "error": f"{type(exc).__name__}: {exc}",
+                    "tokens": 0}
+
+    t0 = time.monotonic()
+    results = await asyncio.gather(*[one(i, j) for i, j in enumerate(jobs)])
+    elapsed = time.monotonic() - t0
+    with open(out_path, "w", encoding="utf-8") as fh:
+        for r in results:
+            fh.write(json.dumps(r) + "\n")
+    total_tokens = sum(r["tokens"] for r in results)
+    n_errors = sum(1 for r in results if "error" in r)
+    print(json.dumps({
+        "batch_prompts": len(jobs), "errors": n_errors,
+        "output_tokens": total_tokens,
+        "elapsed_s": round(elapsed, 3),
+        "tok_s": round(total_tokens / elapsed, 1) if elapsed else 0.0,
+        "results": out_path}), flush=True)
+
+
 async def run(args) -> None:
     if args.output == "dyn":
         cfg = RuntimeConfig.from_settings()
@@ -162,10 +249,24 @@ async def run(args) -> None:
         manager.models[served.name] = served
         watcher = None
     try:
-        if args.input == "text":
+        if args.input in ("text", "batch"):
             if args.output == "dyn":
-                raise SystemExit("in=text requires a local out= engine")
-            await run_text_repl(served)
+                raise SystemExit(f"in={args.input} requires a local out= "
+                                 "engine")
+            if args.input == "text":
+                await run_text_repl(served)
+            else:
+                await run_batch(served, args)
+            return
+        if args.input == "grpc":
+            from dynamo_tpu.grpc.kserve import make_server
+            server, port = make_server(manager, host=args.http_host,
+                                       port=args.http_port)
+            await server.start()
+            print(f"LAUNCH_READY in=grpc out={args.output} port={port}",
+                  flush=True)
+            await runtime.wait_for_shutdown()
+            await server.stop(grace=1.0)
             return
         service = HttpService(runtime, manager, host=args.http_host,
                               port=args.http_port)

@@ -186,7 +186,11 @@ class FileStore:
         self._poll_task: asyncio.Task | None = None
 
     # -- revision counter (flock-protected, shared across processes) --------
-    def _next_rev(self) -> int:
+    def _with_rev_lock(self, fn):
+        """Run ``fn(next_rev)`` while holding the cross-process revision
+        lock. Mutations happen inside the lock so revision order and file
+        order can't diverge (two same-key writers racing os.replace would
+        otherwise let the older revision land last and win)."""
         import fcntl
         path = os.path.join(self.root, "_rev")
         fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
@@ -197,6 +201,7 @@ class FileStore:
             os.lseek(fd, 0, os.SEEK_SET)
             os.ftruncate(fd, 0)
             os.write(fd, str(rev).encode())
+            fn(rev)
             return rev
         finally:
             os.close(fd)  # releases the flock
@@ -220,19 +225,19 @@ class FileStore:
             if key is None or not key.startswith(prefix):
                 continue
             doc = self._read(os.path.join(self.root, name))
-            if doc is not None:
+            if doc is not None and "rev" in doc and "v" in doc:
                 out[key] = doc
         return out
 
     async def kv_put(self, key: str, value: Any, lease_id: int | None = None,
                      use_primary_lease: bool = False) -> int:
-        rev = self._next_rev()
-        doc = {"k": key, "v": value, "rev": rev}
-        tmp = self._path(key) + f".tmp{os.getpid()}"
-        with open(tmp, "w", encoding="utf-8") as fh:
-            json.dump(doc, fh)
-        os.replace(tmp, self._path(key))
-        return rev
+        def write(rev: int) -> None:
+            doc = {"k": key, "v": value, "rev": rev}
+            tmp = self._path(key) + f".tmp{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh)
+            os.replace(tmp, self._path(key))
+        return self._with_rev_lock(write)
 
     async def kv_create(self, key: str, value: Any,
                         lease_id: int | None = None,
@@ -307,12 +312,19 @@ class FileStore:
         while True:
             await asyncio.sleep(self.poll_interval)
             for w in self._watches:
-                docs = self._scan(w.prefix)
-                seen = w._seen
-                for k, d in docs.items():
-                    if seen.get(k) != d["rev"]:
-                        w.deliver({"event": "put", "key": k, "value": d["v"]})
-                for k in list(seen):
-                    if k not in docs:
-                        w.deliver({"event": "delete", "key": k, "value": None})
-                w._seen = {k: d["rev"] for k, d in docs.items()}
+                try:
+                    docs = self._scan(w.prefix)
+                    seen = w._seen
+                    for k, d in docs.items():
+                        if seen.get(k) != d["rev"]:
+                            w.deliver({"event": "put", "key": k,
+                                       "value": d["v"]})
+                    for k in list(seen):
+                        if k not in docs:
+                            w.deliver({"event": "delete", "key": k,
+                                       "value": None})
+                    w._seen = {k: d["rev"] for k, d in docs.items()}
+                except OSError:
+                    # Transient filesystem trouble (NFS hiccup, dir
+                    # recreated): skip this tick, keep the watch alive.
+                    continue

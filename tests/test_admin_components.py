@@ -163,3 +163,74 @@ async def test_multinode_barrier_gates_worker_group():
         await rt0.close()
         await rt1.close()
         await coord.stop()
+
+
+# -- deployment doctor (reference deploy/dynamo_check.py) ---------------------
+
+@async_test
+async def test_doctor_against_live_coordinator():
+    from dynamo_tpu.doctor import FAIL, Report, check_coordinator, check_native
+    from dynamo_tpu.runtime.coordinator import Coordinator
+    from dynamo_tpu.runtime.coordinator_client import CoordinatorClient
+
+    coord = Coordinator()
+    await coord.start()
+    client = await CoordinatorClient.connect("127.0.0.1", coord.port)
+    # A live instance backed by a real listening socket, and one dead one.
+    server = await asyncio.start_server(lambda r, w: w.close(),
+                                        "127.0.0.1", 0)
+    live_port = server.sockets[0].getsockname()[1]
+    await client.kv_put("instances/ns/c/e/1", {
+        "namespace": "ns", "component": "c", "endpoint": "e",
+        "instance_id": 1, "host": "127.0.0.1", "port": live_port})
+    await client.kv_put("models/m/1", {"model_name": "m"})
+    try:
+        rep = Report()
+        check_native(rep)
+        await check_coordinator(rep, f"tcp://127.0.0.1:{coord.port}")
+        by_check = {c: s for s, c, _ in rep.rows}
+        assert by_check["coordinator connect"].strip() == "OK"
+        assert by_check["coordinator KV round-trip"].strip() == "OK"
+        assert by_check["coordinator pub/sub"].strip() == "OK"
+        assert by_check["coordinator queue"].strip() == "OK"
+        assert by_check["registered models"].strip() == "OK"
+        assert by_check["instance ns/c/e/1"].strip() == "OK"
+        assert not rep.failed
+        # Dead instance -> FAIL row, nonzero posture.
+        await client.kv_put("instances/ns/c/e/2", {
+            "namespace": "ns", "component": "c", "endpoint": "e",
+            "instance_id": 2, "host": "127.0.0.1", "port": 1})
+        rep2 = Report()
+        await check_coordinator(rep2, f"tcp://127.0.0.1:{coord.port}")
+        assert any(s == FAIL and "ns/c/e/2" in c for s, c, _ in rep2.rows)
+    finally:
+        server.close()
+        await client.close()
+        await coord.stop()
+
+
+def test_grafana_dashboard_matches_registered_metrics():
+    """Drift guard: every metric the dashboard queries must be one the code
+    actually registers (name as constructed by MetricsRegistry: the
+    dynamo_tpu_ prefix + the registration name)."""
+    import json
+    import pathlib
+    import re
+
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    dash = json.loads((repo / "deploy/metrics/grafana-dashboard.json")
+                      .read_text())
+    wanted = set()
+    for p in dash["panels"]:
+        for t in p["targets"]:
+            for name in re.findall(r"dynamo_tpu_[a-z_]+", t["expr"]):
+                wanted.add(re.sub(r"_bucket$", "", name)
+                           .removeprefix("dynamo_tpu_"))
+    registered = set()
+    for src in (repo / "dynamo_tpu").rglob("*.py"):
+        for m in re.finditer(
+                r"\.(?:counter|gauge|histogram)\(\s*\n?\s*\"([a-z_]+)\"",
+                src.read_text()):
+            registered.add(m.group(1))
+    missing = wanted - registered
+    assert not missing, f"dashboard queries unregistered metrics: {missing}"

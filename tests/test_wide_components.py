@@ -160,10 +160,40 @@ def test_launch_arg_parsing():
     from dynamo_tpu.launch import parse_args
     a = parse_args(["in=text", "out=mocker"])
     assert a.input == "text" and a.output == "mocker"
+    a = parse_args(["in=grpc", "out=tpu"])
+    assert a.input == "grpc"
     with pytest.raises(SystemExit):
-        parse_args(["in=grpc", "out=tpu"])
+        parse_args(["in=ftp", "out=tpu"])
     with pytest.raises(SystemExit):
         parse_args(["out=cuda"])
+    with pytest.raises(SystemExit):
+        parse_args(["in=batch", "out=echo"])  # requires --input-file
+
+
+@async_test
+async def test_launcher_batch_input(tmp_path):
+    """in=batch: JSONL prompts -> JSONL completions with timing (reference
+    entrypoint/input/batch.rs)."""
+    import json
+
+    from dynamo_tpu.launch import build_local_served, parse_args, run_batch
+    in_file = tmp_path / "prompts.jsonl"
+    in_file.write_text(
+        json.dumps({"prompt": "hello", "max_tokens": 4}) + "\n"
+        + json.dumps({"messages": [{"role": "user", "content": "hi"}]}) + "\n")
+    args = parse_args(["in=batch", "out=echo", "--input-file", str(in_file),
+                       "--batch-max-tokens", "8"])
+    served, engine = build_local_served(args)
+    try:
+        await run_batch(served, args)
+    finally:
+        engine.stop() if hasattr(engine, "stop") else None
+    out_file = tmp_path / "prompts.jsonl.results.jsonl"
+    rows = [json.loads(l) for l in out_file.read_text().splitlines()]
+    assert len(rows) == 2
+    assert rows[0]["index"] == 0 and rows[0]["tokens"] >= 1
+    assert all(r["finish_reason"] for r in rows)
+    assert all(r["elapsed_s"] >= r["ttft_s"] >= 0 for r in rows)
 
 
 @async_test
