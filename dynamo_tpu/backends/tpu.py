@@ -13,6 +13,14 @@ conditionally forwards long prompts to discovered prefill workers
 (``--max-local-prefill-length``, reference disagg_router.rs:25-45), injects
 the transferred KV, and decodes. ``--mode agg`` (default) is fully local.
 Handlers live in dynamo_tpu.llm.disagg; e2e-tested in tests/test_disagg.py.
+
+Multi-node (reference engines.rs:31-44 MultiNodeConfig): ``--num-nodes N
+--node-rank R`` alone coordinates a per-host replica group over the
+leader/worker barrier. With ``JAX_COORDINATOR_ADDRESS=host:port`` it
+instead runs ONE engine whose mesh spans every host's chips
+(multi-controller SPMD): rank 0 serves and publishes its device-dispatch
+stream, ranks >0 replay it (engine/multihost.py); e2e-tested in
+tests/test_multihost.py.
 """
 
 from __future__ import annotations
@@ -88,6 +96,12 @@ def parse_args(argv=None) -> argparse.Namespace:
                              "all replicas agree on model + mesh shape "
                              "before any serves")
     parser.add_argument("--node-rank", type=int, default=0)
+    parser.add_argument("--mh-group", default=None,
+                        help="multi-host group id (default: model name). "
+                             "REQUIRED to be distinct per group when two "
+                             "multi-host groups of the same model share a "
+                             "coordinator — it keys the dispatch stream "
+                             "and bring-up barrier")
     return parser.parse_args(argv)
 
 
@@ -114,6 +128,20 @@ async def run(args: argparse.Namespace) -> None:
         cfg.coordinator_url = args.coordinator_url
     if args.namespace:
         cfg.namespace = args.namespace
+    # Multi-host SINGLE engine (one jax.distributed mesh spanning hosts):
+    # gated on JAX_COORDINATOR_ADDRESS + --num-nodes. Must initialize
+    # before any JAX backend use.
+    mh_addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    multihost_engine = args.num_nodes > 1 and bool(mh_addr)
+    if multihost_engine:
+        if args.mode != "agg" or args.host_cache_pages or \
+                args.kv_disk_cache_dir:
+            raise SystemExit(
+                "multi-host single-engine mode supports aggregated serving "
+                "only: KV parcel extract/insert (disagg, host/disk tiers) "
+                "needs a cross-host gather that is not implemented")
+        from dynamo_tpu.engine import multihost
+        multihost.initialize(mh_addr, args.num_nodes, args.node_rank)
     runtime = await DistributedRuntime.from_settings(cfg)
     try:
         engine_cfg = build_engine_config(args)
@@ -138,22 +166,32 @@ async def run(args: argparse.Namespace) -> None:
             return TPUEngine(engine_cfg, params=params, kv_publisher=kv_pub,
                              metrics_publisher=metrics_pub)
 
-        if args.num_nodes > 1:
+        mh_group = (args.mh_group
+                    or f"eng-{engine_cfg.model.name}").replace("/", "-")
+        if multihost_engine and args.node_rank > 0:
+            # SPMD follower: replay the leader's dispatch stream on this
+            # host's shard of the global mesh. No registration, no HTTP.
+            from dynamo_tpu.engine import multihost
+            params = None
+            if ckpt is not None:
+                from dynamo_tpu.engine.weights import load_hf_weights
+                params = await asyncio.get_running_loop().run_in_executor(
+                    None, lambda: load_hf_weights(engine_cfg.model, ckpt))
+            print(f"TPU_FOLLOWER_READY rank={args.node_rank}", flush=True)
+            await multihost.run_follower(
+                engine_cfg, runtime.require_coordinator(), mh_group,
+                args.node_rank, params=params)
+            return
+
+        if args.num_nodes > 1 and not multihost_engine:
             # Multi-node worker GROUP: each host runs its own single-host
             # mesh (a dp-style replica set) and the leader/worker barrier
             # coordinates bring-up — every host must agree on the model +
             # mesh shape before any of them starts serving (reference
-            # multi-node bootstrap, leader_worker_barrier.rs). A SINGLE
-            # engine spanning hosts (one jax.distributed mesh) needs an
-            # SPMD follower driver that replays the leader's dispatch
-            # sequence on every process; refuse rather than hang on the
-            # first cross-host collective.
-            if os.environ.get("JAX_COORDINATOR_ADDRESS"):
-                raise SystemExit(
-                    "multi-host SINGLE-engine execution (jax.distributed "
-                    "mesh) requires the SPMD follower driver, which is not "
-                    "implemented; unset JAX_COORDINATOR_ADDRESS and use "
-                    "--num-nodes for a coordinated per-host replica group")
+            # multi-node bootstrap, leader_worker_barrier.rs). For a
+            # SINGLE engine spanning hosts, set JAX_COORDINATOR_ADDRESS:
+            # rank 0 serves through engine/multihost.LeaderRunner and the
+            # other ranks replay its dispatch stream (handled above).
             from dynamo_tpu.runtime.barrier import (LeaderBarrier,
                                                     WorkerBarrier)
             client = runtime.require_coordinator()
@@ -177,6 +215,19 @@ async def run(args: argparse.Namespace) -> None:
         # coordinator lease keepalives keep flowing.
         engine = await asyncio.get_running_loop().run_in_executor(
             None, build_engine)
+        if multihost_engine:
+            # Leader: publish every device call to the follower replay
+            # stream, and hold serving until every follower is listening.
+            from dynamo_tpu.engine import multihost
+            engine.runner = multihost.LeaderRunner(
+                engine.runner, runtime.require_coordinator(),
+                asyncio.get_running_loop(), mh_group)
+            await multihost.leader_barrier(
+                runtime.require_coordinator(), mh_group, args.num_nodes - 1,
+                {"model": engine_cfg.model.name,
+                 "mesh": [args.dp, args.pp, args.sp, args.tp]})
+            log.info("multihost leader: %d followers in lockstep",
+                     args.num_nodes - 1)
         from dynamo_tpu.llm.disagg import (
             PREFILL_COMPONENT, PREFILL_ENDPOINT, DisaggDecodeHandler,
             DisaggRouterConfig, make_prefill_handler)
@@ -233,6 +284,17 @@ async def run(args: argparse.Namespace) -> None:
                 pass
         await runtime.wait_for_shutdown()
         engine.stop()
+        if multihost_engine:
+            # Engine loop is drained — no more dispatches can race this.
+            from dynamo_tpu.engine import multihost
+            try:
+                await runtime.require_coordinator().publish(
+                    multihost.DISPATCH_SUBJECT.format(group=mh_group),
+                    {"m": "stop"})
+            except (ConnectionError, OSError):
+                # Coordinator already gone (whole-deployment teardown);
+                # followers exit with it.
+                pass
         await server.shutdown()
     finally:
         await runtime.close()

@@ -41,12 +41,18 @@ class Report:
 
 def check_imports(rep: Report) -> None:
     rep.add(OK, "python", sys.version.split()[0])
-    for mod in ("jax", "numpy", "aiohttp", "grpc", "transformers"):
+    # grpc/transformers are optional extras (gRPC frontend, HF
+    # checkpoints): a core aggregated-serving node is healthy without
+    # them, so missing ones WARN rather than FAIL.
+    for mod, required in (("jax", True), ("numpy", True),
+                          ("msgpack", True), ("aiohttp", True),
+                          ("grpc", False), ("transformers", False)):
         try:
             m = __import__(mod)
             rep.add(OK, f"import {mod}", getattr(m, "__version__", ""))
         except ImportError as exc:
-            rep.add(FAIL, f"import {mod}", str(exc))
+            rep.add(FAIL if required else WARN, f"import {mod}",
+                    str(exc) if required else "optional; not installed")
 
 
 def check_devices(rep: Report) -> None:
@@ -81,18 +87,19 @@ def check_native(rep: Report) -> None:
 
 
 async def check_coordinator(rep: Report, url: str) -> None:
+    from dynamo_tpu.runtime.config import RuntimeConfig
     from dynamo_tpu.runtime.coordinator_client import CoordinatorClient
-    hostport = url.split("://", 1)[-1]
-    if ":" not in hostport:
+    try:
+        host, port = RuntimeConfig(coordinator_url=url).coordinator_addr
+    except ValueError:
         rep.add(FAIL, "coordinator connect",
                 f"{url}: expected tcp://host:port")
         return
-    host, port = hostport.rsplit(":", 1)
     t0 = time.monotonic()
     try:
         client = await asyncio.wait_for(
-            CoordinatorClient.connect(host, int(port)), timeout=5)
-    except (OSError, ValueError, asyncio.TimeoutError) as exc:
+            CoordinatorClient.connect(host, port), timeout=5)
+    except (OSError, asyncio.TimeoutError) as exc:
         rep.add(FAIL, "coordinator connect", f"{url}: {exc}")
         return
     rep.add(OK, "coordinator connect",

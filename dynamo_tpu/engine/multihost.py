@@ -1,0 +1,287 @@
+"""Multi-host single engine: SPMD leader/follower runner drive.
+
+Capability parity with the reference's multi-node single engine
+(``lib/llm/src/engines.rs:31-44`` ``MultiNodeConfig{num_nodes, node_rank,
+leader_addr}`` + the etcd leader/worker barrier,
+``lib/runtime/src/utils/leader_worker_barrier.rs:137,230``), designed
+TPU-first: one JAX computation spans every host's chips via a global
+``Mesh`` (multi-controller SPMD), so tensor/pipeline shardings ride
+ICI/DCN through XLA collectives — there is no NCCL/MPI layer to port.
+
+How it works:
+
+- Every host calls :func:`initialize` (``jax.distributed.initialize``),
+  making ``jax.devices()`` the global device list. The ``ModelRunner``
+  builds its mesh over those devices unchanged.
+- JAX multi-controller semantics require every process to issue the SAME
+  jit calls in the SAME order. Only the leader runs the serving engine
+  (scheduler, HTTP, KV ledger); its runner is wrapped in
+  :class:`LeaderRunner`, which publishes each device call's control
+  payload (numpy arrays, a few KB) on the coordinator pub/sub before
+  executing it.
+- Followers run :func:`run_follower`: a replay loop that applies the same
+  calls to their own ``ModelRunner`` replica. Control payloads are
+  identical, the rng is threaded through the jit state, so every process
+  dispatches an identical program and XLA's collectives line up.
+- Bring-up is coordinated by the existing leader/worker barrier: the
+  leader blocks until every follower has built its runner and subscribed,
+  so no dispatch can be published before a follower is listening.
+
+Scope: the serving hot path (``prefill_batch``, ``decode_window``,
+``prefill``, ``embed``). KV parcel extract/insert (disaggregation) and
+host-tier offload fetch per-device shards and are leader-local operations;
+they raise in multi-host mode until a cross-host gather path exists.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import queue
+import threading
+from typing import Any
+
+import numpy as np
+
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("multihost")
+
+DISPATCH_SUBJECT = "mh.{group}.dispatch"
+BARRIER_ID = "mh/{group}/bringup"
+
+
+def initialize(coordinator_address: str, num_processes: int,
+               process_id: int) -> None:
+    """``jax.distributed.initialize`` with CPU-backend collectives enabled
+    (tests run N processes on one machine with gloo; on TPU pods the
+    backend does this natively over ICI/DCN)."""
+    import jax
+
+    # Decide from the environment, NOT jax.default_backend(): that call
+    # would initialise the XLA backend, which must not happen before
+    # distributed.initialize.
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    log.info("multihost initialized: process %d/%d, %d global devices",
+             process_id, num_processes, jax.device_count())
+
+
+# -- wire helpers -------------------------------------------------------------
+
+def _pack_array(a) -> dict | None:
+    if a is None:
+        return None
+    a = np.ascontiguousarray(a)
+    return {"b": a.tobytes(), "dtype": str(a.dtype), "shape": list(a.shape)}
+
+
+def _unpack_array(d: dict | None):
+    if d is None:
+        return None
+    return np.frombuffer(d["b"], dtype=d["dtype"]).reshape(d["shape"])
+
+
+def _pack_seq(s) -> dict:
+    return {"tokens": _pack_array(s.tokens), "start_pos": int(s.start_pos),
+            "chunk_pages": _pack_array(s.chunk_pages),
+            "hist_pages": _pack_array(s.hist_pages),
+            "sampling": [float(s.sampling[0]), int(s.sampling[1]),
+                         float(s.sampling[2])],
+            "logprobs": bool(s.logprobs)}
+
+
+def _unpack_seq(d: dict):
+    from dynamo_tpu.engine.runner import PrefillSeq
+    t, k, p = d["sampling"]
+    return PrefillSeq(tokens=_unpack_array(d["tokens"]),
+                      start_pos=d["start_pos"],
+                      chunk_pages=_unpack_array(d["chunk_pages"]),
+                      hist_pages=_unpack_array(d["hist_pages"]),
+                      sampling=(float(t), int(k), float(p)),
+                      logprobs=d["logprobs"])
+
+
+class LeaderRunner:
+    """Wraps the leader's ModelRunner: every device call is published to
+    the follower replay stream (in submission order — one event loop, one
+    coordinator connection) and then executed locally. Engine code treats
+    it exactly like a ModelRunner."""
+
+    def __init__(self, inner, client, loop: asyncio.AbstractEventLoop,
+                 group: str):
+        self._inner = inner
+        self._client = client
+        self._loop = loop
+        self._subject = DISPATCH_SUBJECT.format(group=group)
+        self._seq = 0
+        self._prev_fut = None
+
+    def __getattr__(self, name: str) -> Any:
+        # Non-dispatching surface (mesh, num_pages, bucket_pages_for, ...)
+        # passes straight through.
+        return getattr(self._inner, name)
+
+    def _publish(self, msg: dict) -> None:
+        self._seq += 1
+        msg["n"] = self._seq
+        fut = asyncio.run_coroutine_threadsafe(
+            self._client.publish(self._subject, msg), self._loop)
+        # Surface transport failures instead of silently diverging (a
+        # dropped dispatch would desynchronize every follower) — but
+        # pipelined by one: await the PREVIOUS dispatch's ack, not this
+        # one's, so the engine thread doesn't pay a coordinator RTT
+        # inline per window. Ordering is already fixed by the single
+        # event loop + connection; fail-fast just lands one window late.
+        prev, self._prev_fut = self._prev_fut, fut
+        if prev is not None:
+            prev.result(timeout=30.0)
+
+    def prefill_batch(self, seqs, slots=None):
+        self._publish({"m": "prefill_batch",
+                       "seqs": [_pack_seq(s) for s in seqs],
+                       "slots": None if slots is None
+                       else [int(x) for x in slots]})
+        return self._inner.prefill_batch(seqs, slots)
+
+    def prefill(self, tokens, start_pos, chunk_pages, hist_pages, sampling):
+        from dynamo_tpu.engine.runner import PrefillSeq
+        self._publish({"m": "prefill", "seq": _pack_seq(PrefillSeq(
+            tokens=np.asarray(tokens, np.int32), start_pos=start_pos,
+            chunk_pages=np.asarray(chunk_pages, np.int32),
+            hist_pages=hist_pages, sampling=sampling))})
+        return self._inner.prefill(tokens, start_pos, chunk_pages,
+                                   hist_pages, sampling)
+
+    def decode_window(self, packed: np.ndarray, window: int):
+        self._publish({"m": "decode_window", "packed": _pack_array(packed),
+                       "window": int(window)})
+        return self._inner.decode_window(packed, window)
+
+    def embed(self, token_lists, pooling: str = "last"):
+        self._publish({"m": "embed",
+                       "token_lists": [[int(t) for t in row]
+                                       for row in token_lists],
+                       "pooling": pooling})
+        return self._inner.embed(token_lists, pooling)
+
+    # Leader-local per-device-shard operations: replaying them would not
+    # help (each process sees only its shards) — cross-host KV gather is
+    # future work.
+    def extract_pages(self, pages):
+        raise NotImplementedError("KV extract is not supported in "
+                                  "multi-host mode yet")
+
+    def extract_pages_async(self, pages):
+        raise NotImplementedError("KV extract is not supported in "
+                                  "multi-host mode yet")
+
+    def insert_pages(self, kv, pages):
+        raise NotImplementedError("KV insert is not supported in "
+                                  "multi-host mode yet")
+
+
+async def leader_barrier(client, group: str, num_followers: int,
+                         shape: dict, timeout: float = 300.0) -> None:
+    """Block until every follower has its runner built and subscription
+    live. ``shape`` (model/mesh facts) is cross-checked by followers."""
+    from dynamo_tpu.runtime.barrier import LeaderBarrier
+    await LeaderBarrier(client, BARRIER_ID.format(group=group),
+                        num_followers).sync(shape, timeout=timeout)
+
+
+async def run_follower(config, client, group: str, node_rank: int,
+                       params=None, seed: int = 0) -> None:
+    """Build the runner replica, join the bring-up barrier, then replay
+    leader dispatches until a stop message (or cancellation).
+
+    Runner calls execute on a dedicated thread (device work can block for
+    seconds during compilation; the event loop must keep servicing the
+    coordinator connection's keepalives)."""
+    from dynamo_tpu.engine.runner import ModelRunner
+    from dynamo_tpu.runtime.barrier import WorkerBarrier
+
+    # Build off the event loop: weight load + sharded upload blocks for
+    # seconds and the coordinator keepalives must keep flowing.
+    runner = await asyncio.get_running_loop().run_in_executor(
+        None, lambda: ModelRunner(config, params=params, seed=seed))
+    sub = await client.subscribe(DISPATCH_SUBJECT.format(group=group))
+    shape = await WorkerBarrier(
+        client, BARRIER_ID.format(group=group), str(node_rank)).sync(
+            {"rank": node_rank})
+    expect = {"model": config.model.name,
+              "mesh": [config.dp, config.pp, config.sp, config.tp]}
+    got = {k: shape.get(k) for k in expect}
+    if got != expect:
+        raise RuntimeError(f"follower/leader config mismatch: leader "
+                           f"published {got}, follower built {expect}")
+    log.info("follower %d: runner built, replaying dispatches", node_rank)
+
+    loop = asyncio.get_running_loop()
+    work: queue.Queue = queue.Queue()
+    done = asyncio.Event()  # set (thread-safely) when the replay thread exits
+    errors: list[BaseException] = []
+
+    def replay_loop() -> None:
+        n_seen = 0
+        while True:
+            msg = work.get()
+            if msg is None or msg.get("m") == "stop":
+                break
+            try:
+                n = msg.get("n", 0)
+                if n_seen and n != n_seen + 1:
+                    raise RuntimeError(
+                        f"dispatch stream gap: saw {n} after {n_seen}")
+                n_seen = n
+                m = msg["m"]
+                if m == "prefill_batch":
+                    runner.prefill_batch(
+                        [_unpack_seq(s) for s in msg["seqs"]], msg["slots"])
+                elif m == "prefill":
+                    s = _unpack_seq(msg["seq"])
+                    runner.prefill(s.tokens, s.start_pos, s.chunk_pages,
+                                   s.hist_pages, s.sampling)
+                elif m == "decode_window":
+                    runner.decode_window(_unpack_array(msg["packed"]),
+                                         msg["window"])
+                elif m == "embed":
+                    runner.embed(msg["token_lists"], msg["pooling"])
+                else:
+                    raise RuntimeError(f"unknown dispatch {m!r}")
+            except BaseException as exc:  # noqa: BLE001 — report and die
+                errors.append(exc)
+                break
+        loop.call_soon_threadsafe(done.set)
+
+    thread = threading.Thread(target=replay_loop, name="mh-replay",
+                              daemon=True)
+    thread.start()
+    sub_iter = sub.__aiter__()
+    try:
+        # Race each subscription read against replay-thread death: a
+        # replay error during an idle stretch must surface immediately,
+        # not after the next dispatch happens to arrive.
+        while not done.is_set():
+            get_next = asyncio.ensure_future(sub_iter.__anext__())
+            died = asyncio.ensure_future(done.wait())
+            finished, _ = await asyncio.wait(
+                {get_next, died}, return_when=asyncio.FIRST_COMPLETED)
+            died.cancel()
+            if get_next not in finished:
+                get_next.cancel()
+                break
+            event = get_next.result()
+            work.put(event["payload"])
+            if event["payload"].get("m") == "stop":
+                break
+    finally:
+        work.put(None)
+        await sub.cancel()
+    await done.wait()
+    if errors:
+        raise errors[0]
+    log.info("follower %d: stopped", node_rank)

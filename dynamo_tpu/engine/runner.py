@@ -88,6 +88,29 @@ class PrefillSeq:
     logprobs: bool = False      # row wants first-token logprobs
 
 
+def _mh_put(value, sharding):
+    """Place a host-resident full array onto the mesh. In multi-controller
+    mode (jax.process_count() > 1, multi-host serving) a plain device_put
+    of host data onto a cross-host sharding is illegal — each process
+    instead contributes its addressable shards via make_array_from_callback
+    (every process holds the identical full value, so shards agree)."""
+    if jax.process_count() > 1:
+        arr = np.asarray(value)
+        return jax.make_array_from_callback(arr.shape, sharding,
+                                            lambda idx: arr[idx])
+    return jax.device_put(value, sharding)
+
+
+def _mh_zeros(shape, dtype, sharding):
+    """Sharded zeros that never materialize on one host: compiled creation
+    places each shard directly on its device, which is both multi-host-legal
+    and HBM-friendly for multi-GB KV pools."""
+    if jax.process_count() > 1:
+        return jax.jit(lambda: jnp.zeros(shape, dtype),
+                       out_shardings=sharding)()
+    return jax.device_put(jnp.zeros(shape, dtype), sharding)
+
+
 class ModelRunner:
     def __init__(self, config: EngineConfig, params=None,
                  devices: list | None = None, seed: int = 0):
@@ -151,14 +174,17 @@ class ModelRunner:
             is_leaf=lambda x: isinstance(x, P))
         if params is None:
             key = jax.random.key(seed)
-            with jax.default_device(jax.devices("cpu")[0]):
+            # local_devices, not devices: in multi-controller mode the
+            # global cpu list starts with rank 0's device, and arrays
+            # initialized onto a non-addressable device can't be read.
+            with jax.default_device(jax.local_devices(backend="cpu")[0]):
                 # Init the CANONICAL shape so tp variants of one logical
                 # model share identical parameters.
                 params = init_params(self.canonical_spec, key)
         if self.kv_rep > 1:
             params = _replicate_kv_heads(params, self.canonical_spec,
                                          self.kv_rep)
-        self.params = jax.device_put(params, shardings)
+        self.params = jax.tree.map(_mh_put, params, shardings)
 
         # KV cache arrays [L, Nkv, P, page, D]: layers sharded over pp
         # (pages live with their layer's stage), kv heads over tp, and
@@ -167,16 +193,16 @@ class ModelRunner:
         self.kv_sharding = NamedSharding(self.mesh, kv_spec)
         kv_shape = (spec.num_layers, spec.num_kv_heads, self.num_pages,
                     config.page_size, spec.head_dim)
-        self.k_cache = jax.device_put(
-            jnp.zeros(kv_shape, jnp.bfloat16), self.kv_sharding)
-        self.v_cache = jax.device_put(
-            jnp.zeros(kv_shape, jnp.bfloat16), self.kv_sharding)
+        self.k_cache = _mh_zeros(kv_shape, jnp.bfloat16, self.kv_sharding)
+        self.v_cache = _mh_zeros(kv_shape, jnp.bfloat16, self.kv_sharding)
 
         self._prefill_cache: dict = {}
         self._decode_fn = None
         self._window_cache: dict = {}
         self._rng = jax.random.key(seed + 1)
-        self.tokens_dev = jnp.zeros((config.max_num_seqs,), jnp.int32)
+        self.tokens_dev = _mh_zeros(
+            (config.max_num_seqs,), jnp.int32,
+            NamedSharding(self.mesh, P()))
         self._attention_impl, self._window_attention_impl = \
             self._pick_attention()
 
