@@ -225,7 +225,10 @@ async def run(args: argparse.Namespace) -> None:
             await multihost.leader_barrier(
                 runtime.require_coordinator(), mh_group, args.num_nodes - 1,
                 {"model": engine_cfg.model.name,
-                 "mesh": [args.dp, args.pp, args.sp, args.tp]})
+                 "mesh": [args.dp, args.pp, args.sp, args.tp],
+                 # Followers adopt the leader's ACTUAL pool size so
+                 # auto-sizing can never diverge across hosts.
+                 "num_pages": engine.runner.num_pages})
             log.info("multihost leader: %d followers in lockstep",
                      args.num_nodes - 1)
         from dynamo_tpu.llm.disagg import (

@@ -1,0 +1,299 @@
+"""Graph deployment renderer: one spec -> the whole serving topology.
+
+Reference: the Go operator's ``DynamoGraphDeployment`` CRD
+(``deploy/cloud/operator/api/v1alpha1/dynamocomponentdeployment_types.go``,
+graph composition in ``internal/dynamo/graph.go``) reconciles a declarative
+multi-component inference graph into Deployments/Services. The TPU-native
+equivalent is a renderer (operator-optional posture, ``deploy/README.md``):
+
+    python -m dynamo_tpu.deploy_graph graph.yaml -o manifests/
+
+takes a graph spec and emits ready-to-apply Kubernetes YAML — coordinator,
+frontend(s), per-role worker StatefulSets (aggregated / prefill / decode /
+multi-host groups), the metrics aggregator, and the planner — wiring
+coordinator URLs, modes, parallelism flags, TPU node selectors, and
+resource requests consistently. A CI-style validation pass catches graph
+errors (unknown roles, chip/parallelism mismatches) before anything
+touches a cluster.
+
+Graph spec shape (all sections optional except ``name`` + ``workers``)::
+
+    name: llama-disagg
+    image: registry/dynamo-tpu:latest
+    model: llama-3-8b
+    frontend: {replicas: 2, router_mode: kv, http_port: 8000}
+    workers:
+      decode:  {mode: decode, replicas: 4, tp: 4, chips: 4,
+                tpu: {accelerator: tpu-v5-lite-podslice, topology: 2x2}}
+      prefill: {mode: prefill, replicas: 2, tp: 4, chips: 4}
+    planner: {enabled: true, min_replicas: 1, max_replicas: 8}
+    metrics: {enabled: true}
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Any
+
+import yaml
+
+DEFAULT_TPU = {"accelerator": "tpu-v5-lite-podslice", "topology": "2x4"}
+
+
+class GraphError(ValueError):
+    pass
+
+
+def _component_name(graph_name: str, role: str) -> str:
+    return f"{graph_name}-{role}"
+
+
+def validate(spec: dict) -> None:
+    if not spec.get("name"):
+        raise GraphError("graph needs a 'name'")
+    workers = spec.get("workers")
+    if not workers:
+        raise GraphError("graph needs at least one entry under 'workers'")
+    modes = set()
+    for role, w in workers.items():
+        mode = w.get("mode", "agg")
+        if mode not in ("agg", "prefill", "decode"):
+            raise GraphError(f"worker {role!r}: unknown mode {mode!r}")
+        modes.add(mode)
+        tp = int(w.get("tp", 1)) * int(w.get("dp", 1)) * \
+            int(w.get("pp", 1)) * int(w.get("sp", 1))
+        chips = int(w.get("chips", tp))
+        nodes = int(w.get("num_nodes", 1))
+        if chips * nodes < tp:
+            raise GraphError(
+                f"worker {role!r}: mesh needs {tp} chips but requests "
+                f"{chips} x {nodes} node(s)")
+        if nodes > 1 and mode != "agg":
+            raise GraphError(
+                f"worker {role!r}: multi-host single engine supports "
+                "aggregated mode only")
+    if "decode" in modes and "prefill" not in modes:
+        raise GraphError("graph has decode workers but no prefill workers")
+    if "prefill" in modes and "decode" not in modes:
+        raise GraphError("graph has prefill workers but no decode workers")
+
+
+def _coordinator(spec: dict) -> list[dict]:
+    name = _component_name(spec["name"], "coordinator")
+    port = int(spec.get("coordinator", {}).get("port", 4222))
+    labels = {"app": name}
+    return [
+        {"apiVersion": "apps/v1", "kind": "Deployment",
+         "metadata": {"name": name, "labels": labels},
+         "spec": {"replicas": 1,
+                  "selector": {"matchLabels": labels},
+                  "template": {"metadata": {"labels": labels},
+                               "spec": {"containers": [{
+                                   "name": "coordinator",
+                                   "image": spec.get("image", "dynamo-tpu"),
+                                   "command": [
+                                       "python", "-m",
+                                       "dynamo_tpu.runtime.coordinator",
+                                       "--host", "0.0.0.0",
+                                       "--port", str(port)],
+                                   "ports": [{"containerPort": port}]}]}}}},
+        {"apiVersion": "v1", "kind": "Service",
+         "metadata": {"name": name},
+         "spec": {"selector": labels,
+                  "ports": [{"port": port, "targetPort": port}]}},
+    ]
+
+
+def _coord_url(spec: dict) -> str:
+    name = _component_name(spec["name"], "coordinator")
+    port = int(spec.get("coordinator", {}).get("port", 4222))
+    return f"tcp://{name}:{port}"
+
+
+def _frontend(spec: dict) -> list[dict]:
+    fe = spec.get("frontend", {})
+    name = _component_name(spec["name"], "frontend")
+    port = int(fe.get("http_port", 8000))
+    labels = {"app": name}
+    args = ["python", "-m", "dynamo_tpu.frontend",
+            "--http-port", str(port),
+            "--router-mode", fe.get("router_mode", "kv")]
+    return [
+        {"apiVersion": "apps/v1", "kind": "Deployment",
+         "metadata": {"name": name, "labels": labels},
+         "spec": {"replicas": int(fe.get("replicas", 1)),
+                  "selector": {"matchLabels": labels},
+                  "template": {"metadata": {"labels": labels},
+                               "spec": {"containers": [{
+                                   "name": "frontend",
+                                   "image": spec.get("image", "dynamo-tpu"),
+                                   "command": args,
+                                   "env": [{"name": "DTPU_COORDINATOR_URL",
+                                            "value": _coord_url(spec)}],
+                                   "ports": [{"containerPort": port}],
+                                   "readinessProbe": {
+                                       "httpGet": {"path": "/health",
+                                                   "port": port}}}]}}}},
+        {"apiVersion": "v1", "kind": "Service",
+         "metadata": {"name": name},
+         "spec": {"selector": labels,
+                  "ports": [{"port": port, "targetPort": port}]}},
+    ]
+
+
+def _worker(spec: dict, role: str, w: dict) -> list[dict]:
+    name = _component_name(spec["name"], role)
+    labels = {"app": name, "dynamo-role": role}
+    model = w.get("model", spec.get("model", "tiny-test"))
+    mode = w.get("mode", "agg")
+    tpu = {**DEFAULT_TPU, **spec.get("tpu", {}), **w.get("tpu", {})}
+    chips = int(w.get("chips", int(w.get("tp", 1))))
+    command = ["python", "-m", "dynamo_tpu.backends.tpu",
+               "--model", model, "--mode", mode]
+    for flag in ("tp", "dp", "pp", "sp"):
+        if int(w.get(flag, 1)) != 1:
+            command += [f"--{flag}", str(int(w[flag]))]
+    if mode == "decode" and "max_local_prefill_length" in w:
+        command += ["--max-local-prefill-length",
+                    str(int(w["max_local_prefill_length"]))]
+    env = [{"name": "DTPU_COORDINATOR_URL", "value": _coord_url(spec)}]
+    nodes = int(w.get("num_nodes", 1))
+    if nodes > 1:
+        # Multi-host single engine: pod ordinal = node rank; rank 0 serves.
+        command += ["--num-nodes", str(nodes), "--mh-group", name,
+                    "--node-rank", "$(POD_ORDINAL)"]
+        env += [{"name": "POD_ORDINAL",
+                 "valueFrom": {"fieldRef": {
+                     "fieldPath":
+                     "metadata.labels['apps.kubernetes.io/pod-index']"}}},
+                {"name": "JAX_COORDINATOR_ADDRESS",
+                 "value": f"{name}-0.{name}:8476"}]
+    replicas = int(w.get("replicas", 1)) * nodes
+    return [
+        {"apiVersion": "apps/v1", "kind": "StatefulSet",
+         "metadata": {"name": name, "labels": labels},
+         "spec": {"serviceName": name, "replicas": replicas,
+                  "selector": {"matchLabels": labels},
+                  "template": {"metadata": {"labels": labels},
+                               "spec": {
+                      "nodeSelector": {
+                          "cloud.google.com/gke-tpu-accelerator":
+                              tpu["accelerator"],
+                          "cloud.google.com/gke-tpu-topology":
+                              tpu["topology"]},
+                      "containers": [{
+                          "name": "worker",
+                          "image": spec.get("image", "dynamo-tpu"),
+                          "command": command,
+                          "env": env,
+                          "resources": {
+                              "requests": {"google.com/tpu": str(chips)},
+                              "limits": {"google.com/tpu": str(chips)}},
+                      }]}}}},
+        {"apiVersion": "v1", "kind": "Service",
+         "metadata": {"name": name},
+         "spec": {"clusterIP": "None", "selector": labels, "ports": []}},
+    ]
+
+
+def _planner(spec: dict) -> list[dict]:
+    p = spec.get("planner", {})
+    if not p.get("enabled"):
+        return []
+    name = _component_name(spec["name"], "planner")
+    labels = {"app": name}
+    args = ["python", "-m", "dynamo_tpu.planner"]
+    for k in ("min_replicas", "max_replicas"):
+        if k in p:
+            args += [f"--{k.replace('_', '-')}", str(int(p[k]))]
+    return [{"apiVersion": "apps/v1", "kind": "Deployment",
+             "metadata": {"name": name, "labels": labels},
+             "spec": {"replicas": 1,
+                      "selector": {"matchLabels": labels},
+                      "template": {"metadata": {"labels": labels},
+                                   "spec": {"serviceAccountName": name,
+                                            "containers": [{
+                                       "name": "planner",
+                                       "image": spec.get("image",
+                                                         "dynamo-tpu"),
+                                       "command": args,
+                                       "env": [{
+                                           "name": "DTPU_COORDINATOR_URL",
+                                           "value": _coord_url(spec)}],
+                                   }]}}}}]
+
+
+def _metrics(spec: dict) -> list[dict]:
+    m = spec.get("metrics", {})
+    if not m.get("enabled"):
+        return []
+    name = _component_name(spec["name"], "metrics")
+    labels = {"app": name}
+    port = int(m.get("port", 9091))
+    return [
+        {"apiVersion": "apps/v1", "kind": "Deployment",
+         "metadata": {"name": name, "labels": labels},
+         "spec": {"replicas": 1,
+                  "selector": {"matchLabels": labels},
+                  "template": {"metadata": {"labels": labels},
+                               "spec": {"containers": [{
+                                   "name": "metrics",
+                                   "image": spec.get("image", "dynamo-tpu"),
+                                   "command": [
+                                       "python", "-m",
+                                       "dynamo_tpu.components.metrics",
+                                       "--port", str(port)],
+                                   "env": [{"name": "DTPU_COORDINATOR_URL",
+                                            "value": _coord_url(spec)}],
+                                   "ports": [{"containerPort": port}]}]}}}},
+        {"apiVersion": "v1", "kind": "Service",
+         "metadata": {"name": name},
+         "spec": {"selector": labels,
+                  "ports": [{"port": port, "targetPort": port}]}},
+    ]
+
+
+def render(spec: dict) -> list[dict]:
+    """Graph spec -> list of Kubernetes manifests (validated)."""
+    validate(spec)
+    out = _coordinator(spec) + _frontend(spec)
+    for role, w in spec["workers"].items():
+        out += _worker(spec, role, w or {})
+    out += _planner(spec) + _metrics(spec)
+    return out
+
+
+def render_yaml(spec: dict) -> str:
+    return yaml.safe_dump_all(render(spec), sort_keys=False)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(
+        description="Render a dynamo-tpu graph deployment to k8s YAML")
+    parser.add_argument("graph", help="graph spec YAML path")
+    parser.add_argument("-o", "--out", default=None,
+                        help="output directory (default: stdout, one "
+                             "multi-doc stream)")
+    args = parser.parse_args()
+    with open(args.graph, "r", encoding="utf-8") as fh:
+        spec = yaml.safe_load(fh)
+    try:
+        manifests = render(spec)
+    except GraphError as exc:
+        sys.exit(f"invalid graph: {exc}")
+    if args.out:
+        os.makedirs(args.out, exist_ok=True)
+        for m in manifests:
+            fname = f"{m['kind'].lower()}-{m['metadata']['name']}.yaml"
+            with open(os.path.join(args.out, fname), "w",
+                      encoding="utf-8") as fh:
+                yaml.safe_dump(m, fh, sort_keys=False)
+        print(f"wrote {len(manifests)} manifests to {args.out}")
+    else:
+        print(yaml.safe_dump_all(manifests, sort_keys=False))
+
+
+if __name__ == "__main__":
+    main()

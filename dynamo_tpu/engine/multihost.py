@@ -201,13 +201,17 @@ async def run_follower(config, client, group: str, node_rank: int,
     Runner calls execute on a dedicated thread (device work can block for
     seconds during compilation; the event loop must keep servicing the
     coordinator connection's keepalives)."""
+    import dataclasses
+
     from dynamo_tpu.engine.runner import ModelRunner
     from dynamo_tpu.runtime.barrier import WorkerBarrier
 
-    # Build off the event loop: weight load + sharded upload blocks for
-    # seconds and the coordinator keepalives must keep flowing.
-    runner = await asyncio.get_running_loop().run_in_executor(
-        None, lambda: ModelRunner(config, params=params, seed=seed))
+    # Order matters: subscribe FIRST (dispatches published after the
+    # barrier buffer in the subscription queue), then cross-check the
+    # leader's shape, then build. The leader's barrier payload carries its
+    # ACTUAL num_pages so auto-sizing can never diverge across hosts —
+    # a one-page difference would change the jitted program and corrupt
+    # every cross-host collective.
     sub = await client.subscribe(DISPATCH_SUBJECT.format(group=group))
     shape = await WorkerBarrier(
         client, BARRIER_ID.format(group=group), str(node_rank)).sync(
@@ -218,7 +222,14 @@ async def run_follower(config, client, group: str, node_rank: int,
     if got != expect:
         raise RuntimeError(f"follower/leader config mismatch: leader "
                            f"published {got}, follower built {expect}")
-    log.info("follower %d: runner built, replaying dispatches", node_rank)
+    if shape.get("num_pages"):
+        config = dataclasses.replace(config, num_pages=shape["num_pages"])
+    # Build off the event loop: weight load + sharded upload blocks for
+    # seconds and the coordinator keepalives must keep flowing.
+    runner = await asyncio.get_running_loop().run_in_executor(
+        None, lambda: ModelRunner(config, params=params, seed=seed))
+    log.info("follower %d: runner built (%d pages), replaying dispatches",
+             node_rank, runner.num_pages)
 
     loop = asyncio.get_running_loop()
     work: queue.Queue = queue.Queue()
@@ -261,16 +272,15 @@ async def run_follower(config, client, group: str, node_rank: int,
                               daemon=True)
     thread.start()
     sub_iter = sub.__aiter__()
+    died = asyncio.ensure_future(done.wait())  # completes at most once
     try:
         # Race each subscription read against replay-thread death: a
         # replay error during an idle stretch must surface immediately,
         # not after the next dispatch happens to arrive.
         while not done.is_set():
             get_next = asyncio.ensure_future(sub_iter.__anext__())
-            died = asyncio.ensure_future(done.wait())
             finished, _ = await asyncio.wait(
                 {get_next, died}, return_when=asyncio.FIRST_COMPLETED)
-            died.cancel()
             if get_next not in finished:
                 get_next.cancel()
                 break
@@ -279,9 +289,19 @@ async def run_follower(config, client, group: str, node_rank: int,
             if event["payload"].get("m") == "stop":
                 break
     finally:
+        died.cancel()
         work.put(None)
         await sub.cancel()
-    await done.wait()
+    try:
+        # Bounded: the replay thread can be wedged inside a cross-host
+        # collective whose peers died (leader crash mid-window). It is a
+        # daemon thread — after the grace period let process teardown
+        # reap it rather than hanging shutdown forever.
+        await asyncio.wait_for(done.wait(), timeout=60.0)
+    except asyncio.TimeoutError:
+        log.warning("follower %d: replay thread did not drain in 60s "
+                    "(peer death mid-collective?); abandoning it",
+                    node_rank)
     if errors:
         raise errors[0]
     log.info("follower %d: stopped", node_rank)

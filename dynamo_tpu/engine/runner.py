@@ -165,7 +165,12 @@ class ModelRunner:
         dev_array = np.array(devices[:total]).reshape(
             config.dp, config.pp, config.sp, config.tp)
         self.mesh = Mesh(dev_array, ("dp", "pp", "sp", "tp"))
-        self._sized_pages(devices[0])
+        # Auto-size from an ADDRESSABLE device: in multi-controller mode
+        # devices[0] may belong to another process, and memory_stats on a
+        # remote device fails into the conservative fallback.
+        local = [d for d in devices[:total]
+                 if d.process_index == jax.process_index()]
+        self._sized_pages(local[0] if local else devices[0])
 
         # Shard or init parameters.
         pspecs = param_specs(spec)
