@@ -4,7 +4,9 @@ Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", "detail"}.
 
 Workload: qwen2.5-0.5b-shaped model (random bf16 weights) served through the
 FULL TPUEngine path — batched prefill, M-step decode windows, continuous
-batching — with 32 concurrent requests, ISL 128 / OSL 128. A full-shape
+batching — with 48 concurrent requests, ISL 128 / OSL 128 (BENCH_BATCH /
+BENCH_ISL / BENCH_OSL / BENCH_MODEL / BENCH_WINDOW / BENCH_DEPTH env vars
+override; docs/PERF_NOTES.md records the sweep behind the defaults). A full-shape
 warmup round compiles every bucket first, so the measured round is
 steady-state.
 
@@ -19,13 +21,14 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import time
 
 import numpy as np
 
-ISL = 128
-OSL = 128
-BATCH = 32
+ISL = int(os.environ.get("BENCH_ISL", "128"))
+OSL = int(os.environ.get("BENCH_OSL", "128"))
+BATCH = int(os.environ.get("BENCH_BATCH", "48"))
 HBM_GBPS = 819.0  # v5e chip HBM bandwidth (public spec)
 
 
@@ -90,7 +93,6 @@ async def main_async():
     from dynamo_tpu.engine.config import EngineConfig, PRESETS
     from dynamo_tpu.engine.engine import TPUEngine
 
-    import os
     spec = PRESETS[os.environ.get("BENCH_MODEL", "qwen2.5-0.5b")]
     page = 16
     maxp = 64  # up to 1024 tokens/seq
@@ -100,8 +102,8 @@ async def main_async():
         prefill_buckets=(128, 256, 512, 1024),
         max_prefill_tokens=1024,
         attention_backend=os.environ.get("BENCH_ATTN", "auto"),
-        decode_window=int(os.environ.get("BENCH_WINDOW", "8")),
-        pipeline_depth=int(os.environ.get("BENCH_DEPTH", "8")))
+        decode_window=int(os.environ.get("BENCH_WINDOW", "32")),
+        pipeline_depth=int(os.environ.get("BENCH_DEPTH", "4")))
     engine = TPUEngine(config)
     engine.start()
     rng = np.random.default_rng(0)
