@@ -29,7 +29,8 @@ import numpy as np
 ISL = int(os.environ.get("BENCH_ISL", "128"))
 OSL = int(os.environ.get("BENCH_OSL", "128"))
 BATCH = int(os.environ.get("BENCH_BATCH", "48"))
-HBM_GBPS = 819.0  # v5e chip HBM bandwidth (public spec)
+# HBM bandwidth lives in ModelSpec.weight_read_step_ms (env DTPU_HBM_GBPS,
+# default v5e 819 GB/s) so bench, auto-window sizing, and profiling agree.
 
 
 async def run_round(engine, spec, rng, tag, batch=BATCH, osl=OSL):
@@ -100,7 +101,7 @@ async def main_async():
         model=spec, page_size=page, num_pages=BATCH * maxp + 16,
         max_pages_per_seq=maxp, max_num_seqs=BATCH,
         prefill_buckets=(128, 256, 512, 1024),
-        max_prefill_tokens=1024,
+        max_prefill_tokens=int(os.environ.get("BENCH_MAX_PREFILL", "1024")),
         attention_backend=os.environ.get("BENCH_ATTN", "auto"),
         decode_window=int(os.environ.get("BENCH_WINDOW", "32")),
         pipeline_depth=int(os.environ.get("BENCH_DEPTH", "4")))
@@ -125,8 +126,7 @@ async def main_async():
     engine.stop()
 
     # Roofline context: one decode step must read all weights once.
-    weight_bytes = spec.num_params() * 2
-    step_floor_ms = 1e3 * weight_bytes / (HBM_GBPS * 1e9)
+    step_floor_ms = spec.weight_read_step_ms()
     roofline_tok_s = BATCH / (step_floor_ms / 1e3)
     tok_s = steady["decode_tok_s"]
     baseline_decode_tok_s = 51.22  # BASELINE.md profiler example, tok/s/GPU

@@ -61,6 +61,15 @@ def parse_args(argv=None) -> argparse.Namespace:
                         help="layer-sharded pipeline axis")
     parser.add_argument("--sp", type=int, default=1,
                         help="sequence (context) parallelism for prefill")
+    parser.add_argument("--decode-window", default="auto",
+                        type=_window_arg,
+                        help="decode steps per dispatched window: a "
+                             "positive int, or 'auto' to size from the "
+                             "model's weight-read step estimate "
+                             "(DTPU_WINDOW_TARGET_MS)")
+    parser.add_argument("--pipeline-depth", type=int, default=4,
+                        help="decode windows in flight before the host "
+                             "blocks on the oldest readback")
     parser.add_argument("--attention-backend", default="auto",
                         choices=["auto", "pallas", "xla"])
     parser.add_argument("--host-cache-pages", type=int, default=0,
@@ -118,8 +127,21 @@ def build_engine_config(args) -> EngineConfig:
         tp=args.tp, dp=args.dp, pp=getattr(args, "pp", 1),
         sp=getattr(args, "sp", 1),
         attention_backend=args.attention_backend,
+        decode_window=_window_arg(getattr(args, "decode_window", "auto")),
+        pipeline_depth=getattr(args, "pipeline_depth", 4),
         host_cache_pages=args.host_cache_pages,
         kv_disk_cache_dir=args.kv_disk_cache_dir)
+
+
+def _window_arg(value) -> int | str:
+    """argparse type for --decode-window: positive int or 'auto'.
+    ValueError -> argparse's clean 'invalid value' error at parse time."""
+    if value == "auto":
+        return value
+    n = int(value)
+    if n < 1:
+        raise ValueError(f"decode window must be >= 1, got {n}")
+    return n
 
 
 async def run(args: argparse.Namespace) -> None:

@@ -58,6 +58,17 @@ class ModelSpec:
         return (2 * self.num_layers * self.num_kv_heads * self.head_dim
                 * dtype_bytes)
 
+    def weight_read_step_ms(self, tp: int = 1, pp: int = 1,
+                            hbm_gbps: float | None = None) -> float:
+        """Lower bound on a decode step for this spec's shard: one full
+        read of the shard's bf16 weights from HBM. The single source of
+        the bandwidth constant (bench roofline, auto window sizing,
+        profiling) — override per part with DTPU_HBM_GBPS."""
+        if hbm_gbps is None:
+            hbm_gbps = float(os.environ.get("DTPU_HBM_GBPS", "819"))
+        shard_bytes = self.num_params() * 2 / max(1, tp * pp)
+        return shard_bytes / (hbm_gbps * 1e9) * 1e3
+
     @classmethod
     def from_hf_config(cls, path: str) -> "ModelSpec":
         """Build from a HF config.json (local dir or file)."""
@@ -130,7 +141,12 @@ class EngineConfig:
     # the host sees sampled tokens once per window). Larger windows amortize
     # dispatch + readback latency at the cost of coarser stop-condition
     # granularity (up to window-1 wasted speculative tokens per finish).
-    decode_window: int = 8
+    # "auto" sizes M from the model's weight-read step estimate so the
+    # window PERIOD (M x step) lands near DTPU_WINDOW_TARGET_MS (default
+    # 75 ms — keeps prefill admission gaps SLA-friendly): a 0.5B model
+    # resolves to M=32, an unsharded 8B to M=4, an 8B shard at tp=4 to
+    # M=12 (docs/PERF_NOTES.md sweep is where the target comes from).
+    decode_window: int | str = 8
     # Windows in flight before the host blocks on the oldest readback.
     # Each dispatch/readback pays a host<->device round trip (~100 ms
     # through a tunneled chip, ~100 us locally); depth D overlaps D of
@@ -171,6 +187,31 @@ class EngineConfig:
             if length <= b:
                 return b
         return self.prefill_buckets[-1]
+
+    def resolve_decode_window(self) -> int:
+        """Resolve ``decode_window="auto"`` to a concrete M.
+
+        TPU-first sizing: a decode step is bounded below by reading this
+        shard's weights once from HBM; the per-dispatch host overhead is
+        ~constant. Pick M so the window period M x (step estimate) hits
+        DTPU_WINDOW_TARGET_MS — long enough to amortize dispatch, short
+        enough that prefill admission between windows keeps p99 TTFT
+        inside the SLA (bench sweep in docs/PERF_NOTES.md)."""
+        if isinstance(self.decode_window, int):
+            if self.decode_window < 1:
+                raise ValueError(
+                    f"decode_window must be >= 1, got {self.decode_window}")
+            return self.decode_window
+        if self.decode_window != "auto":
+            raise ValueError(
+                f"decode_window must be an int or 'auto', "
+                f"got {self.decode_window!r}")
+        target_ms = float(os.environ.get("DTPU_WINDOW_TARGET_MS", "75"))
+        step_ms = self.model.weight_read_step_ms(self.tp, self.pp) \
+            + 1.0  # + host/dispatch overhead
+        raw = target_ms / step_ms
+        nice = (1, 2, 4, 8, 12, 16, 24, 32, 48, 64)
+        return min(nice, key=lambda m: abs(m - raw))
 
     @property
     def max_model_len(self) -> int:

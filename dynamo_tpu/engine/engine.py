@@ -86,6 +86,7 @@ class TPUEngine(AsyncEngine):
     def __init__(self, config: EngineConfig, params=None,
                  devices=None, kv_publisher=None, metrics_publisher=None):
         self.config = config
+        self.decode_window = config.resolve_decode_window()
         self.runner = ModelRunner(config, params=params, devices=devices)
         self.allocator = PageAllocator(self.runner.num_pages, config.page_size)
         # KV tiering (G2 host DRAM + optional G3 disk): HBM evictions are
@@ -321,7 +322,7 @@ class TPUEngine(AsyncEngine):
     def _engine_loop(self) -> None:
         log.info("engine loop starting (slots=%d pages=%d window=%d)",
                  self.config.max_num_seqs, self.runner.num_pages,
-                 self.config.decode_window)
+                 self.decode_window)
         depth = max(1, self.config.pipeline_depth)
         while self._running:
             self._run_jobs()
@@ -785,7 +786,7 @@ class TPUEngine(AsyncEngine):
     def _dispatch_window(self) -> _Window:
         cfg = self.config
         page = cfg.page_size
-        M = cfg.decode_window
+        M = self.decode_window
         b = cfg.max_num_seqs
         frozen: dict[int, tuple] = {}
         stalled: set[int] = set()

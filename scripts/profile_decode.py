@@ -101,10 +101,10 @@ def main():
         except Exception as e:  # noqa: BLE001
             print("pallas failed:", type(e).__name__, str(e)[:300])
 
-    # Weight-read roofline context.
+    # Weight-read roofline context (bandwidth from ModelSpec, DTPU_HBM_GBPS).
     pb = spec.num_params() * 2
-    print(f"params {pb / 1e9:.2f} GB -> weight-read floor "
-          f"@819GB/s = {pb / 819e9 * 1e6:.0f} us/step")
+    print(f"params {pb / 1e9:.2f} GB -> weight-read floor = "
+          f"{spec.weight_read_step_ms() * 1e3:.0f} us/step")
 
 
 if __name__ == "__main__":

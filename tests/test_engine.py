@@ -263,3 +263,38 @@ def test_sampler_greedy_and_topk():
     out = sample_tokens(logits, jnp.ones(2), jnp.zeros(2, jnp.int32),
                         jnp.full(2, 1e-6), key)
     assert out.tolist() == [1, 0]
+
+
+def test_auto_decode_window_sizing(monkeypatch):
+    """decode_window='auto' targets DTPU_WINDOW_TARGET_MS from the shard's
+    weight-read step estimate: small models get long windows, big shards
+    short ones (docs/PERF_NOTES.md sweep)."""
+    import pytest
+    from dynamo_tpu.engine.config import EngineConfig, PRESETS
+
+    monkeypatch.delenv("DTPU_WINDOW_TARGET_MS", raising=False)
+    monkeypatch.delenv("DTPU_HBM_GBPS", raising=False)
+
+    def win(model, **kw):
+        return EngineConfig(model=PRESETS[model], decode_window="auto",
+                            **kw).resolve_decode_window()
+
+    w_small = win("qwen2.5-0.5b")
+    w_8b = win("llama-3-8b")
+    assert w_small >= 24  # ~1.2 ms step -> long windows
+    assert 2 <= w_8b <= 8  # ~20 ms unsharded step -> short windows
+    assert w_8b < w_small
+    # tp shrinks the shard -> longer windows again.
+    assert win("llama-3-8b", tp=8) > w_8b
+    # Explicit int passes through; junk and non-positive rejected.
+    assert EngineConfig(model=PRESETS["tiny-test"],
+                        decode_window=6).resolve_decode_window() == 6
+    with pytest.raises(ValueError):
+        EngineConfig(model=PRESETS["tiny-test"],
+                     decode_window="big").resolve_decode_window()
+    with pytest.raises(ValueError):
+        EngineConfig(model=PRESETS["tiny-test"],
+                     decode_window=0).resolve_decode_window()
+    # The target knob moves the answer.
+    monkeypatch.setenv("DTPU_WINDOW_TARGET_MS", "10")
+    assert win("qwen2.5-0.5b") < w_small

@@ -134,6 +134,9 @@ def test_two_process_spmd_engine_matches_single_process(tmp_path):
         time.sleep(2)
         worker_args = ["dynamo_tpu.backends.tpu", "--model", "tiny-test",
                        "--num-pages", "64", "--tp", "4",
+                       # Pin the window to the in-process reference
+                       # engine's default so the dispatch sequences match.
+                       "--decode-window", "8",
                        "--num-nodes", "2"]
         leader = _spawn(worker_args + ["--node-rank", "0"],
                         tmp_path / "leader.log",
