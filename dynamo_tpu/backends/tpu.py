@@ -129,6 +129,7 @@ def build_engine_config(args) -> EngineConfig:
         attention_backend=args.attention_backend,
         decode_window=_window_arg(getattr(args, "decode_window", "auto")),
         pipeline_depth=getattr(args, "pipeline_depth", 4),
+        warmup_windows=True,
         host_cache_pages=args.host_cache_pages,
         kv_disk_cache_dir=args.kv_disk_cache_dir)
 

@@ -147,6 +147,12 @@ class EngineConfig:
     # resolves to M=32, an unsharded 8B to M=4, an 8B shard at tp=4 to
     # M=12 (docs/PERF_NOTES.md sweep is where the target comes from).
     decode_window: int | str = 8
+    # Compile the decode-window program and the smallest prefill bucket
+    # on the engine thread before serving, so a first short request
+    # doesn't pay those XLA compile stalls (larger prefill buckets still
+    # compile on first use). Workers enable this; tests skip it to keep
+    # CPU suites fast.
+    warmup_windows: bool = False
     # Windows in flight before the host blocks on the oldest readback.
     # Each dispatch/readback pays a host<->device round trip (~100 ms
     # through a tunneled chip, ~100 us locally); depth D overlaps D of

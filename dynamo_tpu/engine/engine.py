@@ -319,10 +319,42 @@ class TPUEngine(AsyncEngine):
         return handle
 
     # -- engine thread --------------------------------------------------------
+    def _warmup_window_programs(self) -> None:
+        """Compile the decode-window program (smallest page-table bucket)
+        and the smallest prefill bucket before serving — the runner
+        compiles lazily per shape key on the engine thread, so without
+        this the first request stalls on XLA compiles for both. Larger
+        prefill buckets / page-table widths still compile on first use.
+        Warmup work is inert: all-zero packed rows are inactive
+        (PK_SEQLEN=0) and prefill rows write only the reserved scratch
+        page 0."""
+        t0 = time.monotonic()
+        bucket_pages = self.runner.bucket_pages_for(1)
+        packed = np.zeros((self.config.max_num_seqs,
+                           PK_PREFIX + bucket_pages), np.int32)
+        outs = self.runner.decode_window(packed, self.decode_window)
+        np.asarray(outs[0])  # force compile + execute
+        log.info("warmed window program M=%d in %.1fs", self.decode_window,
+                 time.monotonic() - t0)
+        t0 = time.monotonic()
+        bucket = self.config.prefill_buckets[0]
+        seq = PrefillSeq(tokens=np.zeros(min(4, bucket), np.int32),
+                         start_pos=0,
+                         chunk_pages=np.zeros(1, np.int32),  # scratch page
+                         hist_pages=None, sampling=(0.0, 0, 1.0))
+        self.runner.prefill_batch([seq])  # slots=None blocks until done
+        log.info("warmed prefill bucket %d in %.1fs", bucket,
+                 time.monotonic() - t0)
+
     def _engine_loop(self) -> None:
         log.info("engine loop starting (slots=%d pages=%d window=%d)",
                  self.config.max_num_seqs, self.runner.num_pages,
                  self.decode_window)
+        if self.config.warmup_windows:
+            try:
+                self._warmup_window_programs()
+            except Exception:  # noqa: BLE001 — warmup is best-effort
+                log.exception("window warmup failed; compiling lazily")
         depth = max(1, self.config.pipeline_depth)
         while self._running:
             self._run_jobs()
@@ -786,6 +818,14 @@ class TPUEngine(AsyncEngine):
     def _dispatch_window(self) -> _Window:
         cfg = self.config
         page = cfg.page_size
+        # Window size is fixed: admission is never window-blocked in this
+        # loop (_admit drains the waiting queue into free slots before
+        # every dispatch, and dispatches are async), so an adaptive
+        # shrink-while-waiting policy was tried and reverted — the only
+        # states where requests persist in the queue are slot/KV
+        # saturation, where short windows just multiply dispatch overhead
+        # without admitting anyone (docs/PERF_NOTES.md, round-3 negative
+        # results).
         M = self.decode_window
         b = cfg.max_num_seqs
         frozen: dict[int, tuple] = {}

@@ -298,3 +298,32 @@ def test_auto_decode_window_sizing(monkeypatch):
     # The target knob moves the answer.
     monkeypatch.setenv("DTPU_WINDOW_TARGET_MS", "10")
     assert win("qwen2.5-0.5b") < w_small
+
+
+@async_test
+async def test_warmup_windows_precompiles_and_serves():
+    """warmup_windows=True compiles the decode-window and smallest-prefill
+    programs before serving, and the engine still produces correct
+    streams afterward (warmup work must be inert: inactive rows, scratch
+    page only)."""
+    eng = TPUEngine(tiny_config(warmup_windows=True))
+    calls = []
+    orig_win, orig_pre = eng.runner.decode_window, eng.runner.prefill_batch
+    eng.runner.decode_window = (
+        lambda packed, window: calls.append(("window", window))
+        or orig_win(packed, window))
+    eng.runner.prefill_batch = (
+        lambda seqs, slots=None: calls.append(("prefill", slots))
+        or orig_pre(seqs, slots))
+    eng.start()
+    try:
+        rng = np.random.default_rng(7)
+        prompt = rng.integers(0, SPEC.vocab_size, size=20).tolist()
+        got, finish = await collect(eng, prompt, 8)
+        assert finish == "length" and len(got) == 8
+        # Warmup ran before the serving dispatches: first window call is
+        # the warmup's, first prefill call is the inert slots=None one.
+        assert calls[0] == ("window", eng.decode_window)
+        assert calls[1] == ("prefill", None)
+    finally:
+        eng.stop()
