@@ -33,7 +33,8 @@ from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.engine.kv_cache import PageAllocator
 from dynamo_tpu.engine.runner import (
     ModelRunner, PrefillSeq, PK_OVERRIDE, PK_TOKEN, PK_POS, PK_SEQLEN,
-    PK_TOPK, PK_TEMP, PK_TOPP, PK_CAP, PK_LOGPROB, PK_PREFIX, TOP_LOGPROBS)
+    PK_TOPK, PK_TEMP, PK_TOPP, PK_CAP, PK_LOGPROB, PK_FREQPEN, PK_PRESPEN,
+    PK_PREFIX, TOP_LOGPROBS)
 from dynamo_tpu.engine.sampler import MAX_TOPK
 from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics, KvStats, WorkerStats
 from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput, PreprocessedRequest
@@ -117,6 +118,8 @@ class TPUEngine(AsyncEngine):
         self.temperature = np.zeros(b, np.float32)
         self.top_k = np.zeros(b, np.int32)
         self.top_p = np.ones(b, np.float32)
+        self.freq_pen = np.zeros(b, np.float32)
+        self.pres_pen = np.zeros(b, np.float32)
         self.overrides: dict[int, int] = {}  # slot -> first token next window
         self.waiting: queue.Queue[_Request] = queue.Queue()
         self.num_waiting = 0
@@ -187,6 +190,13 @@ class TPUEngine(AsyncEngine):
                 "sample among the top-%d logits)", s.top_k, MAX_TOPK,
                 MAX_TOPK)
             s.top_k = MAX_TOPK
+        for field in ("frequency_penalty", "presence_penalty"):
+            val = getattr(s, field, None)
+            if val is not None and not -2.0 <= val <= 2.0:
+                clamped = max(-2.0, min(2.0, val))
+                log.warning("%s=%s outside [-2, 2]; clamping to %s",
+                            field, val, clamped)
+                setattr(s, field, clamped)
 
     async def generate(self, request, context: Context) -> AsyncIterator[dict]:
         self.start()
@@ -334,7 +344,14 @@ class TPUEngine(AsyncEngine):
                            PK_PREFIX + bucket_pages), np.int32)
         outs = self.runner.decode_window(packed, self.decode_window)
         np.asarray(outs[0])  # force compile + execute
-        log.info("warmed window program M=%d in %.1fs", self.decode_window,
+        # The penalized variant too: a first penalized request must not
+        # stall every in-flight stream on its compile. One inactive row
+        # with penalty bits set selects it; inactive rows do no work.
+        packed_pen = packed.copy()
+        packed_pen[0, PK_FREQPEN] = np.float32(1.0).view(np.int32)
+        outs = self.runner.decode_window(packed_pen, self.decode_window)
+        np.asarray(outs[0])
+        log.info("warmed window programs M=%d in %.1fs", self.decode_window,
                  time.monotonic() - t0)
         t0 = time.monotonic()
         bucket = self.config.prefill_buckets[0]
@@ -623,10 +640,15 @@ class TPUEngine(AsyncEngine):
                      if (p.hist_pages is not None) == with_h]
             while group:
                 chunk, group = group[:8], group[8:]
+                rows = None
+                if any(any(self._penalties_of(r)) for r, _, _ in chunk):
+                    rows = np.stack([self._count_row_of(r)
+                                     for r, _, _ in chunk])
                 try:
                     handle = self.runner.prefill_batch(
                         [p for _, _, p in chunk],
-                        slots=[s for _, s, _ in chunk])
+                        slots=[s for _, s, _ in chunk],
+                        count_rows=rows)
                 except Exception as exc:  # noqa: BLE001
                     log.exception("batched prefill failed")
                     for r, _, _ in chunk:
@@ -712,7 +734,8 @@ class TPUEngine(AsyncEngine):
             tokens=np.asarray(prompt[reuse_tokens:], np.int32),
             start_pos=reuse_tokens, chunk_pages=chunk_pages,
             hist_pages=hist, sampling=self._sampling_of(r),
-            logprobs=r.req.sampling_options.logprobs is not None)
+            logprobs=r.req.sampling_options.logprobs is not None,
+            penalties=self._penalties_of(r))
 
     def _prefill_chunked(self, r: _Request, slot: int) -> None:
         """Long prompt: prefill in page-aligned chunks with history."""
@@ -754,9 +777,18 @@ class TPUEngine(AsyncEngine):
             chunk_pages = np.asarray(
                 pages[first_page:first_page + (-(-n // page))], np.int32)
             hist = np.asarray(pages[:first_page], np.int32)
+            # Penalty state matters only for the FINAL chunk: earlier
+            # chunks' sampled tokens are discarded, so don't pay the
+            # [vocab] row build / penalized program / multihost publish
+            # for them.
+            final = start + n >= len(prompt)
+            pen = self._penalties_of(r)
             token, _ = self.runner.prefill(
                 chunk_tokens, start, chunk_pages,
-                hist if len(hist) else None, self._sampling_of(r))
+                hist if len(hist) else None, self._sampling_of(r),
+                penalties=pen,
+                count_row=self._count_row_of(r)
+                if final and any(pen) else None)
             start += n
             if start >= len(prompt):
                 first_token = token
@@ -766,6 +798,22 @@ class TPUEngine(AsyncEngine):
     def _sampling_of(self, r: _Request) -> tuple[float, int, float]:
         s = r.req.sampling_options
         return (s.temperature or 0.0, s.top_k or 0, s.top_p or 1.0)
+
+    @staticmethod
+    def _penalties_of(r: _Request) -> tuple[float, float]:
+        s = r.req.sampling_options
+        return (getattr(s, "frequency_penalty", None) or 0.0,
+                getattr(s, "presence_penalty", None) or 0.0)
+
+    def _count_row_of(self, r: _Request) -> np.ndarray:
+        """uint8 [vocab] counts of this request's generated tokens so far
+        (penalty state; saturates at 255). tokens_all is authoritative —
+        every placement path appends the first token before calling."""
+        row = np.zeros(self.runner.spec.vocab_size, np.int64)
+        gen = r.tokens_all[len(r.req.token_ids):]
+        if gen:
+            np.add.at(row, np.asarray(gen, np.int64), 1)
+        return np.minimum(row, 255).astype(np.uint8)
 
     def _place_in_slot_pending(self, r: _Request, slot: int) -> None:
         """Occupy a slot whose first token is still on device (scattered
@@ -785,6 +833,7 @@ class TPUEngine(AsyncEngine):
         self.temperature[slot] = temp
         self.top_k[slot] = tk
         self.top_p[slot] = tp
+        self.freq_pen[slot], self.pres_pen[slot] = self._penalties_of(r)
         self.overrides.pop(slot, None)
 
     def _place_in_slot(self, r: _Request, slot: int, first_token: int,
@@ -812,6 +861,11 @@ class TPUEngine(AsyncEngine):
         self.temperature[slot] = temp
         self.top_k[slot] = tk
         self.top_p[slot] = tp
+        fp, pp = self._penalties_of(r)
+        self.freq_pen[slot], self.pres_pen[slot] = fp, pp
+        if fp or pp:
+            # tokens_all already includes first_token (appended above).
+            self.runner.set_count_rows([slot], self._count_row_of(r)[None])
         self.overrides[slot] = first_token
 
     # -- decode windows -------------------------------------------------------
@@ -922,6 +976,8 @@ class TPUEngine(AsyncEngine):
             packed[i, PK_CAP] = cap
             if r.req.sampling_options.logprobs is not None:
                 packed[i, PK_LOGPROB] = 1
+            packed[i, PK_FREQPEN] = self.freq_pen[i:i + 1].view(np.int32)[0]
+            packed[i, PK_PRESPEN] = self.pres_pen[i:i + 1].view(np.int32)[0]
             packed[i, PK_PREFIX:PK_PREFIX + len(r.pages)] = r.pages
             slots[i] = (r, r.epoch, start, cap)
             adv = min(M, max(0, cap - start))

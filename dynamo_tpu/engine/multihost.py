@@ -91,18 +91,21 @@ def _pack_seq(s) -> dict:
             "hist_pages": _pack_array(s.hist_pages),
             "sampling": [float(s.sampling[0]), int(s.sampling[1]),
                          float(s.sampling[2])],
-            "logprobs": bool(s.logprobs)}
+            "logprobs": bool(s.logprobs),
+            "penalties": [float(s.penalties[0]), float(s.penalties[1])]}
 
 
 def _unpack_seq(d: dict):
     from dynamo_tpu.engine.runner import PrefillSeq
     t, k, p = d["sampling"]
+    fp, pp = d.get("penalties", (0.0, 0.0))
     return PrefillSeq(tokens=_unpack_array(d["tokens"]),
                       start_pos=d["start_pos"],
                       chunk_pages=_unpack_array(d["chunk_pages"]),
                       hist_pages=_unpack_array(d["hist_pages"]),
                       sampling=(float(t), int(k), float(p)),
-                      logprobs=d["logprobs"])
+                      logprobs=d["logprobs"],
+                      penalties=(float(fp), float(pp)))
 
 
 class LeaderRunner:
@@ -140,21 +143,32 @@ class LeaderRunner:
         if prev is not None:
             prev.result(timeout=30.0)
 
-    def prefill_batch(self, seqs, slots=None):
+    def prefill_batch(self, seqs, slots=None, count_rows=None):
         self._publish({"m": "prefill_batch",
                        "seqs": [_pack_seq(s) for s in seqs],
                        "slots": None if slots is None
-                       else [int(x) for x in slots]})
-        return self._inner.prefill_batch(seqs, slots)
+                       else [int(x) for x in slots],
+                       "count_rows": _pack_array(count_rows)})
+        return self._inner.prefill_batch(seqs, slots, count_rows)
 
-    def prefill(self, tokens, start_pos, chunk_pages, hist_pages, sampling):
+    def set_count_rows(self, slots, rows):
+        self._publish({"m": "set_count_rows",
+                       "slots": [int(x) for x in slots],
+                       "rows": _pack_array(rows)})
+        return self._inner.set_count_rows(slots, rows)
+
+    def prefill(self, tokens, start_pos, chunk_pages, hist_pages, sampling,
+                penalties=(0.0, 0.0), count_row=None):
         from dynamo_tpu.engine.runner import PrefillSeq
         self._publish({"m": "prefill", "seq": _pack_seq(PrefillSeq(
             tokens=np.asarray(tokens, np.int32), start_pos=start_pos,
             chunk_pages=np.asarray(chunk_pages, np.int32),
-            hist_pages=hist_pages, sampling=sampling))})
+            hist_pages=hist_pages, sampling=sampling,
+            penalties=penalties)),
+            "count_row": _pack_array(count_row)})
         return self._inner.prefill(tokens, start_pos, chunk_pages,
-                                   hist_pages, sampling)
+                                   hist_pages, sampling, penalties,
+                                   count_row)
 
     def decode_window(self, packed: np.ndarray, window: int):
         self._publish({"m": "decode_window", "packed": _pack_array(packed),
@@ -251,11 +265,16 @@ async def run_follower(config, client, group: str, node_rank: int,
                 m = msg["m"]
                 if m == "prefill_batch":
                     runner.prefill_batch(
-                        [_unpack_seq(s) for s in msg["seqs"]], msg["slots"])
+                        [_unpack_seq(s) for s in msg["seqs"]], msg["slots"],
+                        _unpack_array(msg.get("count_rows")))
+                elif m == "set_count_rows":
+                    runner.set_count_rows(msg["slots"],
+                                          _unpack_array(msg["rows"]))
                 elif m == "prefill":
                     s = _unpack_seq(msg["seq"])
                     runner.prefill(s.tokens, s.start_pos, s.chunk_pages,
-                                   s.hist_pages, s.sampling)
+                                   s.hist_pages, s.sampling, s.penalties,
+                                   _unpack_array(msg.get("count_row")))
                 elif m == "decode_window":
                     runner.decode_window(_unpack_array(msg["packed"]),
                                          msg["window"])

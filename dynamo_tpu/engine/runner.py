@@ -61,11 +61,14 @@ PK_CAP = 7        # position capacity = allocated pages * page_size; a slot
                   # freezes in-graph when its position reaches this
 PK_LOGPROB = 8    # 1 -> this slot wants logprobs (window computes them
                   # when ANY slot asks; per-slot filtering is host-side)
-PK_PREFIX = 9     # page table starts here
+PK_FREQPEN = 9    # float32 bits: OpenAI frequency_penalty (0 = off)
+PK_PRESPEN = 10   # float32 bits: OpenAI presence_penalty (0 = off)
+PK_PREFIX = 11    # page table starts here
 
 TOP_LOGPROBS = 8  # alternatives returned when logprobs are requested
 
-_PF_HDR = 8       # prefill packed-array header columns
+_PF_HDR = 10      # prefill packed-array header columns (7 freq-penalty
+                  # bits, 8 pres-penalty bits, 9 spare)
 
 
 def _logprobs_of(logits: jax.Array, sampled: jax.Array):
@@ -86,6 +89,7 @@ class PrefillSeq:
     hist_pages: np.ndarray | None  # pages before the chunk (None = fresh)
     sampling: tuple[float, int, float]  # (temperature, top_k, top_p)
     logprobs: bool = False      # row wants first-token logprobs
+    penalties: tuple[float, float] = (0.0, 0.0)  # (frequency, presence)
 
 
 def _mh_put(value, sharding):
@@ -208,6 +212,13 @@ class ModelRunner:
         self.tokens_dev = _mh_zeros(
             (config.max_num_seqs,), jnp.int32,
             NamedSharding(self.mesh, P()))
+        # Per-slot generated-token counts [slots, vocab] for OpenAI
+        # frequency/presence penalties (vLLM semantics: output tokens
+        # only). uint8 with saturation at 255; read ONLY by the penalized
+        # window variant, so unpenalized serving never touches it.
+        self.counts_dev = _mh_zeros(
+            (config.max_num_seqs, spec.vocab_size), jnp.uint8,
+            NamedSharding(self.mesh, P()))
         self._attention_impl, self._window_attention_impl = \
             self._pick_attention()
 
@@ -270,8 +281,9 @@ class ModelRunner:
         return paged_decode_attention_xla, paged_window_attention_xla
 
     # -- compiled steps -------------------------------------------------------
-    def _get_prefill(self, bucket: int, batch: int, with_history: bool):
-        key = (bucket, batch, with_history)
+    def _get_prefill(self, bucket: int, batch: int, with_history: bool,
+                     penalized: bool = False):
+        key = (bucket, batch, with_history, penalized)
         fn = self._prefill_cache.get(key)
         if fn is not None:
             return fn
@@ -282,9 +294,12 @@ class ModelRunner:
         # All host inputs travel in ONE packed int32 array (floats bitcast):
         # h2d transfers are latency-bound, so one transfer beats ten.
         # Columns: 0 start_pos, 1 n_tokens, 2 hist_len, 3 temp bits,
-        # 4 top_k, 5 top_p bits, 6 logprobs flag, then tokens[bucket],
-        # ptab[bucket_pages], htab[maxp if with_history].
-        def step(params, k_cache, v_cache, packed, rng):
+        # 4 top_k, 5 top_p bits, 6 logprobs flag, 7/8 penalty bits, then
+        # tokens[bucket], ptab[bucket_pages], htab[maxp if with_history].
+        # The penalized variant (preemption-recompute of a penalized
+        # request) additionally reads prior-generation counts so even the
+        # re-sampled token respects the penalties.
+        def step(params, k_cache, v_cache, packed, rng, counts=None):
             start = packed[:, 0]
             n = packed[:, 1]
             hist_lens = packed[:, 2]
@@ -310,6 +325,14 @@ class ModelRunner:
                 logits, k_cache, v_cache = prefill_forward(
                     params, spec, k_cache, v_cache, tokens, positions,
                     page_table, seq_lens, sp_shard=sp_shard)
+            if penalized:
+                freq = jax.lax.bitcast_convert_type(packed[:, 7],
+                                                    jnp.float32)
+                pres = jax.lax.bitcast_convert_type(packed[:, 8],
+                                                    jnp.float32)
+                cf = counts.astype(jnp.float32)
+                logits = (logits - freq[:, None] * cf
+                          - pres[:, None] * (cf > 0))
             rng, sub = jax.random.split(rng)
             sampled = sample_tokens(logits, temp, top_k, top_p, sub)
             B = sampled.shape[0]
@@ -343,15 +366,22 @@ class ModelRunner:
         self._decode_fn = jax.jit(step, donate_argnums=(1, 2))
         return self._decode_fn
 
-    def _get_window(self, window: int, bucket_pages: int):
-        key = (window, bucket_pages)
+    def _get_window(self, window: int, bucket_pages: int,
+                    penalized: bool = False):
+        """Window program, specialized on ``penalized``: the frequency/
+        presence-penalty variant threads the [B, V] counts state through
+        the scan and pays its read per step; the common variant is the
+        exact unpenalized program, so serving without penalties costs
+        nothing extra."""
+        key = (window, bucket_pages, penalized)
         fn = self._window_cache.get(key)
         if fn is not None:
             return fn
         spec = self.spec
         page = self.config.page_size
 
-        def run_window(params, k_cache, v_cache, tokens_dev, packed, rng):
+        def run_window(params, k_cache, v_cache, tokens_dev, packed, rng,
+                       counts=None):
             mask = packed[:, PK_OVERRIDE] > 0
             tokens0 = jnp.where(mask, packed[:, PK_TOKEN], tokens_dev)
             positions0 = packed[:, PK_POS]
@@ -362,6 +392,10 @@ class ModelRunner:
             top_p = jax.lax.bitcast_convert_type(packed[:, PK_TOPP],
                                                  jnp.float32)
             cap = packed[:, PK_CAP]
+            freq_pen = jax.lax.bitcast_convert_type(packed[:, PK_FREQPEN],
+                                                    jnp.float32)
+            pres_pen = jax.lax.bitcast_convert_type(packed[:, PK_PRESPEN],
+                                                    jnp.float32)
             page_table = packed[:, PK_PREFIX:]
             B = tokens0.shape[0]
             L, nkv = spec.num_layers, spec.num_kv_heads
@@ -379,7 +413,7 @@ class ModelRunner:
             want_lp = jnp.any(packed[:, PK_LOGPROB] > 0)
 
             def step(carry, m):
-                tokens, positions, kbuf, vbuf, rng = carry
+                tokens, positions, kbuf, vbuf, rng, cnts = carry
                 # A slot advances only while live AND within its allocated
                 # pages; at capacity it freezes in-graph (the host emits
                 # LENGTH when it sees the cap).
@@ -395,11 +429,23 @@ class ModelRunner:
                 vbuf = jax.lax.dynamic_update_slice(
                     vbuf, v_new.transpose(0, 2, 1, 3)[:, :, :, None],
                     (0, 0, 0, m, 0))
+                if penalized:
+                    # OpenAI penalties over generated tokens (vLLM
+                    # semantics): subtract before temperature/top-k.
+                    cf = cnts.astype(jnp.float32)
+                    logits = (logits - freq_pen[:, None] * cf
+                              - pres_pen[:, None] * (cf > 0))
                 rng, sub = jax.random.split(rng)
                 sampled = sample_tokens(logits, temp, top_k, top_p, sub)
+                B = sampled.shape[0]
+                if penalized:
+                    # Saturating per-row count bump for this step's token.
+                    b_idx = jnp.arange(B)
+                    cur = cnts[b_idx, sampled]
+                    inc = (live & (cur < 255)).astype(jnp.uint8)
+                    cnts = cnts.at[b_idx, sampled].add(inc)
                 # Logprobs only when some slot asked (lax.cond executes one
                 # branch on TPU: zero cost otherwise).
-                B = sampled.shape[0]
                 lp, top_v, top_i = jax.lax.cond(
                     want_lp,
                     lambda _: _logprobs_of(logits, sampled),
@@ -409,12 +455,14 @@ class ModelRunner:
                     None)
                 tokens = jnp.where(live, sampled, tokens)
                 positions = positions + live.astype(jnp.int32)
-                return (tokens, positions, kbuf, vbuf, rng), (
+                return (tokens, positions, kbuf, vbuf, rng, cnts), (
                     sampled, lp, top_v, top_i)
 
-            (tokens, _, kbuf, vbuf, rng), (toks, lps, top_vs, top_is) = \
-                jax.lax.scan(step, (tokens0, positions0, kbuf0, vbuf0, rng),
-                             jnp.arange(window))
+            carry0 = (tokens0, positions0, kbuf0, vbuf0, rng,
+                      counts if penalized else jnp.zeros((), jnp.uint8))
+            (tokens, _, kbuf, vbuf, rng, counts_out), \
+                (toks, lps, top_vs, top_is) = \
+                jax.lax.scan(step, carry0, jnp.arange(window))
             # Commit the window: scatter every (slot, step) entry into its
             # page. Frozen/inactive entries land on the scratch page 0.
             m_idx = jnp.arange(window)[:, None]                      # [M,1]
@@ -433,15 +481,20 @@ class ModelRunner:
                 kbuf.transpose(0, 1, 3, 2, 4))
             v_cache = v_cache.at[:, :, dest, off].set(
                 vbuf.transpose(0, 1, 3, 2, 4))
+            if penalized:
+                return (toks, lps, top_vs, top_is, tokens, k_cache,
+                        v_cache, rng, counts_out)
             return toks, lps, top_vs, top_is, tokens, k_cache, v_cache, rng
 
-        fn = jax.jit(run_window, donate_argnums=(1, 2))
+        donate = (1, 2, 6) if penalized else (1, 2)
+        fn = jax.jit(run_window, donate_argnums=donate)
         self._window_cache[key] = fn
         return fn
 
     # -- public API (blocking; called from the engine thread) -----------------
     def prefill_batch(self, seqs: list[PrefillSeq],
-                      slots: list[int] | None = None):
+                      slots: list[int] | None = None,
+                      count_rows: np.ndarray | None = None):
         """Prefill a batch of chunks (same compiled program per
         (bucket, padded-batch, with_history) key).
 
@@ -479,6 +532,9 @@ class ModelRunner:
             packed[i, 4] = top_k
             packed[i, 5] = np.float32(top_p).view(np.int32)
             packed[i, 6] = int(s.logprobs)
+            fp, pp = s.penalties
+            packed[i, 7] = np.float32(fp).view(np.int32)
+            packed[i, 8] = np.float32(pp).view(np.int32)
             packed[i, _PF_HDR:_PF_HDR + n] = s.tokens
             # Pad page-table rows stay 0 = the allocator's RESERVED scratch
             # page, so padded block scatters land there — padding with a
@@ -490,11 +546,24 @@ class ModelRunner:
                 off = _PF_HDR + bucket + bucket_pages
                 packed[i, off:off + len(s.hist_pages)] = s.hist_pages
                 packed[i, 2] = s.start_pos
-        fn = self._get_prefill(bucket, bp, with_history)
+        penalized = count_rows is not None
+        fn = self._get_prefill(bucket, bp, with_history, penalized)
         with self.mesh:
-            (sampled, lp, top_v, top_i, logits, self.k_cache, self.v_cache,
-             self._rng) = fn(self.params, self.k_cache, self.v_cache,
-                             jnp.asarray(packed), self._rng)
+            if penalized:
+                rows = np.asarray(count_rows, np.uint8)
+                if rows.shape[0] < bp:  # pad to the batch bucket
+                    rows = np.concatenate(
+                        [rows, np.zeros((bp - rows.shape[0], rows.shape[1]),
+                                        np.uint8)])
+                (sampled, lp, top_v, top_i, logits, self.k_cache,
+                 self.v_cache, self._rng) = fn(
+                    self.params, self.k_cache, self.v_cache,
+                    jnp.asarray(packed), self._rng, jnp.asarray(rows))
+            else:
+                (sampled, lp, top_v, top_i, logits, self.k_cache,
+                 self.v_cache, self._rng) = fn(
+                    self.params, self.k_cache, self.v_cache,
+                    jnp.asarray(packed), self._rng)
         # Device handle (no transfer unless a caller converts it).
         self.last_prefill_logits = logits
         if slots is not None:
@@ -502,6 +571,17 @@ class ModelRunner:
             with self.mesh:
                 self.tokens_dev = self.tokens_dev.at[idx].set(
                     sampled[:len(seqs)])
+                if count_rows is not None:
+                    # Penalty state for these slots: prior generated-token
+                    # counts (zeros for fresh requests; rebuilt rows after
+                    # preemption-recompute) plus this prefill's sampled
+                    # token, which stays on device.
+                    cnt = jnp.asarray(count_rows, jnp.uint8)
+                    sel = sampled[:len(seqs)]
+                    n = jnp.arange(len(seqs))
+                    bumped = cnt.at[n, sel].add(
+                        (cnt[n, sel] < 255).astype(jnp.uint8))
+                    self.counts_dev = self.counts_dev.at[idx].set(bumped)
             for arr in (sampled, lp, top_v, top_i):
                 try:
                     arr.copy_to_host_async()
@@ -513,15 +593,29 @@ class ModelRunner:
 
     def prefill(self, tokens: np.ndarray, start_pos: int,
                 chunk_pages: np.ndarray, hist_pages: np.ndarray | None,
-                sampling: tuple[float, int, float]) -> tuple[int, jax.Array]:
+                sampling: tuple[float, int, float],
+                penalties: tuple[float, float] = (0.0, 0.0),
+                count_row: np.ndarray | None = None) -> tuple[int, jax.Array]:
         """Single-sequence prefill chunk; returns (sampled_token,
         last-position logits [1,V])."""
         seq = PrefillSeq(tokens=np.asarray(tokens, np.int32),
                          start_pos=start_pos,
                          chunk_pages=np.asarray(chunk_pages, np.int32),
-                         hist_pages=hist_pages, sampling=sampling)
-        token = int(self.prefill_batch([seq])[0])
+                         hist_pages=hist_pages, sampling=sampling,
+                         penalties=penalties)
+        token = int(self.prefill_batch(
+            [seq], count_rows=None if count_row is None
+            else count_row[None])[0])
         return token, self.last_prefill_logits[:1]
+
+    def set_count_rows(self, slots: list[int], rows: np.ndarray) -> None:
+        """Install penalty-count rows for slots whose first token is
+        already known host-side (chunked-prefill finish, KV-injected
+        admission): the engine builds the row including that token."""
+        with self.mesh:
+            self.counts_dev = self.counts_dev.at[
+                jnp.asarray(np.asarray(slots, np.int32))].set(
+                jnp.asarray(rows, jnp.uint8))
 
     def bucket_pages_for(self, needed: int) -> int:
         """Page-table width bucket (power of two, >= 8) for the decode
@@ -542,12 +636,24 @@ class ModelRunner:
         zeros unless some slot set PK_LOGPROB.
         """
         bucket_pages = packed.shape[1] - PK_PREFIX
-        fn = self._get_window(window, bucket_pages)
+        # Specialize on whether any slot carries penalties THIS window —
+        # derived from the packed array, so multihost followers replaying
+        # the same control data pick the same program.
+        penalized = bool(packed[:, PK_FREQPEN].any()
+                         or packed[:, PK_PRESPEN].any())
+        fn = self._get_window(window, bucket_pages, penalized)
         with self.mesh:
-            (toks, lps, top_vs, top_is, self.tokens_dev, self.k_cache,
-             self.v_cache, self._rng) = fn(
-                self.params, self.k_cache, self.v_cache, self.tokens_dev,
-                jnp.asarray(packed), self._rng)
+            if penalized:
+                (toks, lps, top_vs, top_is, self.tokens_dev, self.k_cache,
+                 self.v_cache, self._rng, self.counts_dev) = fn(
+                    self.params, self.k_cache, self.v_cache,
+                    self.tokens_dev, jnp.asarray(packed), self._rng,
+                    self.counts_dev)
+            else:
+                (toks, lps, top_vs, top_is, self.tokens_dev, self.k_cache,
+                 self.v_cache, self._rng) = fn(
+                    self.params, self.k_cache, self.v_cache,
+                    self.tokens_dev, jnp.asarray(packed), self._rng)
         return toks, lps, top_vs, top_is
 
     def embed(self, token_lists: list[list[int]],

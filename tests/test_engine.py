@@ -313,17 +313,19 @@ async def test_warmup_windows_precompiles_and_serves():
         lambda packed, window: calls.append(("window", window))
         or orig_win(packed, window))
     eng.runner.prefill_batch = (
-        lambda seqs, slots=None: calls.append(("prefill", slots))
-        or orig_pre(seqs, slots))
+        lambda seqs, slots=None, count_rows=None:
+        calls.append(("prefill", slots))
+        or orig_pre(seqs, slots, count_rows))
     eng.start()
     try:
         rng = np.random.default_rng(7)
         prompt = rng.integers(0, SPEC.vocab_size, size=20).tolist()
         got, finish = await collect(eng, prompt, 8)
         assert finish == "length" and len(got) == 8
-        # Warmup ran before the serving dispatches: first window call is
-        # the warmup's, first prefill call is the inert slots=None one.
+        # Warmup ran before the serving dispatches: both window variants
+        # (plain, penalized) then the inert slots=None prefill.
         assert calls[0] == ("window", eng.decode_window)
-        assert calls[1] == ("prefill", None)
+        assert calls[1] == ("window", eng.decode_window)
+        assert calls[2] == ("prefill", None)
     finally:
         eng.stop()
