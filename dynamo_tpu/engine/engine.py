@@ -197,6 +197,14 @@ class TPUEngine(AsyncEngine):
                 log.warning("%s=%s outside [-2, 2]; clamping to %s",
                             field, val, clamped)
                 setattr(s, field, clamped)
+        if getattr(s, "seed", None) is not None:
+            # The engine's rng is a single stream threaded through the
+            # batched device programs; per-request seeding needs per-slot
+            # key derivation in the sampler and is not implemented. Say
+            # so instead of silently ignoring the field.
+            log.warning("sampling seed=%s is not supported by this engine "
+                        "(single batched rng stream); proceeding unseeded",
+                        s.seed)
 
     async def generate(self, request, context: Context) -> AsyncIterator[dict]:
         self.start()

@@ -114,3 +114,19 @@ async def test_penalty_validation_clamps():
         assert len(toks) == 4
     finally:
         engine.stop()
+
+
+@async_test
+async def test_penalties_under_tensor_parallelism():
+    """Penalty math holds when the model (and logits) shard over tp:
+    greedy penalized output matches the tp=1 engine token-for-token."""
+    outs = {}
+    for tp in (1, 2):
+        engine = TPUEngine(tiny_config(tp=tp))
+        try:
+            outs[tp] = await run_one(engine, list(range(11, 31)), 16,
+                                     presence_penalty=2.0)
+        finally:
+            engine.stop()
+    assert outs[1] == outs[2]
+    assert len(set(outs[1])) == len(outs[1])
