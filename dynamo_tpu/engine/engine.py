@@ -34,7 +34,7 @@ from dynamo_tpu.engine.kv_cache import PageAllocator
 from dynamo_tpu.engine.runner import (
     ModelRunner, PrefillSeq, PK_OVERRIDE, PK_TOKEN, PK_POS, PK_SEQLEN,
     PK_TOPK, PK_TEMP, PK_TOPP, PK_CAP, PK_LOGPROB, PK_FREQPEN, PK_PRESPEN,
-    PK_PREFIX, TOP_LOGPROBS)
+    PK_SEED, PK_SEEDED, PK_PREFIX, TOP_LOGPROBS)
 from dynamo_tpu.engine.sampler import MAX_TOPK
 from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics, KvStats, WorkerStats
 from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput, PreprocessedRequest
@@ -120,6 +120,8 @@ class TPUEngine(AsyncEngine):
         self.top_p = np.ones(b, np.float32)
         self.freq_pen = np.zeros(b, np.float32)
         self.pres_pen = np.zeros(b, np.float32)
+        self.seeds = np.zeros(b, np.int32)
+        self.seeded = np.zeros(b, bool)
         self.overrides: dict[int, int] = {}  # slot -> first token next window
         self.waiting: queue.Queue[_Request] = queue.Queue()
         self.num_waiting = 0
@@ -190,6 +192,12 @@ class TPUEngine(AsyncEngine):
                 "sample among the top-%d logits)", s.top_k, MAX_TOPK,
                 MAX_TOPK)
             s.top_k = MAX_TOPK
+        if getattr(s, "seed", None) is not None and \
+                not 0 <= s.seed <= 0x7FFFFFFF:
+            from dynamo_tpu.engine.runner import mask_seed
+            log.warning("seed=%s outside the engine's 31-bit seed space; "
+                        "using %d (distinct large seeds can collide)",
+                        s.seed, mask_seed(s.seed))
         for field in ("frequency_penalty", "presence_penalty"):
             val = getattr(s, field, None)
             if val is not None and not -2.0 <= val <= 2.0:
@@ -197,14 +205,7 @@ class TPUEngine(AsyncEngine):
                 log.warning("%s=%s outside [-2, 2]; clamping to %s",
                             field, val, clamped)
                 setattr(s, field, clamped)
-        if getattr(s, "seed", None) is not None:
-            # The engine's rng is a single stream threaded through the
-            # batched device programs; per-request seeding needs per-slot
-            # key derivation in the sampler and is not implemented. Say
-            # so instead of silently ignoring the field.
-            log.warning("sampling seed=%s is not supported by this engine "
-                        "(single batched rng stream); proceeding unseeded",
-                        s.seed)
+
 
     async def generate(self, request, context: Context) -> AsyncIterator[dict]:
         self.start()
@@ -358,6 +359,14 @@ class TPUEngine(AsyncEngine):
         packed_pen = packed.copy()
         packed_pen[0, PK_FREQPEN] = np.float32(1.0).view(np.int32)
         outs = self.runner.decode_window(packed_pen, self.decode_window)
+        np.asarray(outs[0])
+        packed_seed = packed.copy()
+        packed_seed[0, PK_SEEDED] = 1
+        outs = self.runner.decode_window(packed_seed, self.decode_window)
+        np.asarray(outs[0])
+        packed_both = packed_seed.copy()
+        packed_both[0, PK_FREQPEN] = np.float32(1.0).view(np.int32)
+        outs = self.runner.decode_window(packed_both, self.decode_window)
         np.asarray(outs[0])
         log.info("warmed window programs M=%d in %.1fs", self.decode_window,
                  time.monotonic() - t0)
@@ -743,7 +752,7 @@ class TPUEngine(AsyncEngine):
             start_pos=reuse_tokens, chunk_pages=chunk_pages,
             hist_pages=hist, sampling=self._sampling_of(r),
             logprobs=r.req.sampling_options.logprobs is not None,
-            penalties=self._penalties_of(r))
+            penalties=self._penalties_of(r), seed=self._seed_of(r))
 
     def _prefill_chunked(self, r: _Request, slot: int) -> None:
         """Long prompt: prefill in page-aligned chunks with history."""
@@ -796,7 +805,8 @@ class TPUEngine(AsyncEngine):
                 hist if len(hist) else None, self._sampling_of(r),
                 penalties=pen,
                 count_row=self._count_row_of(r)
-                if final and any(pen) else None)
+                if final and any(pen) else None,
+                seed=self._seed_of(r) if final else None)
             start += n
             if start >= len(prompt):
                 first_token = token
@@ -806,6 +816,16 @@ class TPUEngine(AsyncEngine):
     def _sampling_of(self, r: _Request) -> tuple[float, int, float]:
         s = r.req.sampling_options
         return (s.temperature or 0.0, s.top_k or 0, s.top_p or 1.0)
+
+    def _set_seed_slot(self, r: _Request, slot: int) -> None:
+        from dynamo_tpu.engine.runner import mask_seed
+        seed = self._seed_of(r)
+        self.seeded[slot] = seed is not None
+        self.seeds[slot] = 0 if seed is None else mask_seed(seed)
+
+    @staticmethod
+    def _seed_of(r: _Request) -> int | None:
+        return getattr(r.req.sampling_options, "seed", None)
 
     @staticmethod
     def _penalties_of(r: _Request) -> tuple[float, float]:
@@ -842,6 +862,7 @@ class TPUEngine(AsyncEngine):
         self.top_k[slot] = tk
         self.top_p[slot] = tp
         self.freq_pen[slot], self.pres_pen[slot] = self._penalties_of(r)
+        self._set_seed_slot(r, slot)
         self.overrides.pop(slot, None)
 
     def _place_in_slot(self, r: _Request, slot: int, first_token: int,
@@ -871,6 +892,7 @@ class TPUEngine(AsyncEngine):
         self.top_p[slot] = tp
         fp, pp = self._penalties_of(r)
         self.freq_pen[slot], self.pres_pen[slot] = fp, pp
+        self._set_seed_slot(r, slot)
         if fp or pp:
             # tokens_all already includes first_token (appended above).
             self.runner.set_count_rows([slot], self._count_row_of(r)[None])
@@ -986,6 +1008,8 @@ class TPUEngine(AsyncEngine):
                 packed[i, PK_LOGPROB] = 1
             packed[i, PK_FREQPEN] = self.freq_pen[i:i + 1].view(np.int32)[0]
             packed[i, PK_PRESPEN] = self.pres_pen[i:i + 1].view(np.int32)[0]
+            packed[i, PK_SEED] = self.seeds[i]
+            packed[i, PK_SEEDED] = int(self.seeded[i])
             packed[i, PK_PREFIX:PK_PREFIX + len(r.pages)] = r.pages
             slots[i] = (r, r.epoch, start, cap)
             adv = min(M, max(0, cap - start))

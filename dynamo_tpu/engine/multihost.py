@@ -92,7 +92,8 @@ def _pack_seq(s) -> dict:
             "sampling": [float(s.sampling[0]), int(s.sampling[1]),
                          float(s.sampling[2])],
             "logprobs": bool(s.logprobs),
-            "penalties": [float(s.penalties[0]), float(s.penalties[1])]}
+            "penalties": [float(s.penalties[0]), float(s.penalties[1])],
+            "seed": None if s.seed is None else int(s.seed)}
 
 
 def _unpack_seq(d: dict):
@@ -105,7 +106,8 @@ def _unpack_seq(d: dict):
                       hist_pages=_unpack_array(d["hist_pages"]),
                       sampling=(float(t), int(k), float(p)),
                       logprobs=d["logprobs"],
-                      penalties=(float(fp), float(pp)))
+                      penalties=(float(fp), float(pp)),
+                      seed=d.get("seed"))
 
 
 class LeaderRunner:
@@ -158,17 +160,17 @@ class LeaderRunner:
         return self._inner.set_count_rows(slots, rows)
 
     def prefill(self, tokens, start_pos, chunk_pages, hist_pages, sampling,
-                penalties=(0.0, 0.0), count_row=None):
+                penalties=(0.0, 0.0), count_row=None, seed=None):
         from dynamo_tpu.engine.runner import PrefillSeq
         self._publish({"m": "prefill", "seq": _pack_seq(PrefillSeq(
             tokens=np.asarray(tokens, np.int32), start_pos=start_pos,
             chunk_pages=np.asarray(chunk_pages, np.int32),
             hist_pages=hist_pages, sampling=sampling,
-            penalties=penalties)),
+            penalties=penalties, seed=seed)),
             "count_row": _pack_array(count_row)})
         return self._inner.prefill(tokens, start_pos, chunk_pages,
                                    hist_pages, sampling, penalties,
-                                   count_row)
+                                   count_row, seed)
 
     def decode_window(self, packed: np.ndarray, window: int):
         self._publish({"m": "decode_window", "packed": _pack_array(packed),
@@ -274,7 +276,8 @@ async def run_follower(config, client, group: str, node_rank: int,
                     s = _unpack_seq(msg["seq"])
                     runner.prefill(s.tokens, s.start_pos, s.chunk_pages,
                                    s.hist_pages, s.sampling, s.penalties,
-                                   _unpack_array(msg.get("count_row")))
+                                   _unpack_array(msg.get("count_row")),
+                                   s.seed)
                 elif m == "decode_window":
                     runner.decode_window(_unpack_array(msg["packed"]),
                                          msg["window"])

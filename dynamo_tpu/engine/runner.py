@@ -44,7 +44,7 @@ from dynamo_tpu.engine.model import (
     decode_forward,
     decode_window_step,
 )
-from dynamo_tpu.engine.sampler import sample_tokens
+from dynamo_tpu.engine.sampler import sample_tokens, sample_tokens_per_row
 from dynamo_tpu.runtime.logging import get_logger
 
 log = get_logger("runner")
@@ -63,12 +63,24 @@ PK_LOGPROB = 8    # 1 -> this slot wants logprobs (window computes them
                   # when ANY slot asks; per-slot filtering is host-side)
 PK_FREQPEN = 9    # float32 bits: OpenAI frequency_penalty (0 = off)
 PK_PRESPEN = 10   # float32 bits: OpenAI presence_penalty (0 = off)
-PK_PREFIX = 11    # page table starts here
+PK_SEED = 11      # int32 sampling seed (meaningful when PK_SEEDED)
+PK_SEEDED = 12    # 1 -> slot uses a per-request seeded rng stream
+PK_PREFIX = 13    # page table starts here
 
 TOP_LOGPROBS = 8  # alternatives returned when logprobs are requested
 
-_PF_HDR = 10      # prefill packed-array header columns (7 freq-penalty
-                  # bits, 8 pres-penalty bits, 9 spare)
+SEED_MASK = 0x7FFFFFFF  # seeds ride int32 control columns: 31 usable bits
+
+
+def mask_seed(seed: int) -> int:
+    """The ONE place a request seed maps to its on-device value — the
+    prefill and window paths must fold the identical base key or
+    preemption-recompute would diverge from the original draws."""
+    return int(seed) & SEED_MASK
+
+_PF_HDR = 12      # prefill packed-array header columns (7 freq-penalty
+                  # bits, 8 pres-penalty bits, 9 seed, 10 seeded flag,
+                  # 11 spare)
 
 
 def _logprobs_of(logits: jax.Array, sampled: jax.Array):
@@ -90,6 +102,7 @@ class PrefillSeq:
     sampling: tuple[float, int, float]  # (temperature, top_k, top_p)
     logprobs: bool = False      # row wants first-token logprobs
     penalties: tuple[float, float] = (0.0, 0.0)  # (frequency, presence)
+    seed: int | None = None     # per-request sampling seed
 
 
 def _mh_put(value, sharding):
@@ -282,8 +295,8 @@ class ModelRunner:
 
     # -- compiled steps -------------------------------------------------------
     def _get_prefill(self, bucket: int, batch: int, with_history: bool,
-                     penalized: bool = False):
-        key = (bucket, batch, with_history, penalized)
+                     penalized: bool = False, seeded: bool = False):
+        key = (bucket, batch, with_history, penalized, seeded)
         fn = self._prefill_cache.get(key)
         if fn is not None:
             return fn
@@ -294,8 +307,9 @@ class ModelRunner:
         # All host inputs travel in ONE packed int32 array (floats bitcast):
         # h2d transfers are latency-bound, so one transfer beats ten.
         # Columns: 0 start_pos, 1 n_tokens, 2 hist_len, 3 temp bits,
-        # 4 top_k, 5 top_p bits, 6 logprobs flag, 7/8 penalty bits, then
-        # tokens[bucket], ptab[bucket_pages], htab[maxp if with_history].
+        # 4 top_k, 5 top_p bits, 6 logprobs flag, 7/8 penalty bits,
+        # 9 seed, 10 seeded flag, 11 spare, then tokens[bucket],
+        # ptab[bucket_pages], htab[maxp if with_history].
         # The penalized variant (preemption-recompute of a penalized
         # request) additionally reads prior-generation counts so even the
         # re-sampled token respects the penalties.
@@ -334,7 +348,20 @@ class ModelRunner:
                 logits = (logits - freq[:, None] * cf
                           - pres[:, None] * (cf > 0))
             rng, sub = jax.random.split(rng)
-            sampled = sample_tokens(logits, temp, top_k, top_p, sub)
+            if seeded:
+                # First generated token lands at position start + n.
+                seed_flag = packed[:, 10] > 0
+                base_keys = jax.vmap(jax.random.key)(packed[:, 9])
+                per_seed = jax.vmap(jax.random.fold_in)(base_keys, start + n)
+                shared = jax.random.split(sub, temp.shape[0])
+                row_keys = jax.random.wrap_key_data(jnp.where(
+                    seed_flag[:, None],
+                    jax.random.key_data(per_seed),
+                    jax.random.key_data(shared)))
+                sampled = sample_tokens_per_row(logits, temp, top_k, top_p,
+                                                row_keys)
+            else:
+                sampled = sample_tokens(logits, temp, top_k, top_p, sub)
             B = sampled.shape[0]
             lp, top_v, top_i = jax.lax.cond(
                 jnp.any(packed[:, 6] > 0),
@@ -367,13 +394,14 @@ class ModelRunner:
         return self._decode_fn
 
     def _get_window(self, window: int, bucket_pages: int,
-                    penalized: bool = False):
-        """Window program, specialized on ``penalized``: the frequency/
-        presence-penalty variant threads the [B, V] counts state through
-        the scan and pays its read per step; the common variant is the
-        exact unpenalized program, so serving without penalties costs
-        nothing extra."""
-        key = (window, bucket_pages, penalized)
+                    penalized: bool = False, seeded: bool = False):
+        """Window program, specialized on ``penalized`` and ``seeded``:
+        the penalty variant threads the [B, V] counts state through the
+        scan; the seeded variant derives each slot's PRNG key from
+        (seed, token position), making a seeded request's draws
+        batch-invariant and preemption-stable. The common variant is the
+        exact plain program, so default serving costs nothing extra."""
+        key = (window, bucket_pages, penalized, seeded)
         fn = self._window_cache.get(key)
         if fn is not None:
             return fn
@@ -396,6 +424,9 @@ class ModelRunner:
                                                     jnp.float32)
             pres_pen = jax.lax.bitcast_convert_type(packed[:, PK_PRESPEN],
                                                     jnp.float32)
+            if seeded:
+                seed_flag = packed[:, PK_SEEDED] > 0
+                base_keys = jax.vmap(jax.random.key)(packed[:, PK_SEED])
             page_table = packed[:, PK_PREFIX:]
             B = tokens0.shape[0]
             L, nkv = spec.num_layers, spec.num_kv_heads
@@ -436,7 +467,21 @@ class ModelRunner:
                     logits = (logits - freq_pen[:, None] * cf
                               - pres_pen[:, None] * (cf > 0))
                 rng, sub = jax.random.split(rng)
-                sampled = sample_tokens(logits, temp, top_k, top_p, sub)
+                if seeded:
+                    # The token being sampled lands at positions + 1: fold
+                    # the request seed with that absolute position, so the
+                    # draw depends only on (seed, position, logits).
+                    per_seed = jax.vmap(jax.random.fold_in)(
+                        base_keys, positions + 1)
+                    shared = jax.random.split(sub, temp.shape[0])
+                    row_keys = jax.random.wrap_key_data(jnp.where(
+                        seed_flag[:, None],
+                        jax.random.key_data(per_seed),
+                        jax.random.key_data(shared)))
+                    sampled = sample_tokens_per_row(logits, temp, top_k,
+                                                    top_p, row_keys)
+                else:
+                    sampled = sample_tokens(logits, temp, top_k, top_p, sub)
                 B = sampled.shape[0]
                 if penalized:
                     # Saturating per-row count bump for this step's token.
@@ -535,6 +580,9 @@ class ModelRunner:
             fp, pp = s.penalties
             packed[i, 7] = np.float32(fp).view(np.int32)
             packed[i, 8] = np.float32(pp).view(np.int32)
+            if s.seed is not None:
+                packed[i, 9] = mask_seed(s.seed)
+                packed[i, 10] = 1
             packed[i, _PF_HDR:_PF_HDR + n] = s.tokens
             # Pad page-table rows stay 0 = the allocator's RESERVED scratch
             # page, so padded block scatters land there — padding with a
@@ -547,7 +595,8 @@ class ModelRunner:
                 packed[i, off:off + len(s.hist_pages)] = s.hist_pages
                 packed[i, 2] = s.start_pos
         penalized = count_rows is not None
-        fn = self._get_prefill(bucket, bp, with_history, penalized)
+        seeded = any(s.seed is not None for s in seqs)
+        fn = self._get_prefill(bucket, bp, with_history, penalized, seeded)
         with self.mesh:
             if penalized:
                 rows = np.asarray(count_rows, np.uint8)
@@ -595,14 +644,15 @@ class ModelRunner:
                 chunk_pages: np.ndarray, hist_pages: np.ndarray | None,
                 sampling: tuple[float, int, float],
                 penalties: tuple[float, float] = (0.0, 0.0),
-                count_row: np.ndarray | None = None) -> tuple[int, jax.Array]:
+                count_row: np.ndarray | None = None,
+                seed: int | None = None) -> tuple[int, jax.Array]:
         """Single-sequence prefill chunk; returns (sampled_token,
         last-position logits [1,V])."""
         seq = PrefillSeq(tokens=np.asarray(tokens, np.int32),
                          start_pos=start_pos,
                          chunk_pages=np.asarray(chunk_pages, np.int32),
                          hist_pages=hist_pages, sampling=sampling,
-                         penalties=penalties)
+                         penalties=penalties, seed=seed)
         token = int(self.prefill_batch(
             [seq], count_rows=None if count_row is None
             else count_row[None])[0])
@@ -641,7 +691,8 @@ class ModelRunner:
         # the same control data pick the same program.
         penalized = bool(packed[:, PK_FREQPEN].any()
                          or packed[:, PK_PRESPEN].any())
-        fn = self._get_window(window, bucket_pages, penalized)
+        seeded = bool(packed[:, PK_SEEDED].any())
+        fn = self._get_window(window, bucket_pages, penalized, seeded)
         with self.mesh:
             if penalized:
                 (toks, lps, top_vs, top_is, self.tokens_dev, self.k_cache,

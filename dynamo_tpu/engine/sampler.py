@@ -66,3 +66,54 @@ def sample_tokens(logits: jax.Array, temperature: jax.Array,
     sampled = jax.lax.cond(jnp.any(sampling), do_sample, lambda _: greedy,
                            None)
     return jnp.where(sampling, sampled, greedy)
+
+
+def sample_tokens_per_row(logits: jax.Array, temperature: jax.Array,
+                          top_k: jax.Array, top_p: jax.Array,
+                          keys: jax.Array) -> jax.Array:
+    """Like :func:`sample_tokens` but with an independent PRNG key PER ROW
+    (``keys`` [B] key array) — the seeded-request path. Categorical
+    sampling becomes gumbel-max with per-row noise, which makes a seeded
+    row's draw depend only on its own key and logits: batch composition,
+    other slots' seeds, and preemption/replacement cannot perturb it."""
+    b, v = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    filtered = (top_k > 0) | (top_p < 1.0)
+    sampling = temperature > 0
+
+    def do_sample(_):
+        safe_t = jnp.where(sampling, temperature, 1.0)
+        scaled = logits / safe_t[:, None]
+        # ONE noise field per row, indexed by TOKEN ID. The filtered path
+        # gathers noise by candidate token id (not candidate rank), so the
+        # draw is independent of candidate ordering — bf16 reduction-order
+        # jitter between compute paths (fresh vs cached-prefix prefill)
+        # reorders near-tied candidates and would otherwise remap the
+        # noise and break seeded reproducibility.
+        noise_full = jax.vmap(
+            lambda k: jax.random.gumbel(k, (v,)))(keys)
+        full_sample = jnp.argmax(scaled + noise_full, axis=-1)
+
+        def do_filtered(_):
+            max_k = min(MAX_TOPK, v)
+            cand, cand_idx = jax.lax.top_k(scaled, max_k)
+            pos = jnp.arange(max_k)[None, :]
+            k_eff = jnp.where(top_k > 0, jnp.minimum(top_k, max_k), max_k)
+            keep_k = pos < k_eff[:, None]
+            probs = jax.nn.softmax(jnp.where(keep_k, cand, -jnp.inf), axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            keep_p = (cum - probs) < top_p[:, None]
+            masked = jnp.where(keep_k & keep_p, cand, -jnp.inf)
+            noise = jnp.take_along_axis(noise_full, cand_idx, axis=1)
+            choice = jnp.argmax(masked + noise, axis=-1)
+            return jnp.take_along_axis(
+                cand_idx, choice[:, None], axis=1)[:, 0]
+
+        top_sample = jax.lax.cond(jnp.any(filtered & sampling), do_filtered,
+                                  lambda _: full_sample, None)
+        return jnp.where(filtered, top_sample,
+                         full_sample).astype(jnp.int32)
+
+    sampled = jax.lax.cond(jnp.any(sampling), do_sample, lambda _: greedy,
+                           None)
+    return jnp.where(sampling, sampled, greedy)

@@ -322,10 +322,10 @@ async def test_warmup_windows_precompiles_and_serves():
         prompt = rng.integers(0, SPEC.vocab_size, size=20).tolist()
         got, finish = await collect(eng, prompt, 8)
         assert finish == "length" and len(got) == 8
-        # Warmup ran before the serving dispatches: both window variants
-        # (plain, penalized) then the inert slots=None prefill.
-        assert calls[0] == ("window", eng.decode_window)
-        assert calls[1] == ("window", eng.decode_window)
-        assert calls[2] == ("prefill", None)
+        # Warmup ran before the serving dispatches: the four window
+        # variants (plain, penalized, seeded, penalized+seeded) then the
+        # inert slots=None prefill.
+        assert calls[:4] == [("window", eng.decode_window)] * 4
+        assert calls[4] == ("prefill", None)
     finally:
         eng.stop()
