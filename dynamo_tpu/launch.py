@@ -196,20 +196,25 @@ async def run_batch(served: ServedModel, args) -> None:
             req = ChatCompletionRequest(
                 model=served.name, messages=messages,
                 max_tokens=int(job.get("max_tokens", args.batch_max_tokens)),
-                temperature=job.get("temperature", 0.0), stream=True)
+                temperature=job.get("temperature", 0.0), stream=True,
+                stream_options={"include_usage": True})
             text, n_tokens, finish = [], 0, None
             async with sem:
                 t0 = time.monotonic()
                 t_first = None
                 async for chunk in served.preprocessor.generate(req,
                                                                 Context()):
+                    # Token counts come from the usage block (detokenizer
+                    # delta chunks are not 1:1 with tokens).
+                    usage = chunk.get("usage")
+                    if usage:
+                        n_tokens = usage.get("completion_tokens", n_tokens)
                     for choice in chunk.get("choices", []):
                         piece = choice.get("delta", {}).get("content")
                         if piece:
                             if t_first is None:
                                 t_first = time.monotonic()
                             text.append(piece)
-                            n_tokens += 1
                         if choice.get("finish_reason"):
                             finish = choice["finish_reason"]
                 elapsed = time.monotonic() - t0

@@ -242,16 +242,28 @@ class FileStore:
     async def kv_create(self, key: str, value: Any,
                         lease_id: int | None = None,
                         use_primary_lease: bool = False) -> bool:
-        # O_EXCL reserves the key atomically across processes; the content
-        # lands with the follow-up put. A reader racing the gap sees an
-        # empty file, which _read treats as absent — same as not-yet-created.
-        try:
-            os.close(os.open(self._path(key),
-                             os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o644))
-        except FileExistsError:
-            return False
-        await self.kv_put(key, value)
-        return True
+        # Reservation and content are ONE atomic step: the full document
+        # is written to a tmp file and link()ed into place (fails if the
+        # key exists). A crash can no longer leave an empty reserved file
+        # that wedges the key (kv_create False forever, kv_get absent).
+        created = False
+
+        def write(rev: int) -> None:
+            nonlocal created
+            doc = {"k": key, "v": value, "rev": rev}
+            tmp = self._path(key) + f".tmp{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh)
+            try:
+                os.link(tmp, self._path(key))
+                created = True
+            except FileExistsError:
+                created = False
+            finally:
+                os.unlink(tmp)
+
+        self._with_rev_lock(write)
+        return created
 
     async def kv_get(self, key: str) -> Any | None:
         doc = self._read(self._path(key))

@@ -27,15 +27,22 @@ import functools
 import pytest
 
 
-def async_test(fn):
+def async_test(fn=None, *, timeout: float = 120):
     """Run an async test function to completion on a fresh event loop
-    (pytest-asyncio is not available in this environment)."""
+    (pytest-asyncio is not available in this environment). Use
+    ``@async_test`` for the default budget or ``@async_test(timeout=N)``
+    for e2e tests whose bring-up scales with machine load (multi-process
+    spawns compiling JAX programs on a contended box)."""
 
-    @functools.wraps(fn)
-    def wrapper(*args, **kwargs):
-        return asyncio.run(asyncio.wait_for(fn(*args, **kwargs), timeout=120))
+    def deco(f):
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            return asyncio.run(
+                asyncio.wait_for(f(*args, **kwargs), timeout=timeout))
 
-    return wrapper
+        return wrapper
+
+    return deco if fn is None else deco(fn)
 
 
 @pytest.fixture

@@ -39,7 +39,7 @@ def _spawn(args, log_path):
                             stdout=fh, stderr=subprocess.STDOUT, cwd=REPO)
 
 
-def _wait_ready(log_path, timeout=120.0) -> dict:
+def _wait_ready(log_path, timeout=420.0) -> dict:
     """Poll a worker log for its TPU_WORKER_READY line; returns fields."""
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
@@ -76,8 +76,12 @@ class _VictimFirstEngine(AsyncEngine):
             yield item
 
 
-@async_test
+@async_test(timeout=600)
 async def test_sigkill_mid_stream_migrates_to_survivor(tmp_path):
+    # The budget is sized for a CONTENDED machine (round-3 VERDICT weak
+    # #3: the 120s default flaked 2/4 when the rest of the suite ran
+    # concurrently on 1 vCPU): two worker processes each compile several
+    # XLA programs before READY, which takes minutes under load.
     procs = []
     try:
         coord = _spawn(["dynamo_tpu.runtime.coordinator", "--host",
