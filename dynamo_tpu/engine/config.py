@@ -31,6 +31,10 @@ class ModelSpec:
     # MoE (Mixtral family): num_experts == 0 means dense FFN.
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # Weight-only quantization: None (bf16) or "int8" (engine/quant.py —
+    # int8 storage, bf16 MXU compute; halves the weight-read roofline and
+    # fits full llama-3-8b on one 16 GB v5e).
+    quant: str | None = None
 
     def __post_init__(self):
         if self.head_dim is None:
@@ -66,7 +70,8 @@ class ModelSpec:
         profiling) — override per part with DTPU_HBM_GBPS."""
         if hbm_gbps is None:
             hbm_gbps = float(os.environ.get("DTPU_HBM_GBPS", "819"))
-        shard_bytes = self.num_params() * 2 / max(1, tp * pp)
+        per_weight = 1.0 if self.quant == "int8" else 2.0
+        shard_bytes = self.num_params() * per_weight / max(1, tp * pp)
         return shard_bytes / (hbm_gbps * 1e9) * 1e3
 
     @classmethod
@@ -147,6 +152,13 @@ class EngineConfig:
     # resolves to M=32, an unsharded 8B to M=4, an 8B shard at tp=4 to
     # M=12 (docs/PERF_NOTES.md sweep is where the target comes from).
     decode_window: int | str = 8
+    # Microbatched pipeline-parallel PREFILL (model.prefill_forward_
+    # pipelined): with pp > 1, whole-prompt prefill batches split into pp
+    # microbatches flowing through the layer stages concurrently
+    # (GPipe-style) instead of every stage idling while one batch
+    # traverses the others' layers. Decode and history-chunk prefill keep
+    # the layer-sharded path. Requires batch-bucket % pp == 0 to engage.
+    pp_microbatch: bool = False
     # Compile the decode-window program and the smallest prefill bucket
     # on the engine thread before serving, so a first short request
     # doesn't pay those XLA compile stalls (larger prefill buckets still

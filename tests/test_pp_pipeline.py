@@ -1,0 +1,148 @@
+"""Microbatched pipeline-parallel prefill tests
+(model.prefill_forward_pipelined; round-3 VERDICT missing #4).
+
+Correctness: pp=2 microbatched prefill produces the same greedy tokens
+and (near-)identical logits and KV as the pp=1 path. Overlap artifact:
+the lowered program shifts the stage buffer with a collective-permute
+over the "pp" axis — the stages really run concurrently rather than
+serializing layer by layer.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+from conftest import async_test
+
+from dynamo_tpu.engine.config import EngineConfig, PRESETS
+from dynamo_tpu.engine.runner import ModelRunner, PrefillSeq
+
+SPEC = PRESETS["tiny-test"]  # 2 layers -> pp=2 puts one per stage
+PAGE = 16
+
+
+def cfg(**kw) -> EngineConfig:
+    defaults = dict(model=SPEC, page_size=PAGE, num_pages=64,
+                    max_pages_per_seq=16, max_num_seqs=8,
+                    prefill_buckets=(32, 64), max_prefill_tokens=64,
+                    attention_backend="xla")
+    defaults.update(kw)
+    return EngineConfig(**defaults)
+
+
+def _seqs(n_rows: int, n_tok: int = 32):
+    rng = np.random.default_rng(3)
+    seqs = []
+    for i in range(n_rows):
+        pages = np.asarray([1 + 2 * i, 2 + 2 * i], np.int32)
+        seqs.append(PrefillSeq(
+            tokens=rng.integers(0, SPEC.vocab_size, n_tok).astype(np.int32),
+            start_pos=0, chunk_pages=pages, hist_pages=None,
+            sampling=(0.0, 0, 1.0)))
+    return seqs
+
+
+def test_pp2_microbatched_matches_pp1():
+    """Greedy tokens identical, logits close, KV pages close — the
+    VERDICT 'done' criterion (tokens identical to pp=1)."""
+    a = ModelRunner(cfg(pp=2, pp_microbatch=True))
+    b = ModelRunner(cfg())
+    seqs = _seqs(4)
+    ta = a.prefill_batch([dataclasses.replace(s) for s in seqs])
+    la = np.asarray(a.last_prefill_logits, np.float32)
+    tb = b.prefill_batch([dataclasses.replace(s) for s in seqs])
+    lb = np.asarray(b.last_prefill_logits, np.float32)
+    assert ta.tolist() == tb.tolist()
+    np.testing.assert_allclose(la[:4], lb[:4], rtol=2e-2, atol=2e-2)
+    pages = [p for s in seqs for p in s.chunk_pages.tolist()]
+    kva = a.extract_pages(pages).astype(np.float32)
+    kvb = b.extract_pages(pages).astype(np.float32)
+    np.testing.assert_allclose(kva, kvb, rtol=2e-2, atol=2e-2)
+
+
+def test_pp2_microbatched_matches_plain_pp2_bitexact():
+    """Same mesh, same shardings, same per-row math: the pipelined
+    schedule must not change RESULTS at all vs the layer-sharded pp=2
+    path (bit-exact greedy tokens + KV)."""
+    a = ModelRunner(cfg(pp=2, pp_microbatch=True))
+    b = ModelRunner(cfg(pp=2))
+    seqs = _seqs(4)
+    ta = a.prefill_batch([dataclasses.replace(s) for s in seqs])
+    tb = b.prefill_batch([dataclasses.replace(s) for s in seqs])
+    assert ta.tolist() == tb.tolist()
+    pages = [p for s in seqs for p in s.chunk_pages.tolist()]
+    kva = a.extract_pages(pages)
+    kvb = b.extract_pages(pages)
+    np.testing.assert_array_equal(kva.view(np.uint16), kvb.view(np.uint16))
+
+
+def test_bucket_not_divisible_falls_back():
+    """A 1-row batch (batch bucket 1 % pp != 0) silently uses the
+    layer-sharded path — no crash, same tokens."""
+    a = ModelRunner(cfg(pp=2, pp_microbatch=True))
+    b = ModelRunner(cfg())
+    s = _seqs(1)
+    ta = a.prefill_batch([dataclasses.replace(x) for x in s])
+    tb = b.prefill_batch([dataclasses.replace(x) for x in s])
+    assert ta.tolist() == tb.tolist()
+
+
+def test_lowered_hlo_contains_collective_permute():
+    """The overlap artifact: the stage shift lowers to collective-permute
+    on the pp axis (stages exchange activations point-to-point instead of
+    serializing through one device)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.model import prefill_forward_pipelined
+
+    r = ModelRunner(cfg(pp=2, pp_microbatch=True))
+    B, s = 4, 32
+    tokens = jnp.zeros((B, s), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(s)[None], (B, s))
+    page_table = jnp.arange(B * (s // PAGE), dtype=jnp.int32).reshape(B, -1)
+    seq_lens = jnp.full((B,), s, jnp.int32)
+
+    def fn(params, k, v):
+        return prefill_forward_pipelined(
+            params, r.spec, k, v, tokens, positions, page_table, seq_lens,
+            n_stages=2)
+
+    with r.mesh:
+        text = jax.jit(fn).lower(r.params, r.k_cache, r.v_cache) \
+            .compile().as_text()
+    assert "collective-permute" in text, \
+        "stage shift did not lower to a collective-permute"
+
+
+@async_test
+async def test_engine_serves_with_pp_microbatch():
+    from dynamo_tpu.engine.engine import TPUEngine
+    from dynamo_tpu.llm.protocols import PreprocessedRequest
+    from dynamo_tpu.runtime.context import Context
+
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, SPEC.vocab_size, 24).tolist()
+               for _ in range(4)]
+
+    async def run(engine):
+        import asyncio
+
+        async def one(p):
+            req = PreprocessedRequest(model="m", token_ids=list(p))
+            req.stop_conditions.max_tokens = 6
+            req.stop_conditions.ignore_eos = True
+            toks = []
+            async for out in engine.generate(req, Context()):
+                toks.extend(out.get("token_ids", []))
+                if out.get("finish_reason"):
+                    break
+            return toks
+        try:
+            return await asyncio.gather(*[one(p) for p in prompts])
+        finally:
+            engine.stop()
+
+    got = await run(TPUEngine(cfg(pp=2, pp_microbatch=True)))
+    ref = await run(TPUEngine(cfg()))
+    assert got == ref

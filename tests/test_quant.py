@@ -1,0 +1,147 @@
+"""Weight-only int8 quantization tests (engine/quant.py; round-3 VERDICT
+missing #7 / next-round #5).
+
+Quality gate: quantized-vs-bf16 logits tolerance on the same weights
+(the VERDICT's 'golden-ish quality check'), greedy agreement, and the
+serving path (engine, tp sharding, KV extract) running quantized.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+from conftest import async_test
+
+from dynamo_tpu.engine.config import EngineConfig, PRESETS
+from dynamo_tpu.engine.quant import (QTensor, quantize_embedding,
+                                     quantize_params, quantize_weight,
+                                     weight_dtype_bytes)
+from dynamo_tpu.engine.runner import ModelRunner, PrefillSeq
+
+SPEC = PRESETS["tiny-test"]
+PAGE = 16
+
+
+def tiny_config(quant=None, **kw) -> EngineConfig:
+    spec = dataclasses.replace(SPEC, quant=quant)
+    defaults = dict(model=spec, page_size=PAGE, num_pages=64,
+                    max_pages_per_seq=16, max_num_seqs=4,
+                    prefill_buckets=(32, 64), max_prefill_tokens=64,
+                    attention_backend="xla")
+    defaults.update(kw)
+    return EngineConfig(**defaults)
+
+
+def _prompt(seed: int, n: int) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, SPEC.vocab_size, size=n).astype(np.int32)
+
+
+def test_quantize_weight_error_bound():
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((64, 48)).astype(np.float32)
+    qt = quantize_weight(w)
+    assert qt.q.dtype == np.int8 and qt.s.shape == (1, 48)
+    deq = qt.q.astype(np.float32) * qt.s
+    # Symmetric round-to-nearest: error <= half a quantization step.
+    assert float(np.abs(deq - w).max()) <= float(qt.s.max()) / 2 + 1e-6
+
+
+def test_quantize_embedding_scale_axis():
+    rng = np.random.default_rng(1)
+    w = rng.standard_normal((100, 16)).astype(np.float32)
+    qt = quantize_embedding(w)
+    assert qt.s.shape == (1, 16)  # per-hidden-channel
+    deq = qt.q.astype(np.float32) * qt.s
+    assert float(np.abs(deq - w).max()) <= float(qt.s.max()) / 2 + 1e-6
+
+
+def test_quantize_params_leaves():
+    from dynamo_tpu.engine.model import init_params
+    import jax
+    params = jax.tree.map(np.asarray, init_params(SPEC, jax.random.key(0)))
+    qp = quantize_params(params)
+    assert isinstance(qp["layers"]["wq"], QTensor)
+    assert qp["layers"]["wq"].q.dtype == np.int8
+    assert isinstance(qp["embed"], QTensor)
+    # Norms and biases stay high-precision.
+    assert not isinstance(qp["layers"]["input_norm"], QTensor)
+    assert not isinstance(qp["final_norm"], QTensor)
+
+
+def test_quant_runner_logits_close_and_greedy_agrees():
+    """The quality gate: same seed, bf16 vs int8 runners; prefill logits
+    stay close (cosine) and greedy top-1 agrees on the prompt batch."""
+    a = ModelRunner(tiny_config())
+    b = ModelRunner(tiny_config(quant="int8"))
+    agree = 0
+    for seed in range(4):
+        prompt = _prompt(seed, 32)
+        seq = lambda: PrefillSeq(  # noqa: E731
+            tokens=prompt, start_pos=0,
+            chunk_pages=np.asarray([1, 2], np.int32),
+            hist_pages=None, sampling=(0.0, 0, 1.0))
+        ta = int(a.prefill_batch([seq()])[0])
+        la = np.asarray(a.last_prefill_logits[0], np.float32)
+        tb = int(b.prefill_batch([seq()])[0])
+        lb = np.asarray(b.last_prefill_logits[0], np.float32)
+        cos = float(np.dot(la, lb)
+                    / (np.linalg.norm(la) * np.linalg.norm(lb) + 1e-9))
+        assert cos > 0.99, f"seed {seed}: quantized logits diverged ({cos})"
+        agree += int(ta == tb)
+    assert agree >= 3, f"greedy top-1 agreed only {agree}/4 times"
+
+
+@async_test
+async def test_quant_engine_serves():
+    from dynamo_tpu.engine.engine import TPUEngine
+    from dynamo_tpu.llm.protocols import PreprocessedRequest
+    from dynamo_tpu.runtime.context import Context
+
+    engine = TPUEngine(tiny_config(quant="int8"))
+    try:
+        req = PreprocessedRequest(model="t", token_ids=_prompt(9, 24).tolist())
+        req.stop_conditions.max_tokens = 8
+        req.stop_conditions.ignore_eos = True
+        toks = []
+        async for out in engine.generate(req, Context()):
+            toks.extend(out.get("token_ids", []))
+            if out.get("finish_reason"):
+                break
+        assert len(toks) == 8
+    finally:
+        engine.stop()
+
+
+def test_quant_tp2_and_kv_extract():
+    """Quantized weights shard over tp (QTensor scale specs keep the
+    in-axis unsharded) and the KV parcel path is unaffected."""
+    r = ModelRunner(tiny_config(quant="int8", tp=2))
+    prompt = _prompt(5, 32)
+    r.prefill_batch([PrefillSeq(tokens=prompt, start_pos=0,
+                                chunk_pages=np.asarray([1, 2], np.int32),
+                                hist_pages=None, sampling=(0.0, 0, 1.0))])
+    kv = r.extract_pages([1, 2])
+    assert kv.shape[3] == 2 and str(kv.dtype) == "bfloat16"
+    r2 = ModelRunner(tiny_config(quant="int8", tp=2))
+    r2.insert_pages(kv, [4, 5])
+    back = r2.extract_pages([4, 5])
+    np.testing.assert_array_equal(kv.view(np.uint16), back.view(np.uint16))
+
+
+def test_weight_read_accounting_halves():
+    spec8 = dataclasses.replace(PRESETS["llama-3-8b"], quant="int8")
+    bf = PRESETS["llama-3-8b"].weight_read_step_ms()
+    q8 = spec8.weight_read_step_ms()
+    assert abs(q8 - bf / 2) < 1e-6
+    assert weight_dtype_bytes("int8") == 1.0
+    assert weight_dtype_bytes(None) == 2.0
+
+
+def test_quant_cli_flag():
+    from dynamo_tpu.backends.tpu import build_engine_config, parse_args
+    args = parse_args(["--model", "tiny-test", "--quant", "int8"])
+    cfg = build_engine_config(args)
+    assert cfg.model.quant == "int8"
+    args = parse_args(["--model", "tiny-test"])
+    assert build_engine_config(args).model.quant is None
