@@ -61,6 +61,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                         help="layer-sharded pipeline axis")
     parser.add_argument("--sp", type=int, default=1,
                         help="sequence (context) parallelism for prefill")
+    parser.add_argument("--pp-microbatch", action="store_true",
+                        help="with --pp > 1: microbatched pipeline-"
+                             "parallel prefill (GPipe fill/drain over the "
+                             "pp stages) instead of layer-sharded-only")
     parser.add_argument("--decode-window", default="auto",
                         type=_window_arg,
                         help="decode steps per dispatched window: a "
@@ -72,6 +76,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                              "blocks on the oldest readback")
     parser.add_argument("--attention-backend", default="auto",
                         choices=["auto", "pallas", "xla"])
+    parser.add_argument("--quant", default=None, choices=["int8"],
+                        help="weight-only quantization: int8 storage, "
+                             "bf16 MXU compute (halves weight HBM — fits "
+                             "full llama-3-8b on one 16 GB v5e)")
     parser.add_argument("--host-cache-pages", type=int, default=0,
                         help="G2 host-DRAM KV block cache capacity in "
                              "pages (0 = disabled); evicted HBM pages "
@@ -96,9 +104,30 @@ def parse_args(argv=None) -> argparse.Namespace:
                         help="decode mode: prompts longer than this prefill "
                              "remotely (conditional disaggregation; dynamic "
                              "via the coordinator disagg/<model> key)")
+    parser.add_argument("--prefill-dispatch", default="direct",
+                        choices=["direct", "queue"],
+                        help="remote-prefill dispatch: direct round-robin "
+                             "to discovered prefill workers, or the shared "
+                             "coordinator queue with worker-side pull and "
+                             "depth backpressure (reference PrefillQueue, "
+                             "nats.rs:433)")
+    parser.add_argument("--max-prefill-queue-depth", type=int, default=8,
+                        help="queue dispatch: enqueue only while the queue "
+                             "is shallower than this; otherwise prefill "
+                             "locally (load-leveling backpressure)")
     parser.add_argument("--prefill-component", default=None,
                         help="component name prefill workers serve under "
                              "(default: 'prefill')")
+    parser.add_argument("--kv-plane-host", default="127.0.0.1",
+                        help="address this worker's direct KV data plane "
+                             "binds and advertises (the NIXL-role bulk "
+                             "plane, llm/kv_plane.py); must be reachable "
+                             "by peer workers")
+    parser.add_argument("--no-kv-plane", action="store_true",
+                        help="disable the direct KV data plane: disagg "
+                             "parcels ride the request plane inline (v0 "
+                             "fallback) and this worker serves no G4 "
+                             "remote-tier blocks")
     parser.add_argument("--num-nodes", type=int, default=1,
                         help="hosts in this worker group; >1 gates serving "
                              "on a leader/worker barrier (rank 0 leads) so "
@@ -115,17 +144,22 @@ def parse_args(argv=None) -> argparse.Namespace:
 
 
 def build_engine_config(args) -> EngineConfig:
+    import dataclasses
+
     from dynamo_tpu.engine.hub import resolve_model
     try:
         spec, ckpt = resolve_model(args.model)
     except FileNotFoundError as exc:
         raise SystemExit(str(exc)) from exc
+    if getattr(args, "quant", None):
+        spec = dataclasses.replace(spec, quant=args.quant)
     args.resolved_checkpoint = ckpt
     return EngineConfig(
         model=spec, page_size=args.page_size, num_pages=args.num_pages,
         max_num_seqs=args.max_num_seqs, max_pages_per_seq=args.max_pages_per_seq,
         tp=args.tp, dp=args.dp, pp=getattr(args, "pp", 1),
         sp=getattr(args, "sp", 1),
+        pp_microbatch=getattr(args, "pp_microbatch", False),
         attention_backend=args.attention_backend,
         decode_window=_window_arg(getattr(args, "decode_window", "auto")),
         pipeline_depth=getattr(args, "pipeline_depth", 4),
@@ -157,12 +191,6 @@ async def run(args: argparse.Namespace) -> None:
     mh_addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
     multihost_engine = args.num_nodes > 1 and bool(mh_addr)
     if multihost_engine:
-        if args.mode != "agg" or args.host_cache_pages or \
-                args.kv_disk_cache_dir:
-            raise SystemExit(
-                "multi-host single-engine mode supports aggregated serving "
-                "only: KV parcel extract/insert (disagg, host/disk tiers) "
-                "needs a cross-host gather that is not implemented")
         from dynamo_tpu.engine import multihost
         multihost.initialize(mh_addr, args.num_nodes, args.node_rank)
     runtime = await DistributedRuntime.from_settings(cfg)
@@ -259,6 +287,75 @@ async def run(args: argparse.Namespace) -> None:
             DisaggRouterConfig, make_prefill_handler)
         prefill_component = args.prefill_component or PREFILL_COMPONENT
         disagg_handler = None
+        # Direct KV data plane (the NIXL role): every worker runs the
+        # server side — prefill workers stage parcels on it, and any
+        # worker with host tiers serves G4 remote-tier block fetches.
+        plane = None
+        peer_watch_task = None
+        if not args.no_kv_plane:
+            from dynamo_tpu.llm.kv_plane import (KvPlaneServer,
+                                                 RemoteBlockSource)
+            plane = KvPlaneServer(
+                host=args.kv_plane_host,
+                block_provider=(engine.host_cache.get
+                                if engine.host_cache is not None else None))
+            plane.start()
+            coordinator = runtime.require_coordinator()
+            await coordinator.kv_put(
+                f"kvplane/{cfg.namespace}/{runtime.instance_id:x}",
+                {"addr": plane.address, "model": model_name},
+                lease_id=coordinator.primary_lease_id)
+            # G4 remote tier: watch peer plane registrations so prefix
+            # extensions can onboard blocks a PEER's host tier holds
+            # instead of recomputing (engine._try_onboard). Short-timeout
+            # client: the consult runs on the engine thread.
+            engine.remote_source = RemoteBlockSource(self_addr=plane.address)
+            peer_watch = await coordinator.watch_prefix(
+                f"kvplane/{cfg.namespace}/")
+            peers: dict[str, str] = {
+                item["k"]: item["v"]["addr"]
+                for item in peer_watch.snapshot
+                if item["v"].get("model") == model_name}
+            engine.remote_source.peers = [a for a in peers.values()
+                                          if a != plane.address]
+
+            async def watch_peers() -> None:
+                # Must not die silently: a frozen peer list both misses
+                # new workers and keeps feeding dead addresses to the G4
+                # consult. On watch failure, log and re-establish.
+                watch = peer_watch
+                while True:
+                    try:
+                        async for event in watch:
+                            if event["event"] == "put" and \
+                                    event["value"].get("model") == model_name:
+                                peers[event["key"]] = event["value"]["addr"]
+                            elif event["event"] == "delete":
+                                peers.pop(event["key"], None)
+                            engine.remote_source.peers = [
+                                a for a in peers.values()
+                                if a != plane.address]
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:  # noqa: BLE001 — log and re-watch
+                        log.exception("kvplane peer watch failed; retrying")
+                    await asyncio.sleep(2.0)
+                    try:
+                        watch = await coordinator.watch_prefix(
+                            f"kvplane/{cfg.namespace}/")
+                        peers.clear()
+                        peers.update({
+                            item["k"]: item["v"]["addr"]
+                            for item in watch.snapshot
+                            if item["v"].get("model") == model_name})
+                        engine.remote_source.peers = [
+                            a for a in peers.values() if a != plane.address]
+                    except (ConnectionError, OSError):
+                        log.warning("kvplane peer re-watch failed; will "
+                                    "retry")
+
+            peer_watch_task = asyncio.create_task(watch_peers())
+        queue_worker = None
         if args.mode == "prefill":
             # Prefill workers register under their own component so decode
             # workers (not the frontend router) discover them; prefill
@@ -266,7 +363,24 @@ async def run(args: argparse.Namespace) -> None:
             endpoint = (runtime.namespace(None).component(prefill_component)
                         .endpoint(PREFILL_ENDPOINT))
             server = await endpoint.serve_endpoint(
-                make_prefill_handler(engine), graceful_shutdown=True)
+                make_prefill_handler(engine, plane=plane),
+                graceful_shutdown=True)
+            if plane is not None:
+                # Also pull from the shared prefill queue (queue dispatch
+                # needs the data plane for the reply ticket): serving both
+                # paths lets direct- and queue-mode decode workers share
+                # one prefill pool.
+                from dynamo_tpu.llm.prefill_queue import QueuePrefillWorker
+                queue_worker = QueuePrefillWorker(
+                    engine, runtime.require_coordinator(), model_name,
+                    plane)
+                queue_worker.start()
+            else:
+                log.warning(
+                    "--no-kv-plane: this prefill worker will NOT pull "
+                    "from the shared prefill queue (queue replies carry "
+                    "data-plane tickets); queue-mode decode workers need "
+                    "at least one plane-enabled prefill worker")
         elif args.mode == "decode":
             prefill_ep = (runtime.namespace(None)
                           .component(prefill_component)
@@ -277,6 +391,20 @@ async def run(args: argparse.Namespace) -> None:
                 default_max_local=args.max_local_prefill_length)
             disagg_handler = DisaggDecodeHandler(engine, prefill_client,
                                                  disagg_cfg)
+            if args.prefill_dispatch == "queue":
+                if args.no_kv_plane:
+                    raise SystemExit(
+                        "--prefill-dispatch queue needs the KV data plane "
+                        "(queue replies carry plane tickets); drop "
+                        "--no-kv-plane or use --prefill-dispatch direct")
+                from dynamo_tpu.llm.prefill_queue import (
+                    QueuePrefillDispatcher)
+                # Share the handler's plane client: one TCP connection
+                # cache per prefill worker, one close at shutdown.
+                disagg_handler.queue_dispatcher = QueuePrefillDispatcher(
+                    runtime.require_coordinator(), model_name,
+                    disagg_handler.plane_client,
+                    max_queue_depth=args.max_prefill_queue_depth)
             endpoint = (runtime.namespace(None).component(args.component)
                         .endpoint(args.endpoint))
             server = await endpoint.serve_endpoint(disagg_handler.handler(),
@@ -314,6 +442,11 @@ async def run(args: argparse.Namespace) -> None:
             # Engine loop is drained — no more dispatches can race this.
             from dynamo_tpu.engine import multihost
             try:
+                # Surface a transport failure on the LAST dispatch (acks
+                # are pipelined one behind) before declaring clean stop.
+                pending = engine.runner.pending_ack()
+                if pending is not None:
+                    await asyncio.wrap_future(pending)
                 await runtime.require_coordinator().publish(
                     multihost.DISPATCH_SUBJECT.format(group=mh_group),
                     {"m": "stop"})
@@ -322,6 +455,16 @@ async def run(args: argparse.Namespace) -> None:
                 # followers exit with it.
                 pass
         await server.shutdown()
+        if queue_worker is not None:
+            await queue_worker.stop()
+        if peer_watch_task is not None:
+            peer_watch_task.cancel()
+        if plane is not None:
+            if engine.remote_source is not None:
+                engine.remote_source.client.close()
+            plane.close()
+        if disagg_handler is not None:
+            disagg_handler.plane_client.close()
     finally:
         await runtime.close()
 

@@ -108,6 +108,11 @@ class TPUEngine(AsyncEngine):
         self._evict_buffer: list[tuple[int, int]] = []
         self._pending_spills: list[dict] = []
         self.onboard_blocks = 0
+        # G4 remote tier (kv_plane.RemoteBlockSource, set by the worker):
+        # prefix extensions that miss G1/G2/G3 consult peer workers' host
+        # tiers over the data plane before recomputing.
+        self.remote_source = None
+        self.g4_blocks = 0
         self.kv_publisher = kv_publisher
         self.metrics_publisher = metrics_publisher
         b = config.max_num_seqs
@@ -284,6 +289,13 @@ class TPUEngine(AsyncEngine):
         host. Returns (first_token, kv [2,L,Nkv,n,page,D], prompt_len) —
         the disaggregated prefill side (reference PrefillWorkerHandler,
         handlers.py:167-199)."""
+        first_token, handle, prompt_len = self._prefill_for_extract(req)
+        return first_token, self.runner.finalize_extract(handle), prompt_len
+
+    def _prefill_for_extract(self, req: PreprocessedRequest):
+        """Prefill + dispatch the page gather; returns the UNRESOLVED
+        extract handle so the device->host copy can overlap whatever the
+        caller does next (stage-for-pull, decode windows)."""
         self._validate(req)
         r = _Request(req=req, ctx=Context(), out_q=None, loop=None,  # type: ignore[arg-type]
                      tokens_all=list(req.token_ids))
@@ -297,11 +309,37 @@ class TPUEngine(AsyncEngine):
                 first_token = int(self.runner.prefill_batch([plan])[0])
             for idx, h in enumerate(r.blocks.block_hashes):
                 self.allocator.register(r.pages[idx], h)
-            kv = self.runner.extract_pages(r.pages)
+            handle = self.runner.extract_pages_async(r.pages)
         finally:
+            # The gather is dispatched: device-stream order guarantees it
+            # reads the pages before any later program can overwrite them,
+            # so the pages release immediately.
             self.allocator.release(r.pages)
             r.pages = []
-        return first_token, kv, len(r.tokens_all)
+        return first_token, handle, len(r.tokens_all)
+
+    def prefill_extract_staged(self, req: PreprocessedRequest, plane):
+        """ENGINE-THREAD ONLY (call via run_job). Disaggregated prefill
+        over the direct KV data plane: prefill, stage the extract handle
+        with the plane (host fetch resolves lazily on the plane thread,
+        overlapping this engine's next windows), return (first_token,
+        ticket, prompt_len). The ticket rides the small response stream;
+        the KV bytes take the plane's direct path (llm/kv_plane.py)."""
+        first_token, handle, prompt_len = self._prefill_for_extract(req)
+        spec = self.runner.spec
+        n = handle[1]
+        shape = [2, spec.num_layers, self.runner.canonical_nkv, n,
+                 self.config.page_size, spec.head_dim]
+        # The jax device-path needs the staged array to be EXACTLY the
+        # advertised shape; the gather output is bucket-padded and
+        # kv-head-replicated, so only offer it when neither applies.
+        dev = (handle[0] if handle[0].shape[3] == n
+               and self.runner.kv_rep == 1 else None)
+        ticket = plane.stage(
+            meta={"shape": shape, "dtype": "bfloat16"},
+            resolve=lambda: self.runner.finalize_extract(handle),
+            device_array=dev, prompt_len=prompt_len)
+        return first_token, ticket, prompt_len
 
     async def embed(self, token_lists: list[list[int]],
                     pooling: str = "last") -> list[list[float]]:
@@ -474,23 +512,42 @@ class TPUEngine(AsyncEngine):
 
     def _try_onboard(self, r: _Request, hashes: list[int],
                      cached_pages: list[int]) -> tuple[list[int], int]:
-        """Extend the G1 prefix hit with consecutive G2/G3 blocks: upload
-        them into fresh pages (re-registered for sharing) instead of
-        recomputing. Returns (extra_pages, extra_tokens)."""
+        """Extend the G1 prefix hit with consecutive G2/G3 blocks — and
+        past those, G4 blocks fetched from peer workers' host tiers —
+        uploading them into fresh pages (re-registered for sharing)
+        instead of recomputing. Returns (extra_pages, extra_tokens)."""
         page = self.config.page_size
-        if self.host_cache is None:
+        if self.host_cache is None and self.remote_source is None:
             return [], 0
         # Never reuse past the second-to-last block (the last token must
         # always be recomputed for logits), matching the G1 rule.
         allowed = (len(r.tokens_all) - 1) // page - len(cached_pages)
         blocks: list[tuple[int, np.ndarray]] = []
-        for h in hashes[len(cached_pages):]:
-            if len(blocks) >= allowed:
-                break
-            kv = self.host_cache.get(h)
-            if kv is None:
-                break
-            blocks.append((h, kv))
+        if self.host_cache is not None:
+            for h in hashes[len(cached_pages):]:
+                if len(blocks) >= allowed:
+                    break
+                kv = self.host_cache.get(h)
+                if kv is None:
+                    break
+                blocks.append((h, kv))
+        if self.remote_source is not None and len(blocks) < allowed:
+            # G4: one bounded peer round trip for the rest of the run.
+            start = len(cached_pages) + len(blocks)
+            want = hashes[start:start + (allowed - len(blocks))]
+            if want:
+                try:
+                    remote = self.remote_source.fetch(want, len(want))
+                except Exception:  # noqa: BLE001 — peers are best-effort
+                    log.exception("G4 remote fetch failed")
+                    remote = []
+                for h, kv in remote:
+                    blocks.append((h, kv))
+                    if self.host_cache is not None:
+                        # Promote into the local G2 so the next hit is
+                        # one NIC hop shorter.
+                        self.host_cache.put(h, kv, promotion=True)
+                self.g4_blocks += len(remote)
         if not blocks:
             return [], 0
         pages = self.allocator.allocate(len(blocks))

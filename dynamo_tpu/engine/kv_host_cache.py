@@ -18,6 +18,7 @@ disaggregation parcels.
 from __future__ import annotations
 
 import os
+import threading
 from collections import OrderedDict
 
 import numpy as np
@@ -25,6 +26,12 @@ import numpy as np
 from dynamo_tpu.runtime.logging import get_logger
 
 log = get_logger("kv_host_cache")
+
+# Tiers are mutated by the engine thread AND read by KV-plane connection
+# threads serving peer G4 block fetches (llm/kv_plane.py block_provider):
+# one lock covers both tiers' OrderedDict surgery (entries are immutable
+# once stored — content-hashed — so only the index needs protecting).
+
 
 
 class DiskKVCache:
@@ -37,6 +44,7 @@ class DiskKVCache:
         os.makedirs(directory, exist_ok=True)
         # hash -> path, insertion-ordered for LRU.
         self._index: OrderedDict[int, str] = OrderedDict()
+        self._lock = threading.Lock()
         for name in sorted(os.listdir(directory)):
             if name.endswith(".npy"):
                 try:
@@ -48,18 +56,24 @@ class DiskKVCache:
         self.misses = 0
 
     def __contains__(self, block_hash: int) -> bool:
-        return block_hash in self._index
+        with self._lock:
+            return block_hash in self._index
 
     def put(self, block_hash: int, kv: np.ndarray) -> None:
-        if block_hash in self._index:
-            self._index.move_to_end(block_hash)
-            return
+        with self._lock:
+            if block_hash in self._index:
+                self._index.move_to_end(block_hash)
+                return
         path = os.path.join(self.dir, f"{block_hash & (2**64 - 1):016x}.npy")
         # View bf16 as uint16 for npy portability.
         np.save(path, kv.view(np.uint16))
-        self._index[block_hash] = path
-        while len(self._index) > self.capacity:
-            _, old = self._index.popitem(last=False)
+        evicted: list[str] = []
+        with self._lock:
+            self._index[block_hash] = path
+            while len(self._index) > self.capacity:
+                _, old = self._index.popitem(last=False)
+                evicted.append(old)
+        for old in evicted:
             try:
                 os.remove(old)
             except OSError:
@@ -67,17 +81,21 @@ class DiskKVCache:
 
     def get(self, block_hash: int) -> np.ndarray | None:
         import ml_dtypes
-        path = self._index.get(block_hash)
+        with self._lock:
+            path = self._index.get(block_hash)
         if path is None:
             self.misses += 1
             return None
         try:
             arr = np.load(path).view(ml_dtypes.bfloat16)
         except (OSError, ValueError):
-            self._index.pop(block_hash, None)
+            with self._lock:
+                self._index.pop(block_hash, None)
             self.misses += 1
             return None
-        self._index.move_to_end(block_hash)
+        with self._lock:
+            if block_hash in self._index:
+                self._index.move_to_end(block_hash)
         self.hits += 1
         return arr
 
@@ -91,35 +109,42 @@ class HostKVCache:
         self.capacity = capacity_pages
         self.disk = disk
         self._blocks: OrderedDict[int, np.ndarray] = OrderedDict()
+        self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
         self.spills_in = 0       # blocks offloaded into this tier
         self.demotions = 0       # G2 -> G3 capacity evictions
 
     def __len__(self) -> int:
-        return len(self._blocks)
+        with self._lock:
+            return len(self._blocks)
 
     def put(self, block_hash: int, kv: np.ndarray,
             promotion: bool = False) -> None:
-        if block_hash in self._blocks:
-            self._blocks.move_to_end(block_hash)
-            return
-        # Own the memory: callers hand views into large batched extract
-        # buffers — storing the view would pin the whole base array and
-        # blow the capacity bound by the padding/replication factor.
-        self._blocks[block_hash] = np.ascontiguousarray(kv)
-        if not promotion:
-            self.spills_in += 1
-        while len(self._blocks) > self.capacity:
-            old_hash, old_kv = self._blocks.popitem(last=False)
-            if self.disk is not None:
+        demoted: list[tuple[int, np.ndarray]] = []
+        with self._lock:
+            if block_hash in self._blocks:
+                self._blocks.move_to_end(block_hash)
+                return
+            # Own the memory: callers hand views into large batched extract
+            # buffers — storing the view would pin the whole base array and
+            # blow the capacity bound by the padding/replication factor.
+            self._blocks[block_hash] = np.ascontiguousarray(kv)
+            if not promotion:
+                self.spills_in += 1
+            while len(self._blocks) > self.capacity:
+                demoted.append(self._blocks.popitem(last=False))
+        if self.disk is not None:
+            for old_hash, old_kv in demoted:
                 self.disk.put(old_hash, old_kv)
                 self.demotions += 1
 
     def get(self, block_hash: int) -> np.ndarray | None:
-        kv = self._blocks.get(block_hash)
+        with self._lock:
+            kv = self._blocks.get(block_hash)
+            if kv is not None:
+                self._blocks.move_to_end(block_hash)
         if kv is not None:
-            self._blocks.move_to_end(block_hash)
             self.hits += 1
             return kv
         if self.disk is not None:
@@ -135,14 +160,17 @@ class HostKVCache:
     def clear(self) -> None:
         """Drop every tier (admin clear_kv_blocks): G2 memory and the G3
         disk files behind it."""
-        self._blocks.clear()
+        with self._lock:
+            self._blocks.clear()
         if self.disk is not None:
-            for h, path in list(self.disk._index.items()):
+            with self.disk._lock:
+                index, self.disk._index = dict(self.disk._index), \
+                    OrderedDict()
+            for h, path in index.items():
                 try:
                     os.remove(path)
                 except OSError:
                     pass
-            self.disk._index.clear()
 
     def stats(self) -> dict:
         out = {"g2_blocks": len(self._blocks), "g2_hits": self.hits,

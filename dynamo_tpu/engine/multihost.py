@@ -28,9 +28,13 @@ How it works:
   so no dispatch can be published before a follower is listening.
 
 Scope: the serving hot path (``prefill_batch``, ``decode_window``,
-``prefill``, ``embed``). KV parcel extract/insert (disaggregation) and
-host-tier offload fetch per-device shards and are leader-local operations;
-they raise in multi-host mode until a cross-host gather path exists.
+``prefill``, ``embed``) AND the KV parcel plane (``extract_pages``,
+``insert_pages``): extracts compile with a replicated output in
+multi-controller mode (XLA all-gathers the pages over ICI/DCN, so the
+leader's host fetch is local), inserts replay with the parcel bytes in
+the dispatch payload — disaggregation and host/disk tiering therefore
+compose with multi-host engines (the north-star configuration:
+BASELINE.md, 70B disaggregated across hosts).
 """
 
 from __future__ import annotations
@@ -82,7 +86,13 @@ def _pack_array(a) -> dict | None:
 def _unpack_array(d: dict | None):
     if d is None:
         return None
-    return np.frombuffer(d["b"], dtype=d["dtype"]).reshape(d["shape"])
+    if d["dtype"] == "bfloat16":  # KV parcels; not a numpy-native name
+        import ml_dtypes
+
+        dtype = np.dtype(ml_dtypes.bfloat16)
+    else:
+        dtype = np.dtype(d["dtype"])
+    return np.frombuffer(d["b"], dtype=dtype).reshape(d["shape"])
 
 
 def _pack_seq(s) -> dict:
@@ -145,6 +155,16 @@ class LeaderRunner:
         if prev is not None:
             prev.result(timeout=30.0)
 
+    def pending_ack(self):
+        """The newest dispatch's unacknowledged publish future (or None).
+        The stop path awaits it before declaring shutdown complete — a
+        transport failure on the LAST dispatch before idle/stop would
+        otherwise never surface, leaving followers silently one window
+        behind. (Async-safe: callers on the event loop wrap it with
+        asyncio.wrap_future instead of blocking on .result().)"""
+        fut, self._prev_fut = self._prev_fut, None
+        return fut
+
     def prefill_batch(self, seqs, slots=None, count_rows=None):
         self._publish({"m": "prefill_batch",
                        "seqs": [_pack_seq(s) for s in seqs],
@@ -184,20 +204,24 @@ class LeaderRunner:
                        "pooling": pooling})
         return self._inner.embed(token_lists, pooling)
 
-    # Leader-local per-device-shard operations: replaying them would not
-    # help (each process sees only its shards) — cross-host KV gather is
-    # future work.
-    def extract_pages(self, pages):
-        raise NotImplementedError("KV extract is not supported in "
-                                  "multi-host mode yet")
-
+    # KV parcel extract/insert (disaggregation + tiering): the extract
+    # gather runs on EVERY process with a replicated output (the runner
+    # compiles it with out_shardings=P() in multi-controller mode, so XLA
+    # all-gathers the pages over ICI/DCN) — the leader's host fetch is
+    # then local. Inserts replay with the parcel bytes in the dispatch
+    # payload (identical on every host, like any other control array).
     def extract_pages_async(self, pages):
-        raise NotImplementedError("KV extract is not supported in "
-                                  "multi-host mode yet")
+        self._publish({"m": "extract_pages",
+                       "pages": [int(p) for p in pages]})
+        return self._inner.extract_pages_async(pages)
+
+    def extract_pages(self, pages):
+        return self._inner.finalize_extract(self.extract_pages_async(pages))
 
     def insert_pages(self, kv, pages):
-        raise NotImplementedError("KV insert is not supported in "
-                                  "multi-host mode yet")
+        self._publish({"m": "insert_pages", "kv": _pack_array(kv),
+                       "pages": [int(p) for p in pages]})
+        return self._inner.insert_pages(kv, pages)
 
 
 async def leader_barrier(client, group: str, num_followers: int,
@@ -283,6 +307,14 @@ async def run_follower(config, client, group: str, node_rank: int,
                                          msg["window"])
                 elif m == "embed":
                     runner.embed(msg["token_lists"], msg["pooling"])
+                elif m == "extract_pages":
+                    # Dispatch the (replicated-output) gather so the
+                    # leader's all-gather has peers; the result itself is
+                    # only fetched leader-side.
+                    runner.extract_pages_async(msg["pages"])
+                elif m == "insert_pages":
+                    runner.insert_pages(_unpack_array(msg["kv"]),
+                                        msg["pages"])
                 else:
                     raise RuntimeError(f"unknown dispatch {m!r}")
             except BaseException as exc:  # noqa: BLE001 — report and die

@@ -94,15 +94,16 @@ class DisaggRouterConfig:
             await self._watch.cancel()
 
 
-def make_prefill_handler(engine):
-    """Prefill-worker endpoint handler: prompt in, (KV parcel + first
-    token) out as a chunked response stream.
+def make_prefill_handler(engine, plane=None):
+    """Prefill-worker endpoint handler: prompt in, (KV + first token) out.
 
-    Frame contract (consumed by collect_prefill_response): one frame with
-    the parcel meta {shape, dtype, n_chunks}, n_chunks frames each with a
-    kv_chunk bytes payload, and a final frame carrying the sampled first
-    token — the role of the reference's kv_transfer_params response
-    (handlers.py:195-199)."""
+    With ``plane`` (a KvPlaneServer): the parcel is STAGED on the direct
+    KV data plane and the response carries only a small transfer ticket —
+    the decode worker pulls the bulk bytes worker-to-worker
+    (llm/kv_plane.py, the NIXL role). Without it: the v0 inline-chunk
+    contract (one meta frame {shape, dtype, n_chunks}, n_chunks kv_chunk
+    frames, then the first token — the role of the reference's
+    kv_transfer_params response, handlers.py:195-199)."""
 
     async def handle(request, context: Context) -> AsyncIterator[dict]:
         if isinstance(request, dict) and request.get("clear_kv_blocks"):
@@ -110,6 +111,15 @@ def make_prefill_handler(engine):
             return
         req = (request if isinstance(request, PreprocessedRequest)
                else PreprocessedRequest.from_wire(request))
+        if plane is not None:
+            first_token, ticket, prompt_len = await engine.run_job(
+                lambda: engine.prefill_extract_staged(req, plane))
+            log.info("prefill parcel staged: %d tokens, ticket %d",
+                     prompt_len, ticket["id"])
+            yield LLMEngineOutput(
+                disagg_params={"ticket": ticket}).to_wire()
+            yield LLMEngineOutput(token_ids=[first_token]).to_wire()
+            return
         first_token, kv, prompt_len = await engine.run_job(
             lambda: engine.prefill_extract(req))
         meta, chunks = kv_to_chunks(kv)
@@ -129,10 +139,21 @@ class DisaggDecodeHandler:
     """Decode-worker handler with conditional remote prefill (reference
     DecodeWorkerHandler, handlers.py:113-162)."""
 
-    def __init__(self, engine, prefill_client, config: DisaggRouterConfig):
+    def __init__(self, engine, prefill_client, config: DisaggRouterConfig,
+                 plane_client=None, queue_dispatcher=None):
         self.engine = engine
         self.prefill_client = prefill_client
         self.config = config
+        # Pull side of the direct KV data plane (created on demand: a
+        # plane-less prefill worker just sends inline chunks instead).
+        if plane_client is None:
+            from dynamo_tpu.llm.kv_plane import KvPlaneClient
+            plane_client = KvPlaneClient()
+        self.plane_client = plane_client
+        # Queue-based dispatch (llm/prefill_queue.py): when set, remote
+        # prefills go through the shared coordinator queue with depth
+        # backpressure instead of direct round-robin.
+        self.queue_dispatcher = queue_dispatcher
         # Telemetry for tests + metrics.
         self.remote_prefills = 0
         self.local_prefills = 0
@@ -174,6 +195,8 @@ class DisaggDecodeHandler:
             if injected is not None:
                 self.remote_prefills += 1
                 first_token, kv = injected
+                log.info("remote prefill injected: %d tokens",
+                         len(req.token_ids))
                 async for out in self.engine.generate_injected(
                         req, context, first_token, kv):
                     yield out
@@ -184,14 +207,18 @@ class DisaggDecodeHandler:
 
     async def _remote_prefill(self, req: PreprocessedRequest,
                               context: Context):
-        """Forward the prompt to a prefill worker; returns
+        """Forward the prompt to a prefill worker (direct round-robin, or
+        the shared queue when a dispatcher is configured); returns
         (first_token, kv parcel) or None to fall back to local prefill
         (any remote failure degrades to aggregated serving, never fails
         the request)."""
         try:
+            if self.queue_dispatcher is not None:
+                return await self.queue_dispatcher.remote_prefill(req)
             stream = await self.prefill_client.round_robin(
                 req.to_wire(), context=context)
-            return await collect_prefill_response(stream)
+            return await collect_prefill_response(
+                stream, plane_client=self.plane_client)
         except (NoInstancesError, StreamIncompleteError, EngineError,
                 ConnectionError, OSError, RuntimeError) as exc:
             self.remote_failures += 1

@@ -1,0 +1,586 @@
+"""Direct worker<->worker KV data plane — the NIXL role, TPU-first.
+
+The reference moves KV blocks GPU<->GPU/host/disk over NIXL RDMA with a
+layout/metadata handshake (lib/llm/src/block_manager/storage/nixl.rs,
+block_manager/layout/nixl.rs, docs/architecture/dynamo_flow.md §NIXL).
+This module is the TPU-native equivalent: a dedicated bulk-transfer plane
+between workers that keeps KV bytes OFF the coordinator-discovered
+request plane. Paths, fastest first, negotiated per transfer by a
+metadata ticket (the role of NIXL's metadata exchange through etcd):
+
+1. ``jax``  — ``jax.experimental.transfer``: device-to-device pull over
+   ICI/DCN with no host staging. Probed at import-site: the probe
+   actually stages and pulls a loopback array, because several PJRT
+   builds (CPU, tunneled TPU) advertise the module but raise
+   UNIMPLEMENTED on ``PJRT_Client_CreateBuffersForAsyncHostToDevice``.
+   Activates on real TPU pods; falls through cleanly elsewhere.
+2. ``socket`` — a direct TCP bulk plane: the source worker serves its
+   extracted KV (host-staged via the runner's async D2H copy, which
+   overlaps decode windows) on its OWN listening socket; the sink pulls
+   with ``recv_into`` a preallocated buffer. One NIC hop, no msgpack
+   re-framing of multi-MB payloads, no coordinator in the data path.
+3. Inline parcel chunks on the request plane (llm/kv_transfer.py) — the
+   v0 fallback, still emitted when the prefill worker has no plane.
+
+The ticket contract: ``{"id", "addr", "jax_addr"?, "shape", "dtype",
+"nbytes", "prompt_len"}`` rides the ordinary (small) response stream;
+only the bulk bytes take the direct path.
+
+The same socket also serves ``blocks`` requests — peer workers fetch KV
+blocks from this worker's G2/G3 host tiers by block hash (the G4
+remote-tier role, block_manager.rs:76-82 CacheLevel G1..G4), enabling
+cross-worker prefix reuse without recompute.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import struct
+import threading
+import time
+from typing import Callable
+
+import msgpack
+import numpy as np
+
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("kv_plane")
+
+_LEN = struct.Struct(">I")
+_MAX_CTRL = 64 * 1024 * 1024  # control frames stay small; bulk is raw
+_SEND_CHUNK = 4 << 20
+
+STAGED_TTL_S = 120.0  # unseen tickets expire (sink crashed mid-handshake)
+
+
+def _bf16():
+    import ml_dtypes
+
+    return ml_dtypes.bfloat16
+
+
+def dtype_of(name: str) -> np.dtype:
+    return np.dtype(_bf16() if name == "bfloat16" else name)
+
+
+# -- sync frame helpers (server thread + client executor threads) -------------
+
+def _send_ctrl(sock: socket.socket, obj: dict) -> None:
+    body = msgpack.packb(obj, use_bin_type=True)
+    sock.sendall(_LEN.pack(len(body)) + body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionError("peer closed mid-frame")
+        got += r
+    return bytes(buf)
+
+
+def _recv_ctrl(sock: socket.socket) -> dict:
+    (length,) = _LEN.unpack(_recv_exact(sock, 4))
+    if length > _MAX_CTRL:
+        raise ValueError(f"control frame too large: {length}")
+    return msgpack.unpackb(_recv_exact(sock, length), raw=False)
+
+
+def _send_bulk(sock: socket.socket, arr: np.ndarray) -> None:
+    # uint8 view first: bfloat16 has no buffer-protocol format char, and
+    # the view + memoryview is zero-copy from the numpy buffer either way.
+    data = memoryview(np.ascontiguousarray(arr).view(np.uint8).reshape(-1))
+    for off in range(0, len(data), _SEND_CHUNK):
+        sock.sendall(data[off:off + _SEND_CHUNK])
+
+
+def _recv_bulk_into(sock: socket.socket, buf: memoryview) -> None:
+    got = 0
+    n = len(buf)
+    while got < n:
+        r = sock.recv_into(buf[got:], n - got)
+        if r == 0:
+            raise ConnectionError("peer closed mid-payload")
+        got += r
+
+
+# -- jax.experimental.transfer probe ------------------------------------------
+
+_jax_probe: bool | None = None
+_jax_server = None
+
+
+def jax_transfer_usable() -> bool:
+    """True iff the device-to-device transfer engine actually works on
+    this backend (loopback stage+pull; cached). CPU and tunneled-TPU
+    PJRT builds raise UNIMPLEMENTED from the buffer-import hook, so a
+    hasattr check is not enough."""
+    global _jax_probe
+    if _jax_probe is not None:
+        return _jax_probe
+    try:
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import transfer
+        from jax.sharding import SingleDeviceSharding
+
+        dev = jax.local_devices()[0]
+        srv = transfer.start_transfer_server(dev.client)
+        arr = jnp.arange(8, dtype=jnp.float32)
+        arr.block_until_ready()
+        srv.await_pull(0, [arr])
+        conn = srv.connect(srv.address())
+        out = conn.pull(0, [jax.ShapeDtypeStruct(
+            arr.shape, arr.dtype, sharding=SingleDeviceSharding(dev))])
+        np.asarray(out[0])
+        _jax_probe = True
+    except Exception as exc:  # noqa: BLE001 — any failure means "no"
+        log.info("jax.experimental.transfer unusable on this backend "
+                 "(%s: %s); KV plane uses the socket path",
+                 type(exc).__name__, exc)
+        _jax_probe = False
+    return _jax_probe
+
+
+def _get_jax_server():
+    """Process-wide transfer server (lazy; only when the probe passed)."""
+    global _jax_server
+    if _jax_server is None:
+        import jax
+        from jax.experimental import transfer
+
+        _jax_server = transfer.start_transfer_server(
+            jax.local_devices()[0].client)
+    return _jax_server
+
+
+class _Staged:
+    __slots__ = ("meta", "payload", "resolve", "t", "jax_uuid")
+
+    def __init__(self, meta: dict, payload, resolve, jax_uuid):
+        self.meta = meta
+        self.payload = payload      # np.ndarray once resolved
+        self.resolve = resolve      # () -> np.ndarray, or None
+        self.t = time.monotonic()
+        self.jax_uuid = jax_uuid
+
+    def array(self) -> np.ndarray:
+        if self.payload is None:
+            self.payload = self.resolve()
+            self.resolve = None
+        return self.payload
+
+
+class KvPlaneServer:
+    """Source side: stages KV parcels for direct pull and serves host-tier
+    blocks to peers. One per worker process; thread-based (bulk socket
+    I/O must not share the event loop with request-plane latency)."""
+
+    def __init__(self, host: str = "127.0.0.1",
+                 block_provider: Callable[[int], np.ndarray | None] | None = None,
+                 use_jax_path: bool | None = None):
+        self.host = host
+        self.port = 0
+        self.block_provider = block_provider
+        self._staged: dict[int, _Staged] = {}
+        self._next_id = 1
+        self._lock = threading.Lock()
+        self._sock: socket.socket | None = None
+        self._threads: list[threading.Thread] = []
+        self._running = False
+        self._use_jax = (jax_transfer_usable() if use_jax_path is None
+                         else use_jax_path)
+        # Telemetry (tests + PERF_NOTES measurements).
+        self.transfers = 0
+        self.bytes_out = 0
+        self.block_requests = 0
+        self.blocks_served = 0
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((self.host, 0))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        self._running = True
+        t = threading.Thread(target=self._accept_loop, name="kv-plane",
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+        # Periodic GC: unclaimed tickets pin the extract's DEVICE buffer
+        # through their resolve closure — a crashed sink must not hold
+        # HBM past the TTL just because no new prefill triggers stage().
+        g = threading.Thread(target=self._gc_loop, name="kv-plane-gc",
+                             daemon=True)
+        g.start()
+        self._threads.append(g)
+        log.info("KV plane listening on %s (jax path: %s)", self.address,
+                 "on" if self._use_jax else "off")
+
+    def _gc_loop(self) -> None:
+        while self._running:
+            time.sleep(min(30.0, STAGED_TTL_S / 4))
+            with self._lock:
+                self._gc_locked()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def close(self) -> None:
+        self._running = False
+        if self._sock is not None:
+            try:
+                # shutdown() first: a thread blocked in accept() holds a
+                # kernel reference, so close() alone leaves the port
+                # listening until the accept returns.
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        with self._lock:
+            self._staged.clear()
+
+    # -- staging ------------------------------------------------------------
+    def stage(self, kv=None, meta: dict | None = None,
+              resolve: Callable[[], np.ndarray] | None = None,
+              device_array=None, prompt_len: int | None = None) -> dict:
+        """Stage a parcel; returns the transfer ticket to send over the
+        (small) response stream. Either ``kv`` (host array) or ``resolve``
+        (deferred host fetch — lets the D2H copy overlap decode windows;
+        resolved on the plane thread at pull time) must be given.
+        ``device_array`` additionally registers the parcel with the jax
+        transfer server for a zero-host-copy pull when both ends support
+        it."""
+        meta = dict(meta or {})
+        if kv is not None:
+            meta.setdefault("shape", list(kv.shape))
+            meta.setdefault("dtype", str(kv.dtype))
+        shape, dt = meta["shape"], dtype_of(meta["dtype"])
+        meta["nbytes"] = int(np.prod(shape)) * dt.itemsize
+        if prompt_len is not None:
+            meta["prompt_len"] = prompt_len
+        with self._lock:
+            tid = self._next_id
+            self._next_id += 1
+            jax_uuid = None
+            if self._use_jax and device_array is not None:
+                jax_uuid = tid
+                try:
+                    _get_jax_server().await_pull(jax_uuid, [device_array])
+                except Exception:  # noqa: BLE001 — fall back to socket
+                    log.exception("jax-path staging failed; socket only")
+                    jax_uuid = None
+            self._staged[tid] = _Staged(meta, kv, resolve, jax_uuid)
+            self._gc_locked()
+        ticket = {"id": tid, "addr": self.address, **meta}
+        if jax_uuid is not None:
+            ticket["jax_addr"] = _get_jax_server().address()
+            ticket["jax_uuid"] = jax_uuid
+        return ticket
+
+    def _gc_locked(self) -> None:
+        now = time.monotonic()
+        dead = [tid for tid, s in self._staged.items()
+                if now - s.t > STAGED_TTL_S]
+        for tid in dead:
+            del self._staged[tid]
+        if dead:
+            log.warning("expired %d unclaimed KV transfers", len(dead))
+
+    # -- server loops --------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            while True:
+                try:
+                    req = _recv_ctrl(conn)
+                except (ConnectionError, OSError):
+                    return
+                op = req.get("op")
+                if op == "pull":
+                    self._handle_pull(conn, req)
+                elif op == "blocks":
+                    self._handle_blocks(conn, req)
+                elif op == "done":
+                    # Fire-and-forget: a jax-path pull completed — drop
+                    # the staged entry now instead of pinning the device
+                    # array until the TTL.
+                    with self._lock:
+                        self._staged.pop(int(req.get("id", -1)), None)
+                else:
+                    _send_ctrl(conn, {"err": f"unknown op {op!r}"})
+        except (ConnectionError, OSError, ValueError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle_pull(self, conn: socket.socket, req: dict) -> None:
+        with self._lock:
+            staged = self._staged.pop(int(req["id"]), None)
+        if staged is None:
+            _send_ctrl(conn, {"err": "unknown or expired transfer id"})
+            return
+        try:
+            arr = np.ascontiguousarray(staged.array())
+        except Exception as exc:  # noqa: BLE001 — resolve() device fault
+            log.exception("staged KV resolve failed")
+            _send_ctrl(conn, {"err": f"resolve failed: {exc}"})
+            return
+        _send_ctrl(conn, {"ok": True, **staged.meta})
+        _send_bulk(conn, arr)
+        self.transfers += 1
+        self.bytes_out += arr.nbytes
+
+    def _handle_blocks(self, conn: socket.socket, req: dict) -> None:
+        """G4 remote-tier serve: return which of the requested block hashes
+        this worker holds in its host tiers, with their bytes, stopping at
+        the first miss (prefix semantics: later blocks are useless without
+        earlier ones)."""
+        self.block_requests += 1
+        provider = self.block_provider
+        hashes = [int(h) for h in req.get("hashes", [])]
+        limit = int(req.get("max", 64))
+        found: list[np.ndarray] = []
+        found_hashes: list[int] = []
+        if provider is not None:
+            for h in hashes[:limit]:
+                kv = provider(h)
+                if kv is None:
+                    break
+                found.append(np.ascontiguousarray(kv))
+                found_hashes.append(h)
+        if not found:
+            _send_ctrl(conn, {"ok": True, "hashes": [], "shape": [],
+                              "dtype": "", "nbytes": 0})
+            return
+        stacked = np.stack(found)  # [n, 2, L, Nkv, page, D]
+        _send_ctrl(conn, {"ok": True, "hashes": found_hashes,
+                          "shape": list(stacked.shape),
+                          "dtype": str(stacked.dtype),
+                          "nbytes": stacked.nbytes})
+        _send_bulk(conn, stacked)
+        self.blocks_served += len(found)
+
+
+class KvPlaneClient:
+    """Sink side: pulls staged parcels / peer host-tier blocks. Blocking
+    socket I/O runs on executor threads; per-address connections are
+    cached (pulls from the same prefill worker reuse one TCP stream)."""
+
+    def __init__(self, timeout: float = 30.0):
+        # addr -> (socket, per-connection lock): pulls run on executor
+        # threads, and two concurrent request/response cycles on one
+        # socket would interleave frames — the lock serializes the full
+        # cycle per connection. ``timeout`` bounds connect AND each recv:
+        # callers on latency-sensitive threads (the engine's G4 consult)
+        # pass a small value so a blackholed peer can't stall them long.
+        self.timeout = timeout
+        self._conns: dict[str, tuple[socket.socket, threading.Lock]] = {}
+        self._lock = threading.Lock()
+        self.transfers = 0
+        self.bytes_in = 0
+        self.jax_pulls = 0
+        self._use_jax = None  # probed on first jax-path ticket
+
+    # -- sync core (executor) ------------------------------------------------
+    def _conn_for(self, addr: str) -> tuple[socket.socket, threading.Lock]:
+        with self._lock:
+            entry = self._conns.get(addr)
+        if entry is not None:
+            return entry
+        host, port = addr.rsplit(":", 1)
+        sock = socket.create_connection((host, int(port)),
+                                        timeout=self.timeout)
+        sock.settimeout(self.timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        with self._lock:
+            old = self._conns.get(addr)
+            if old is not None:
+                sock.close()
+                return old
+            entry = (sock, threading.Lock())
+            self._conns[addr] = entry
+        return entry
+
+    def _drop_conn(self, addr: str) -> None:
+        with self._lock:
+            entry = self._conns.pop(addr, None)
+        if entry is not None:
+            try:
+                entry[0].close()
+            except OSError:
+                pass
+
+    def _pull_jax(self, ticket: dict) -> np.ndarray | None:
+        if self._use_jax is None:
+            self._use_jax = jax_transfer_usable()
+        if not self._use_jax or "jax_addr" not in ticket:
+            return None
+        try:
+            import jax
+            from jax.sharding import SingleDeviceSharding
+
+            conn = _get_jax_server().connect(ticket["jax_addr"])
+            dev = jax.local_devices()[0]
+            spec = jax.ShapeDtypeStruct(
+                tuple(ticket["shape"]), dtype_of(ticket["dtype"]),
+                sharding=SingleDeviceSharding(dev))
+            out = conn.pull(int(ticket["jax_uuid"]), [spec])
+            self.jax_pulls += 1
+            return np.asarray(out[0])
+        except Exception:  # noqa: BLE001 — fall through to the socket path
+            log.exception("jax-path pull failed; falling back to socket")
+            return None
+
+    def pull_sync(self, ticket: dict) -> np.ndarray:
+        out = self._pull_jax(ticket)
+        if out is not None:
+            self.transfers += 1
+            try:  # release the server's staged entry (best-effort)
+                sock, conn_lock = self._conn_for(ticket["addr"])
+                with conn_lock:
+                    _send_ctrl(sock, {"op": "done",
+                                      "id": int(ticket["id"])})
+            except (ConnectionError, OSError):
+                pass  # TTL GC covers it
+            return out
+        addr = ticket["addr"]
+        sock, conn_lock = self._conn_for(addr)
+        try:
+            with conn_lock:
+                _send_ctrl(sock, {"op": "pull", "id": int(ticket["id"])})
+                resp = _recv_ctrl(sock)
+                if "err" in resp:
+                    raise ConnectionError(f"KV pull refused: {resp['err']}")
+                shape = resp["shape"]
+                dt = dtype_of(resp["dtype"])
+                buf = np.empty(int(resp["nbytes"]), np.uint8)
+                _recv_bulk_into(sock, memoryview(buf))
+        except (ConnectionError, OSError):
+            self._drop_conn(addr)
+            raise
+        self.transfers += 1
+        self.bytes_in += buf.nbytes
+        return buf.view(dt).reshape(shape)
+
+    def fetch_blocks_sync(self, addr: str, hashes: list[int],
+                          max_blocks: int = 64
+                          ) -> tuple[list[int], np.ndarray | None]:
+        """G4: ask a peer for a consecutive run of block hashes from its
+        host tiers. Returns (hashes found, [n, 2, L, Nkv, page, D])."""
+        sock, conn_lock = self._conn_for(addr)
+        try:
+            with conn_lock:
+                _send_ctrl(sock, {"op": "blocks", "hashes": hashes,
+                                  "max": max_blocks})
+                resp = _recv_ctrl(sock)
+                if "err" in resp:
+                    raise ConnectionError(
+                        f"block fetch refused: {resp['err']}")
+                if not resp["hashes"]:
+                    return [], None
+                dt = dtype_of(resp["dtype"])
+                buf = np.empty(int(resp["nbytes"]), np.uint8)
+                _recv_bulk_into(sock, memoryview(buf))
+        except (ConnectionError, OSError):
+            self._drop_conn(addr)
+            raise
+        self.bytes_in += buf.nbytes
+        return resp["hashes"], buf.view(dt).reshape(resp["shape"])
+
+    # -- async wrappers ------------------------------------------------------
+    async def pull(self, ticket: dict) -> np.ndarray:
+        return await asyncio.get_running_loop().run_in_executor(
+            None, self.pull_sync, ticket)
+
+    async def fetch_blocks(self, addr: str, hashes: list[int],
+                           max_blocks: int = 64):
+        return await asyncio.get_running_loop().run_in_executor(
+            None, self.fetch_blocks_sync, addr, hashes, max_blocks)
+
+    def close(self) -> None:
+        with self._lock:
+            conns, self._conns = dict(self._conns), {}
+        for sock, _ in conns.values():
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+class RemoteBlockSource:
+    """G4 remote tier: fetch KV blocks from PEER workers' host tiers by
+    block hash (reference CacheLevel G4, block_manager.rs:76-82 + the
+    distributed leader/worker's cross-worker reuse role). The engine
+    consults it when a prefix extension misses G1/G2/G3 — one bounded
+    round trip per peer, first hit wins; content-hashed blocks make the
+    result trustworthy regardless of which worker computed them.
+
+    ``peers`` is swapped wholesale by the worker's coordinator watcher
+    (kvplane/ registrations), so the engine thread only ever reads a
+    consistent list."""
+
+    # G4 fetches run on the ENGINE thread between windows: a dead peer
+    # must cost seconds at most, not the plane's bulk-transfer timeout —
+    # and a peer that keeps failing must stop costing anything at all
+    # until its cooldown expires (its lease usually expires first).
+    G4_TIMEOUT_S = 2.0
+    PEER_COOLDOWN_S = 60.0
+
+    def __init__(self, client: KvPlaneClient | None = None,
+                 self_addr: str | None = None, max_peers: int = 4):
+        self.client = client or KvPlaneClient(timeout=self.G4_TIMEOUT_S)
+        self.self_addr = self_addr
+        self.max_peers = max_peers
+        self.peers: list[str] = []
+        self._cooldown: dict[str, float] = {}  # addr -> retry-after
+        self.fetched_blocks = 0
+        self.fetch_failures = 0
+
+    def fetch(self, hashes: list[int], max_blocks: int
+              ) -> list[tuple[int, np.ndarray]]:
+        """SYNC (engine thread, between windows): returns the longest
+        consecutive run of requested blocks any single peer holds."""
+        now = time.monotonic()
+        for addr in list(self.peers)[:self.max_peers]:
+            if addr == self.self_addr or not addr:
+                continue
+            if self._cooldown.get(addr, 0.0) > now:
+                continue
+            try:
+                found, arr = self.client.fetch_blocks_sync(
+                    addr, hashes, max_blocks)
+            except (ConnectionError, OSError):
+                self.fetch_failures += 1
+                self._cooldown[addr] = now + self.PEER_COOLDOWN_S
+                log.warning("G4 peer %s unreachable; cooling down %.0fs",
+                            addr, self.PEER_COOLDOWN_S)
+                continue
+            self._cooldown.pop(addr, None)
+            if found:
+                self.fetched_blocks += len(found)
+                return [(h, arr[i]) for i, h in enumerate(found)]
+        return []

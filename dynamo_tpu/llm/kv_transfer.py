@@ -49,15 +49,23 @@ def kv_from_chunks(meta: dict, chunks: list[bytes]) -> np.ndarray:
     return np.frombuffer(raw, dtype=dtype).reshape(meta["shape"])
 
 
-async def collect_prefill_response(stream: AsyncIterator[dict]
+async def collect_prefill_response(stream: AsyncIterator[dict],
+                                   plane_client=None
                                    ) -> tuple[int, np.ndarray]:
-    """Assemble a prefill worker's chunked response into
-    (first_token, kv parcel)."""
+    """Assemble a prefill worker's response into (first_token, kv parcel).
+
+    Two wire forms: a transfer TICKET (the worker staged the parcel on
+    the direct KV data plane, llm/kv_plane.py — pull the bulk bytes
+    there), or inline chunks (the v0 host-staged path, still emitted by
+    plane-less workers)."""
     chunks: list[bytes] = []
     meta = None
+    ticket = None
     first_token = None
     async for out in stream:
         dp = out.get("disagg_params") or {}
+        if "ticket" in dp:
+            ticket = dp["ticket"]
         if "kv_chunk" in dp:
             chunks.append(dp["kv_chunk"])
         if "shape" in dp:
@@ -65,6 +73,12 @@ async def collect_prefill_response(stream: AsyncIterator[dict]
         toks = out.get("token_ids") or []
         if toks:
             first_token = toks[0]
-    if meta is None or first_token is None:
+    if first_token is None or (meta is None and ticket is None):
         raise RuntimeError("incomplete disaggregated prefill response")
+    if ticket is not None:
+        if plane_client is None:
+            raise RuntimeError(
+                "prefill worker sent a KV-plane ticket but this worker "
+                "has no plane client")
+        return first_token, await plane_client.pull(ticket)
     return first_token, kv_from_chunks(meta, chunks)

@@ -1,0 +1,151 @@
+"""Queue-based prefill dispatch (the reference's JetStream PrefillQueue,
+lib/runtime/src/transports/nats.rs:433-600 NatsQueue + the xPyD
+load-leveling described in docs/architecture/disagg_serving.md).
+
+Instead of the decode worker round-robining prompts at prefill workers
+(direct mode, llm/disagg.py), it PUSHES work onto a shared coordinator
+queue and prefill workers PULL when free — a worker chewing a long
+prompt simply doesn't pull, so load levels across xP automatically.
+
+Flow: decode worker subscribes a per-request reply subject, pushes
+{req, reply} onto ``prefillq/<model>``, and waits (bounded). A prefill
+worker's pull loop pops, prefills + stages the KV parcel on its data
+plane (llm/kv_plane.py), and publishes {ticket, first_token} to the
+reply subject; the decode worker pulls the parcel worker-to-worker and
+injects. Queue DEPTH is the backpressure signal: past
+``max_queue_depth`` the decode worker prefills locally instead of
+enqueueing (the queue-depth-driven local/remote split — conditional
+disaggregation's load-leveling term).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+
+from dynamo_tpu.llm.model_card import model_slug
+from dynamo_tpu.llm.protocols import PreprocessedRequest
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("prefill_queue")
+
+REPLY_PREFIX = "prefillr."
+
+
+def queue_name(model_name: str) -> str:
+    return f"prefillq/{model_slug(model_name)}"
+
+
+class QueuePrefillWorker:
+    """Prefill-worker side: pull -> prefill+stage -> reply, one at a time
+    (pulling only when free IS the load-leveling — a busy worker leaves
+    work on the queue for its peers)."""
+
+    def __init__(self, engine, client, model_name: str, plane,
+                 poll_timeout: float = 1.0):
+        self.engine = engine
+        self.client = client
+        self.queue = queue_name(model_name)
+        self.plane = plane
+        self.poll_timeout = poll_timeout
+        self.pulled = 0
+        self.failed = 0
+        self._task: asyncio.Task | None = None
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                item = await self.client.queue_pop(
+                    self.queue, timeout=self.poll_timeout)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — the pull loop must survive
+                # Anything less and the worker silently stops draining the
+                # queue while still serving the direct endpoint — queue-
+                # mode decode workers would degrade to local-only forever.
+                log.exception("prefill queue pop failed; retrying")
+                await asyncio.sleep(0.5)
+                continue
+            if item is None:
+                continue
+            await self._serve_one(item)
+
+    async def _serve_one(self, item: dict) -> None:
+        reply = item.get("reply")
+        try:
+            req = PreprocessedRequest.from_wire(item["req"])
+            first_token, ticket, prompt_len = await self.engine.run_job(
+                lambda: self.engine.prefill_extract_staged(req, self.plane))
+            self.pulled += 1
+            log.info("queue prefill served: %d tokens, ticket %d",
+                     prompt_len, ticket["id"])
+            await self.client.publish(
+                reply, {"first_token": first_token, "ticket": ticket})
+        except Exception as exc:  # noqa: BLE001 — report to the requester
+            self.failed += 1
+            log.exception("queue prefill failed")
+            if reply:
+                try:
+                    await self.client.publish(reply, {"error": str(exc)})
+                except (ConnectionError, OSError):
+                    pass
+
+
+class QueuePrefillDispatcher:
+    """Decode-worker side: enqueue with depth backpressure, await the
+    reply, pull the parcel over the data plane."""
+
+    def __init__(self, client, model_name: str, plane_client,
+                 max_queue_depth: int = 8, reply_timeout: float = 120.0):
+        self.client = client
+        self.queue = queue_name(model_name)
+        self.plane_client = plane_client
+        self.max_queue_depth = max_queue_depth
+        self.reply_timeout = reply_timeout
+        self.enqueued = 0
+        self.backpressured = 0
+
+    async def remote_prefill(self, req: PreprocessedRequest):
+        """Returns (first_token, kv) or None (backpressure/timeout/error —
+        caller prefills locally)."""
+        depth = await self.client.queue_len(self.queue)
+        if depth >= self.max_queue_depth:
+            # The queue-depth-driven prefill-load split: deep queue means
+            # every prefill worker is busy — local prefill beats queueing.
+            self.backpressured += 1
+            log.info("prefill queue depth %d >= %d: prefilling locally",
+                     depth, self.max_queue_depth)
+            return None
+        reply = REPLY_PREFIX + uuid.uuid4().hex
+        sub = await self.client.subscribe(reply)
+        try:
+            await self.client.queue_push(
+                self.queue, {"req": req.to_wire(), "reply": reply})
+            self.enqueued += 1
+            try:
+                msg = await asyncio.wait_for(sub.__aiter__().__anext__(),
+                                             timeout=self.reply_timeout)
+            except asyncio.TimeoutError:
+                log.warning("prefill queue reply timed out after %.0fs",
+                            self.reply_timeout)
+                return None
+            payload = msg["payload"]
+            if "error" in payload:
+                log.warning("queued prefill failed remotely: %s",
+                            payload["error"])
+                return None
+            kv = await self.plane_client.pull(payload["ticket"])
+            return payload["first_token"], kv
+        finally:
+            await sub.cancel()

@@ -104,7 +104,7 @@ class _Stack:
     pass
 
 
-async def start_stack(prefill_tp=1, decode_tp=1, max_local=8):
+async def start_stack(prefill_tp=1, decode_tp=1, max_local=8, plane=False):
     s = _Stack()
     s.coord = Coordinator()
     await s.coord.start()
@@ -113,10 +113,16 @@ async def start_stack(prefill_tp=1, decode_tp=1, max_local=8):
     s.p_rt = await DistributedRuntime.from_settings(cfg())
     s.d_rt = await DistributedRuntime.from_settings(cfg())
 
+    s.plane = None
+    if plane:
+        from dynamo_tpu.llm.kv_plane import KvPlaneServer
+        s.plane = KvPlaneServer()
+        s.plane.start()
     s.p_engine = TPUEngine(tiny_config(tp=prefill_tp))
     p_ep = s.p_rt.namespace("test").component("prefill").endpoint("generate")
-    s.p_server = await p_ep.serve_endpoint(make_prefill_handler(s.p_engine),
-                                           graceful_shutdown=True)
+    s.p_server = await p_ep.serve_endpoint(
+        make_prefill_handler(s.p_engine, plane=s.plane),
+        graceful_shutdown=True)
 
     s.d_engine = TPUEngine(tiny_config(tp=decode_tp))
     pc_ep = s.d_rt.namespace("test").component("prefill").endpoint("generate")
@@ -146,6 +152,9 @@ async def stop_stack(s) -> None:
     await s.p_server.shutdown()
     s.d_engine.stop()
     s.p_engine.stop()
+    s.handler.plane_client.close()
+    if s.plane is not None:
+        s.plane.close()
     await s.d_rt.close()
     await s.p_rt.close()
     await s.coord.stop()

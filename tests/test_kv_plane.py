@@ -1,0 +1,173 @@
+"""Direct KV data plane tests (llm/kv_plane.py — the NIXL role).
+
+Unit: stage/pull round-trips (eager + deferred resolve), expired tickets,
+peer block fetch (G4 op). E2E: the disagg stack moving its parcel over
+the plane's direct socket path with ZERO inline kv_chunk frames, token-
+identical to aggregated, including the TP-mismatch re-shard.
+Reference semantics: lib/llm/src/block_manager/storage/nixl.rs (RDMA KV
+plane), docs/architecture/dynamo_flow.md §NIXL (metadata handshake).
+"""
+
+import numpy as np
+import pytest
+from conftest import async_test
+
+from dynamo_tpu.llm.kv_plane import KvPlaneClient, KvPlaneServer
+from test_disagg import (
+    _prompt, run_agg, run_request, start_stack, stop_stack)
+
+
+def _rand_kv(shape=(2, 2, 2, 3, 16, 32), seed=0):
+    import ml_dtypes
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal(shape).astype(ml_dtypes.bfloat16)
+
+
+@pytest.fixture
+def plane():
+    server = KvPlaneServer(use_jax_path=False)
+    server.start()
+    client = KvPlaneClient()
+    yield server, client
+    client.close()
+    server.close()
+
+
+@async_test
+async def test_stage_pull_roundtrip(plane):
+    server, client = plane
+    kv = _rand_kv()
+    ticket = server.stage(kv=kv, prompt_len=48)
+    assert ticket["prompt_len"] == 48
+    assert ticket["nbytes"] == kv.nbytes
+    out = await client.pull(ticket)
+    assert out.dtype == kv.dtype
+    np.testing.assert_array_equal(kv.view(np.uint16), out.view(np.uint16))
+    assert server.transfers == 1 and client.transfers == 1
+    assert server.bytes_out == kv.nbytes == client.bytes_in
+
+
+@async_test
+async def test_deferred_resolve_runs_on_pull(plane):
+    """The staged parcel may be a deferred device fetch: resolve() runs on
+    the plane thread at pull time (overlap with the engine's windows)."""
+    server, client = plane
+    kv = _rand_kv(seed=1)
+    calls = []
+
+    def resolve():
+        calls.append(1)
+        return kv
+
+    ticket = server.stage(meta={"shape": list(kv.shape),
+                                "dtype": "bfloat16"}, resolve=resolve)
+    assert not calls  # staging must not resolve
+    out = await client.pull(ticket)
+    assert calls == [1]
+    np.testing.assert_array_equal(kv.view(np.uint16), out.view(np.uint16))
+
+
+@async_test
+async def test_pull_twice_and_unknown_id_fail(plane):
+    server, client = plane
+    kv = _rand_kv(seed=2)
+    ticket = server.stage(kv=kv)
+    await client.pull(ticket)
+    with pytest.raises((ConnectionError, OSError)):
+        await client.pull(ticket)  # one-shot: consumed
+    with pytest.raises((ConnectionError, OSError)):
+        await client.pull({**ticket, "id": 999999})
+
+
+@async_test
+async def test_large_parcel_multi_chunk(plane):
+    """Parcels far larger than the send chunk stream intact."""
+    server, client = plane
+    kv = np.arange(6 << 20, dtype=np.float32).reshape(2, 3 << 20 >> 1, 2)
+    ticket = server.stage(kv=kv)
+    out = await client.pull(ticket)
+    np.testing.assert_array_equal(kv, out)
+
+
+@async_test
+async def test_block_fetch_prefix_semantics(plane):
+    """The G4 op returns the consecutive run of requested hashes the peer
+    holds, stopping at the first miss."""
+    server, client = plane
+    store = {10: _rand_kv((2, 2, 2, 16, 32), seed=3),
+             11: _rand_kv((2, 2, 2, 16, 32), seed=4),
+             13: _rand_kv((2, 2, 2, 16, 32), seed=5)}
+    server.block_provider = store.get
+    hashes, blocks = await client.fetch_blocks(
+        server.address, [10, 11, 12, 13])
+    assert hashes == [10, 11]  # 12 missing stops the run; 13 unreachable
+    assert blocks.shape[0] == 2
+    np.testing.assert_array_equal(blocks[0].view(np.uint16),
+                                  store[10].view(np.uint16))
+    np.testing.assert_array_equal(blocks[1].view(np.uint16),
+                                  store[11].view(np.uint16))
+    hashes, blocks = await client.fetch_blocks(server.address, [99])
+    assert hashes == [] and blocks is None
+    assert server.block_requests == 2 and server.blocks_served == 2
+
+
+@async_test
+async def test_no_provider_returns_empty(plane):
+    server, client = plane
+    hashes, blocks = await client.fetch_blocks(server.address, [1, 2])
+    assert hashes == [] and blocks is None
+
+
+# ---------------------------------------------------------------------------
+# e2e: disagg over the plane
+# ---------------------------------------------------------------------------
+
+@async_test
+async def test_disagg_over_plane_token_identical():
+    """1P+1D with the KV parcel on the direct plane: greedy output matches
+    the aggregated engine, exactly one plane transfer, and no inline
+    kv_chunk ever rides the request plane."""
+    s = await start_stack(max_local=8, plane=True)
+    try:
+        prompt = _prompt(30, 24)
+        got = await run_request(s.caller, prompt, 10)
+        assert s.handler.remote_prefills == 1
+        assert s.handler.remote_failures == 0
+        assert s.plane.transfers == 1
+        assert s.handler.plane_client.transfers == 1
+        ref = await run_agg(prompt, 10)
+        assert got == ref
+    finally:
+        await stop_stack(s)
+
+
+@async_test
+async def test_disagg_over_plane_tp_mismatch():
+    """tp=1 prefill -> tp=2 decode over the plane: the deferred resolve
+    dedups KV-head replicas and the decode mesh re-shards on upload."""
+    s = await start_stack(prefill_tp=1, decode_tp=2, max_local=8, plane=True)
+    try:
+        prompt = _prompt(31, 24)
+        got = await run_request(s.caller, prompt, 8)
+        assert s.handler.remote_prefills == 1
+        assert s.plane.transfers == 1
+        ref = await run_agg(prompt, 8, tp=2)
+        assert got == ref
+    finally:
+        await stop_stack(s)
+
+
+@async_test
+async def test_plane_death_falls_back_to_local_prefill():
+    """Plane server dies between staging and pull: the decode worker
+    degrades to local prefill instead of failing the request."""
+    s = await start_stack(max_local=8, plane=True)
+    try:
+        s.plane.close()  # tickets still issued; pulls now fail
+        prompt = _prompt(32, 24)
+        got = await run_request(s.caller, prompt, 6)
+        assert len(got) == 6
+        assert s.handler.remote_failures == 1
+        assert s.handler.local_prefills == 1
+    finally:
+        await stop_stack(s)

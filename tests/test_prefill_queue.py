@@ -1,0 +1,114 @@
+"""Queue-based prefill dispatch tests (llm/prefill_queue.py — the
+reference's JetStream PrefillQueue role, nats.rs:433-600): e2e over the
+queue token-identical to aggregated, queue-depth backpressure driving
+the local/remote split, and reply-timeout fallback.
+"""
+
+import asyncio
+
+import pytest
+from conftest import async_test
+
+from dynamo_tpu.llm.disagg import DisaggDecodeHandler, DisaggRouterConfig
+from dynamo_tpu.llm.kv_plane import KvPlaneClient
+from dynamo_tpu.llm.prefill_queue import (QueuePrefillDispatcher,
+                                          QueuePrefillWorker, queue_name)
+from dynamo_tpu.llm.protocols import PreprocessedRequest
+from dynamo_tpu.runtime.context import Context
+from test_disagg import _prompt, run_agg, start_stack, stop_stack
+
+
+async def start_queue_stack(max_local=8, max_queue_depth=8):
+    """The disagg stack rewired for queue dispatch: the prefill worker
+    pulls from the shared queue; the decode handler enqueues."""
+    s = await start_stack(max_local=max_local, plane=True)
+    s.queue_worker = QueuePrefillWorker(
+        s.p_engine, s.p_rt.require_coordinator(), "tiny-test", s.plane,
+        poll_timeout=0.2)
+    s.queue_worker.start()
+    s.dispatcher = QueuePrefillDispatcher(
+        s.d_rt.require_coordinator(), "tiny-test", KvPlaneClient(),
+        max_queue_depth=max_queue_depth, reply_timeout=60.0)
+    s.handler.queue_dispatcher = s.dispatcher
+    return s
+
+
+async def stop_queue_stack(s):
+    await s.queue_worker.stop()
+    s.dispatcher.plane_client.close()
+    await stop_stack(s)
+
+
+@async_test(timeout=240)
+async def test_queue_dispatch_token_identical():
+    s = await start_queue_stack(max_local=8)
+    try:
+        from test_disagg import run_request
+        prompt = _prompt(40, 24)
+        got = await run_request(s.caller, prompt, 10)
+        assert s.dispatcher.enqueued == 1
+        assert s.queue_worker.pulled == 1
+        assert s.handler.remote_prefills == 1
+        assert s.plane.transfers == 1  # parcel rode the data plane
+        ref = await run_agg(prompt, 10)
+        assert got == ref
+    finally:
+        await stop_queue_stack(s)
+
+
+@async_test(timeout=240)
+async def test_queue_depth_backpressure_goes_local():
+    """A deep queue drives the split to LOCAL prefill (the queue-depth
+    prefill-load term): pre-fill the queue past the threshold and the
+    handler must not enqueue."""
+    s = await start_queue_stack(max_local=8, max_queue_depth=2)
+    try:
+        await s.queue_worker.stop()  # nobody drains the stuffing
+        client = s.d_rt.require_coordinator()
+        for i in range(2):
+            await client.queue_push(queue_name("tiny-test"),
+                                    {"req": {}, "reply": f"junk{i}"})
+        req = PreprocessedRequest(model="tiny-test",
+                                  token_ids=_prompt(41, 24))
+        req.stop_conditions.max_tokens = 6
+        toks = []
+        async for out in s.handler.generate(req.to_wire(), Context()):
+            toks.extend(out.get("token_ids", []))
+            if out.get("finish_reason"):
+                break
+        assert len(toks) == 6
+        assert s.dispatcher.backpressured == 1
+        assert s.dispatcher.enqueued == 0
+        assert s.handler.local_prefills == 1
+    finally:
+        await stop_queue_stack(s)
+
+
+@async_test(timeout=240)
+async def test_queue_reply_timeout_falls_back_local():
+    s = await start_queue_stack(max_local=8)
+    try:
+        await s.queue_worker.stop()  # no worker will ever reply
+        s.dispatcher.reply_timeout = 0.5
+        req = PreprocessedRequest(model="tiny-test",
+                                  token_ids=_prompt(42, 24))
+        req.stop_conditions.max_tokens = 6
+        toks = []
+        async for out in s.handler.generate(req.to_wire(), Context()):
+            toks.extend(out.get("token_ids", []))
+            if out.get("finish_reason"):
+                break
+        assert len(toks) == 6
+        assert s.dispatcher.enqueued == 1
+        assert s.handler.local_prefills == 1
+    finally:
+        await stop_queue_stack(s)
+
+
+def test_worker_cli_flags():
+    from dynamo_tpu.backends.tpu import parse_args
+    args = parse_args(["--mode", "decode", "--prefill-dispatch", "queue",
+                       "--max-prefill-queue-depth", "4"])
+    assert args.prefill_dispatch == "queue"
+    assert args.max_prefill_queue_depth == 4
+    assert parse_args([]).prefill_dispatch == "direct"
