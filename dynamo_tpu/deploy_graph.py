@@ -70,10 +70,26 @@ def validate(spec: dict) -> None:
             raise GraphError(
                 f"worker {role!r}: mesh needs {tp} chips but requests "
                 f"{chips} x {nodes} node(s)")
-        if nodes > 1 and mode != "agg":
+        if nodes > 1 and spec.get("planner", {}).get("enabled") \
+                and mode in ("agg", "decode"):
+            # The planner's kube connector patches StatefulSet /scale —
+            # but a multi-host worker's replica count is the NODE COUNT
+            # of ONE engine: scaling it kills a follower mid-collective
+            # or adds an out-of-range node rank.
             raise GraphError(
-                f"worker {role!r}: multi-host single engine supports "
-                "aggregated mode only")
+                f"worker {role!r}: the planner cannot scale a multi-host "
+                "engine group (its StatefulSet replicas are node ranks, "
+                "not engine replicas); disable the planner or declare "
+                "fixed worker entries per group")
+        if nodes > 1 and int(w.get("replicas", 1)) > 1:
+            # One StatefulSet would pool replicas*nodes pods under a single
+            # --mh-group and coordinator address, with ordinals >= nodes
+            # yielding invalid ranks and colliding dispatch streams.
+            raise GraphError(
+                f"worker {role!r}: replicas > 1 with num_nodes > 1 is not "
+                "renderable as one StatefulSet (each multi-host engine "
+                "group needs its own mh-group and coordinator address); "
+                "declare one worker entry per replica group instead")
     if "decode" in modes and "prefill" not in modes:
         raise GraphError("graph has decode workers but no prefill workers")
     if "prefill" in modes and "decode" not in modes:
@@ -150,15 +166,33 @@ def _worker(spec: dict, role: str, w: dict) -> list[dict]:
     mode = w.get("mode", "agg")
     tpu = {**DEFAULT_TPU, **spec.get("tpu", {}), **w.get("tpu", {})}
     chips = int(w.get("chips", int(w.get("tp", 1))))
+    # --component <role>: metrics/KV-event subjects and (for prefill
+    # workers) the served component carry the graph role name, so the
+    # planner's per-pool metrics subscription and its kube connector's
+    # StatefulSet target (<graph>-<role>) line up by construction.
     command = ["python", "-m", "dynamo_tpu.backends.tpu",
-               "--model", model, "--mode", mode]
+               "--model", model, "--mode", mode, "--component", role,
+               # The KV data plane must advertise an address PEER PODS can
+               # reach — the default binds loopback (fine for one host,
+               # dead for cross-pod disagg/G4).
+               "--kv-plane-host", "$(POD_IP)"]
+    if mode == "prefill":
+        command += ["--prefill-component", role]
     for flag in ("tp", "dp", "pp", "sp"):
         if int(w.get(flag, 1)) != 1:
             command += [f"--{flag}", str(int(w[flag]))]
-    if mode == "decode" and "max_local_prefill_length" in w:
-        command += ["--max-local-prefill-length",
-                    str(int(w["max_local_prefill_length"]))]
-    env = [{"name": "DTPU_COORDINATOR_URL", "value": _coord_url(spec)}]
+    if mode == "decode":
+        prefill_role = next(
+            (r for r, other in spec.get("workers", {}).items()
+             if other.get("mode", "agg") == "prefill"), None)
+        if prefill_role:
+            command += ["--prefill-component", prefill_role]
+        if "max_local_prefill_length" in w:
+            command += ["--max-local-prefill-length",
+                        str(int(w["max_local_prefill_length"]))]
+    env = [{"name": "DTPU_COORDINATOR_URL", "value": _coord_url(spec)},
+           {"name": "POD_IP",
+            "valueFrom": {"fieldRef": {"fieldPath": "status.podIP"}}}]
     nodes = int(w.get("num_nodes", 1))
     if nodes > 1:
         # Multi-host single engine: pod ordinal = node rank; rank 0 serves.
@@ -204,7 +238,20 @@ def _planner(spec: dict) -> list[dict]:
         return []
     name = _component_name(spec["name"], "planner")
     labels = {"app": name}
-    args = ["python", "-m", "dynamo_tpu.planner"]
+    # The kube connector scales this graph's StatefulSets in-cluster
+    # (planner/kube.py; RBAC for statefulsets/scale rides the
+    # serviceAccountName below).
+    args = ["python", "-m", "dynamo_tpu.planner",
+            "--connector", "kube", "--graph-name", spec["name"]]
+    workers = spec.get("workers", {})
+    decode = next((r for r, w in workers.items()
+                   if w.get("mode", "agg") == "decode"), None)
+    prefill = next((r for r, w in workers.items()
+                    if w.get("mode", "agg") == "prefill"), None)
+    if decode:
+        args += ["--decode-component", decode]
+    if prefill:
+        args += ["--prefill-component", prefill]
     for k in ("min_replicas", "max_replicas"):
         if k in p:
             args += [f"--{k.replace('_', '-')}", str(int(p[k]))]

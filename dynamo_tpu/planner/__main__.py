@@ -1,0 +1,105 @@
+"""``python -m dynamo_tpu.planner`` — the planner as a deployable process.
+
+Reference: ``python -m dynamo.planner`` (components/planner). Consumes the
+workers' ForwardPassMetrics pub/sub stream through the coordinator and
+scales worker pools through the selected connector:
+
+- ``--connector kube``: patch StatefulSet replica counts via the
+  Kubernetes API (planner/kube.py) — the deployment rendered by
+  deploy_graph.py names StatefulSets ``<graph>-<component>``.
+- ``--connector log`` (default): record decisions only (dry-run, the
+  reference planner's no-op mode) — safe everywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from dynamo_tpu.planner.connector import FakeConnector
+from dynamo_tpu.planner.core import Planner, PlannerConfig
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("planner.main")
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description="dynamo-tpu SLA planner")
+    p.add_argument("--namespace", default=None)
+    p.add_argument("--decode-component", default="tpu")
+    p.add_argument("--prefill-component", default=None,
+                   help="set for disaggregated deployments")
+    p.add_argument("--adjustment-interval", type=float, default=10.0)
+    p.add_argument("--predictor", default="moving_average",
+                   choices=["constant", "moving_average", "linear"])
+    p.add_argument("--max-num-seqs-per-worker", type=int, default=32)
+    p.add_argument("--target-utilization", type=float, default=0.8)
+    p.add_argument("--prefill-capacity-tok-s", type=float, default=8000.0)
+    p.add_argument("--min-replicas", type=int, default=1)
+    p.add_argument("--max-replicas", type=int, default=8)
+    p.add_argument("--connector", default="log", choices=["log", "kube"])
+    p.add_argument("--graph-name", default=None,
+                   help="kube connector: the deploy_graph graph name "
+                        "(StatefulSets are <graph>-<component>)")
+    p.add_argument("--kube-url", default=None,
+                   help="kube connector: API server base URL override "
+                        "(default: in-cluster env)")
+    p.add_argument("--coordinator-url", default=None)
+    return p.parse_args(argv)
+
+
+async def run(args: argparse.Namespace) -> None:
+    cfg = RuntimeConfig.from_settings()
+    if args.coordinator_url:
+        cfg.coordinator_url = args.coordinator_url
+    if args.namespace:
+        cfg.namespace = args.namespace
+    runtime = await DistributedRuntime.from_settings(cfg)
+    try:
+        if args.connector == "kube":
+            from dynamo_tpu.planner.kube import (KubernetesAPI,
+                                                 KubernetesConnector)
+            if not args.graph_name:
+                raise SystemExit("--connector kube needs --graph-name")
+            connector = KubernetesConnector(
+                args.graph_name,
+                api=KubernetesAPI(base_url=args.kube_url))
+        else:
+            connector = FakeConnector()
+        planner = Planner(PlannerConfig(
+            namespace=cfg.namespace,
+            decode_component=args.decode_component,
+            prefill_component=args.prefill_component,
+            adjustment_interval_s=args.adjustment_interval,
+            predictor=args.predictor,
+            max_num_seqs_per_worker=args.max_num_seqs_per_worker,
+            target_utilization=args.target_utilization,
+            prefill_capacity_tok_s=args.prefill_capacity_tok_s,
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas,
+        ), connector, runtime=runtime)
+        await planner.start()
+        print(f"PLANNER_READY connector={args.connector} "
+              f"decode={args.decode_component} "
+              f"prefill={args.prefill_component or '-'}", flush=True)
+        import signal
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, runtime.shutdown)
+            except NotImplementedError:
+                pass
+        await runtime.wait_for_shutdown()
+        await planner.stop()
+    finally:
+        await runtime.close()
+
+
+def main() -> None:
+    asyncio.run(run(parse_args()))
+
+
+if __name__ == "__main__":
+    main()

@@ -82,10 +82,16 @@ def test_validation_errors():
         render({"name": "g", "workers": {"w": {"mode": "train"}}})
     with pytest.raises(GraphError, match="needs 16 chips"):
         render({"name": "g", "workers": {"w": {"tp": 16, "chips": 8}}})
-    with pytest.raises(GraphError, match="aggregated mode only"):
+    with pytest.raises(GraphError, match="replicas > 1 with num_nodes > 1"):
         render({"name": "g", "workers": {
-            "p": {"mode": "prefill", "num_nodes": 2, "chips": 8, "tp": 4},
-            "d": {"mode": "decode"}}})
+            "w": {"mode": "agg", "num_nodes": 2, "replicas": 2,
+                  "chips": 8, "tp": 4}}})
+    # Multi-host disagg workers render (the round-3 agg-only gate is gone:
+    # KV extract/insert now works through the dispatch-replay plane).
+    ms = render({"name": "g", "workers": {
+        "p": {"mode": "prefill", "num_nodes": 2, "chips": 8, "tp": 4},
+        "d": {"mode": "decode"}}})
+    assert by_name(ms, "StatefulSet", "g-p")["spec"]["replicas"] == 2
     with pytest.raises(GraphError, match="at least one"):
         render({"name": "g", "workers": {}})
 

