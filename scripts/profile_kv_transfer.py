@@ -57,7 +57,7 @@ def main() -> None:
     page = 16
     cfg = EngineConfig(model=spec, page_size=page, num_pages=N_PAGES * 4 + 16,
                        max_pages_per_seq=64, max_num_seqs=8,
-                       prefill_buckets=(128, 256),
+                       prefill_buckets=(128, 256, 512, 1024),
                        attention_backend="xla")
     runner = ModelRunner(cfg)
     tokens = np.random.default_rng(0).integers(

@@ -1,0 +1,121 @@
+"""Measure the multihost dispatch-replay plane's throughput.
+
+The multihost leader publishes EVERY device call's control payload on
+the coordinator pub/sub before executing it (engine/multihost.py
+LeaderRunner); followers replay. This microbench answers: how many
+dispatches per second does that plane sustain, and what latency does a
+pipelined-by-one ack add — i.e. can the replay plane keep up with
+production window rates (a serving engine dispatches one decode window
+every M x step_ms; at bs40/M=32 on a 0.5B model that is ~25 windows/s,
+an 8B ~1-4/s).
+
+Measures, with a real in-process coordinator + two client connections
+(publisher + subscriber), three payload shapes:
+  - decode_window control array  [48, 77] int32  (~15 KB)
+  - prefill_batch of 8 x 128-token rows          (~8 KB)
+  - insert_pages parcel          (configurable pages, MBs — the
+    multihost disagg insert payload)
+
+Run: JAX not needed. `python scripts/profile_mh_dispatch.py`
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N = int(os.environ.get("PROF_N", "200"))
+
+
+async def bench_payload(pub, sub_client, name: str, payload: dict) -> dict:
+    subject = f"prof.{name}"
+    sub = await sub_client.subscribe(subject)
+    it = sub.__aiter__()
+
+    async def drain():
+        for _ in range(N):
+            await it.__anext__()
+
+    drainer = asyncio.create_task(drain())
+    t0 = time.monotonic()
+    # Pipelined-by-one ack, exactly like LeaderRunner._publish.
+    prev = None
+    for i in range(N):
+        fut = asyncio.create_task(pub.publish(subject, payload))
+        if prev is not None:
+            await prev
+        prev = fut
+    await prev
+    publish_s = time.monotonic() - t0
+    await asyncio.wait_for(drainer, timeout=60)
+    end_to_end_s = time.monotonic() - t0
+    await sub.cancel()
+    import msgpack
+    size = len(msgpack.packb(payload, use_bin_type=True))
+    return {
+        "payload_bytes": size,
+        "publish_rate_per_s": round(N / publish_s, 1),
+        "delivered_rate_per_s": round(N / end_to_end_s, 1),
+        "publish_ms_each": round(1e3 * publish_s / N, 3),
+        "mb_s_delivered": round(size * N / end_to_end_s / 1e6, 1),
+    }
+
+
+async def main_async() -> None:
+    from dynamo_tpu.runtime.coordinator import Coordinator
+    from dynamo_tpu.runtime.coordinator_client import CoordinatorClient
+
+    coord = Coordinator("127.0.0.1", 0)
+    await coord.start()
+    host, port = coord.host, coord.port
+    pub = await CoordinatorClient.connect(host, port)
+    sub_client = await CoordinatorClient.connect(host, port)
+
+    def arr(a):
+        a = np.ascontiguousarray(a)
+        return {"b": a.tobytes(), "dtype": str(a.dtype),
+                "shape": list(a.shape)}
+
+    window = {"m": "decode_window", "n": 1,
+              "packed": arr(np.zeros((48, 77), np.int32)), "window": 32}
+    prefill = {"m": "prefill_batch", "n": 1, "slots": list(range(8)),
+               "seqs": [{"tokens": arr(np.zeros(128, np.int32)),
+                         "start_pos": 0,
+                         "chunk_pages": arr(np.zeros(8, np.int32)),
+                         "hist_pages": None,
+                         "sampling": [0.0, 0, 1.0], "logprobs": False,
+                         "penalties": [0.0, 0.0], "seed": None}
+                        for _ in range(8)]}
+    pages = int(os.environ.get("PROF_PARCEL_PAGES", "8"))
+    # llama-3-8b-L8 canonical KV shape per page: [2, 8, 8, 16, 128] bf16.
+    parcel = {"m": "insert_pages", "n": 1,
+              "kv": arr(np.zeros((2, 8, 8, pages, 16, 128), np.uint16)),
+              "pages": list(range(pages))}
+
+    out = {}
+    out["decode_window"] = await bench_payload(pub, sub_client,
+                                               "win", window)
+    out["prefill_batch"] = await bench_payload(pub, sub_client,
+                                               "pre", prefill)
+    out["insert_parcel"] = await bench_payload(pub, sub_client,
+                                               "ins", parcel)
+    await pub.close()
+    await sub_client.close()
+    await coord.stop()
+    print(json.dumps({"metric": "mh_dispatch_replay_plane", "n": N,
+                      **out}))
+
+
+def main() -> None:
+    asyncio.run(main_async())
+
+
+if __name__ == "__main__":
+    main()
