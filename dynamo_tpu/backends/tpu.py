@@ -65,6 +65,11 @@ def parse_args(argv=None) -> argparse.Namespace:
                         help="with --pp > 1: microbatched pipeline-"
                              "parallel prefill (GPipe fill/drain over the "
                              "pp stages) instead of layer-sharded-only")
+    parser.add_argument("--ring-attention", action="store_true",
+                        help="with --sp > 1: rotate K/V blocks around "
+                             "the sp ring (ppermute + online softmax) "
+                             "instead of all-gathering the full K/V — "
+                             "peak K/V memory is one block per device")
     parser.add_argument("--decode-window", default="auto",
                         type=_window_arg,
                         help="decode steps per dispatched window: a "
@@ -160,6 +165,7 @@ def build_engine_config(args) -> EngineConfig:
         tp=args.tp, dp=args.dp, pp=getattr(args, "pp", 1),
         sp=getattr(args, "sp", 1),
         pp_microbatch=getattr(args, "pp_microbatch", False),
+        ring_attention=getattr(args, "ring_attention", False),
         attention_backend=args.attention_backend,
         decode_window=_window_arg(getattr(args, "decode_window", "auto")),
         pipeline_depth=getattr(args, "pipeline_depth", 4),

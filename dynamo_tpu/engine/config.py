@@ -159,6 +159,15 @@ class EngineConfig:
     # traverses the others' layers. Decode and history-chunk prefill keep
     # the layer-sharded path. Requires batch-bucket % pp == 0 to engage.
     pp_microbatch: bool = False
+    # Ring attention for the sp axis (model.ring_causal_attention): K/V
+    # blocks rotate around the sp ring via neighbor ppermute with an
+    # online softmax instead of GSPMD's full K/V all-gather — peak
+    # per-device K/V memory during a WHOLE-PROMPT (single-bucket)
+    # prefill is one block. History-chunk prefills (prompts longer than
+    # the largest bucket) still use the all-gather path, so size
+    # prefill_buckets to the long-context target when enabling this.
+    # Opt-in; the all-gather path stays the default.
+    ring_attention: bool = False
     # Compile the decode-window program and the smallest prefill bucket
     # on the engine thread before serving, so a first short request
     # doesn't pay those XLA compile stalls (larger prefill buckets still

@@ -11,6 +11,7 @@ replacement for the reference engines' NCCL tensor parallelism (SURVEY.md
 from __future__ import annotations
 
 import functools
+import math
 from typing import Any
 
 import jax
@@ -259,6 +260,86 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)
 
 
+def ring_causal_attention(mesh, q: jax.Array, k: jax.Array, v: jax.Array,
+                          q_positions: jax.Array, kv_len_mask: jax.Array,
+                          q_per_kv: int) -> jax.Array:
+    """Ring attention over the "sp" mesh axis (blockwise causal prefill
+    attention with online softmax; Liu et al.'s ring attention shape,
+    lax-level).
+
+    The GSPMD sp path all-gathers the full K/V onto every shard before
+    the quadratic scores — O(s) memory per device in sequence length.
+    Here each sp shard keeps its sequence block resident and the K/V
+    blocks ROTATE around the ring (lax.ppermute neighbor exchange over
+    ICI), with a running (max, sum, acc) online softmax — peak K/V
+    memory is one block, and each hop's transfer overlaps the previous
+    block's matmul in XLA's schedule. Queries never move (they are the
+    larger tensor with GQA).
+
+    q [B,S,Nh,D], k/v [B,S,Nkv,D], q_positions [B,S] absolute,
+    kv_len_mask [B,S] — sequence-sharded over "sp" AND head-sharded over
+    "tp" (both axes stay manual in the shard_map, so tp keeps its
+    head-parallel split instead of being all-gathered; the head-major
+    [nkv, g] layout keeps each kv group's q heads on the group's tp
+    shard, so GQA grouping is shard-local). Causality rides the ABSOLUTE
+    positions travelling with each block, so no step/offset bookkeeping
+    is needed. The ring loop is UNROLLED over the (static, small) shard
+    count: the last block skips the rotation — a fori_loop would pay one
+    dead full-K/V neighbor hop per layer. fp32 accumulation, bf16 matmul
+    operands — same numerics recipe as the dense path. The reference has
+    no sequence parallelism at all (SURVEY §2.7); this is a
+    beyond-parity capability."""
+    from jax.experimental.shard_map import shard_map
+
+    n_shards = mesh.shape["sp"]
+    scale = 1.0 / math.sqrt(q.shape[-1])
+
+    def local(q_blk, k_blk, v_blk, qpos_blk, kmask_blk):
+        b, sq, nh, d = q_blk.shape  # nh, nkv are per-tp-shard counts here
+        nkv = k_blk.shape[2]
+        qg = q_blk.reshape(b, sq, nkv, q_per_kv, d)
+        m = jnp.full((b, nkv, q_per_kv, sq), -1e30, jnp.float32)
+        l = jnp.zeros((b, nkv, q_per_kv, sq), jnp.float32)
+        acc = jnp.zeros((b, nkv, q_per_kv, sq, d), jnp.float32)
+        k_c, v_c = k_blk, v_blk
+        kpos, kmask = qpos_blk, kmask_blk
+        perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+        for t in range(n_shards):
+            if t > 0:  # rotate-before-compute: no dead final hop
+                k_c = jax.lax.ppermute(k_c, "sp", perm)
+                v_c = jax.lax.ppermute(v_c, "sp", perm)
+                kpos = jax.lax.ppermute(kpos, "sp", perm)
+                kmask = jax.lax.ppermute(kmask, "sp", perm)
+            s = jnp.einsum("bqngd,bknd->bngqk", qg, k_c,
+                           preferred_element_type=jnp.float32) * scale
+            ok = ((qpos_blk[:, None, None, :, None]
+                   >= kpos[:, None, None, None, :])
+                  & kmask[:, None, None, None, :])
+            s = jnp.where(ok, s, -1e30)
+            m_new = jnp.maximum(m, s.max(axis=-1))
+            # where() rather than bare exp: an all-masked block would
+            # otherwise yield exp(-1e30 - (-1e30)) = 1 per masked key.
+            p = jnp.where(ok, jnp.exp(s - m_new[..., None]), 0.0)
+            corr = jnp.exp(m - m_new)
+            l = l * corr + p.sum(axis=-1)
+            acc = (acc * corr[..., None]
+                   + jnp.einsum("bngqk,bknd->bngqd",
+                                p.astype(jnp.bfloat16), v_c
+                                ).astype(jnp.float32))
+            m = m_new
+        out = acc / jnp.maximum(l, 1e-9)[..., None]
+        # [B,Nkv,G,sq,D] -> [B,sq,Nh,D]
+        return out.transpose(0, 3, 1, 2, 4).reshape(b, sq, nh, d) \
+            .astype(q_blk.dtype)
+
+    seq_heads = P(None, "sp", "tp", None)
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(seq_heads, seq_heads, seq_heads,
+                  P(None, "sp"), P(None, "sp")),
+        out_specs=seq_heads)(q, k, v, q_positions, kv_len_mask)
+
+
 def dense_causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                            q_positions: jax.Array, kv_len_mask: jax.Array,
                            q_per_kv: int) -> jax.Array:
@@ -368,7 +449,7 @@ def prefill_forward(params: Params, spec: ModelSpec,
                     k_cache: jax.Array, v_cache: jax.Array,
                     tokens: jax.Array, positions: jax.Array,
                     page_table: jax.Array, seq_lens: jax.Array,
-                    sp_shard: bool = False,
+                    sp_shard: bool = False, ring_mesh=None,
                     ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Process prompt chunks and write K/V into pages.
 
@@ -405,7 +486,12 @@ def prefill_forward(params: Params, spec: ModelSpec,
         v = _split_heads(v, spec.num_kv_heads, d)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        attn = dense_causal_attention(q, k, v, positions, valid, spec.q_per_kv)
+        if ring_mesh is not None:
+            attn = ring_causal_attention(ring_mesh, q, k, v, positions,
+                                         valid, spec.q_per_kv)
+        else:
+            attn = dense_causal_attention(q, k, v, positions, valid,
+                                          spec.q_per_kv)
         attn = attn.reshape(b, s, -1)
         x = x + mm(attn, lp["wo"], "bsd,dh->bsh")
         h2 = rms_norm(x, lp["post_attn_norm"], spec.rms_norm_eps)

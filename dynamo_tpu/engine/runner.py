@@ -349,6 +349,16 @@ class ModelRunner:
                          and batch % cfg_pp == 0
                          and spec.num_layers % cfg_pp == 0)
             if with_history:
+                if sp_shard and self.config.ring_attention and \
+                        not getattr(self, "_ring_hist_warned", False):
+                    # History chunks (prompts longer than one prefill
+                    # bucket) read prior pages via the paged gather —
+                    # that path still uses the GSPMD all-gather, so ring
+                    # attention covers single-bucket prefills only.
+                    self._ring_hist_warned = True
+                    log.info("ring attention: history-chunk prefill uses "
+                             "the all-gather sp path (ring covers "
+                             "single-bucket prefills)")
                 logits, k_cache, v_cache = _prefill_with_history(
                     params, spec, k_cache, v_cache, tokens, positions,
                     page_table, seq_lens, hist_table, hist_lens,
@@ -362,7 +372,9 @@ class ModelRunner:
             else:
                 logits, k_cache, v_cache = prefill_forward(
                     params, spec, k_cache, v_cache, tokens, positions,
-                    page_table, seq_lens, sp_shard=sp_shard)
+                    page_table, seq_lens, sp_shard=sp_shard,
+                    ring_mesh=(self.mesh if sp_shard
+                               and self.config.ring_attention else None))
             if penalized:
                 freq = jax.lax.bitcast_convert_type(packed[:, 7],
                                                     jnp.float32)
