@@ -430,7 +430,10 @@ async def run(args: argparse.Namespace) -> None:
                 reasoning_parser=args.reasoning_parser,
                 runtime_config=ModelRuntimeConfig(
                     total_kv_blocks=engine.runner.num_pages,
-                    max_num_seqs=engine_cfg.max_num_seqs))
+                    max_num_seqs=engine_cfg.max_num_seqs,
+                    # The frontend's audio encoder projects to this width
+                    # (mm_embeds spans must match the model hidden size).
+                    extra={"hidden_size": engine_cfg.model.hidden_size}))
         engine.start()
         print(f"TPU_WORKER_READY mode={args.mode} port={server.port} "
               f"worker={runtime.instance_id:x} pages={engine.runner.num_pages}",

@@ -69,6 +69,12 @@ class _Request:
     # dispatch never allocates pages past it, so pipelined lookahead can't
     # demand pages a finishing request will never write.
     len_cap: int = 2**30
+    # Multimodal requests skip the prefix cache entirely: the placeholder
+    # ids under media spans would alias unrelated media in the
+    # content-hash space. mm_buf carries the parsed full-prompt
+    # (embeddings, mask) for the chunked path.
+    no_cache: bool = False
+    mm_buf: tuple | None = None
 
     def push(self, item) -> None:
         self.loop.call_soon_threadsafe(self.out_q.put_nowait, item)
@@ -248,7 +254,12 @@ class TPUEngine(AsyncEngine):
                      tokens_all=list(req.token_ids),
                      injected=(first_token, kv),
                      len_cap=len(req.token_ids)
-                     + (req.stop_conditions.max_tokens or 2**30))
+                     + (req.stop_conditions.max_tokens or 2**30),
+                     # The injected path never runs _plan_prefill, so the
+                     # multimodal no-cache flag must be set here: the
+                     # placeholder-id hash chain must not enter the
+                     # prefix cache pointing at media-conditioned KV.
+                     no_cache=bool(getattr(req, "mm_embeds", None)))
         self.waiting.put(r)
         self.num_waiting += 1
         while True:
@@ -307,8 +318,9 @@ class TPUEngine(AsyncEngine):
                 first_token = self._prefill_chunked_token(r)
             else:
                 first_token = int(self.runner.prefill_batch([plan])[0])
-            for idx, h in enumerate(r.blocks.block_hashes):
-                self.allocator.register(r.pages[idx], h)
+            if not r.no_cache:
+                for idx, h in enumerate(r.blocks.block_hashes):
+                    self.allocator.register(r.pages[idx], h)
             handle = self.runner.extract_pages_async(r.pages)
         finally:
             # The gather is dispatched: device-stream order guarantees it
@@ -771,6 +783,10 @@ class TPUEngine(AsyncEngine):
         prompt = r.tokens_all
         r.blocks = TokenBlockSequence(page, prompt)
         hashes = r.blocks.block_hashes
+        mm = getattr(r.req, "mm_embeds", None)
+        if mm:
+            r.no_cache = True
+            return self._plan_prefill_multimodal(r, mm)
         cached_pages = self.allocator.acquire_cached(hashes)
         reuse_tokens = len(cached_pages) * page
         if reuse_tokens >= len(prompt):
@@ -810,6 +826,53 @@ class TPUEngine(AsyncEngine):
             hist_pages=hist, sampling=self._sampling_of(r),
             logprobs=r.req.sampling_options.logprobs is not None,
             penalties=self._penalties_of(r), seed=self._seed_of(r))
+
+    def _plan_prefill_multimodal(self, r: _Request, mm: list[dict]):
+        """Plan a prompt with encoder-embedding spans (reference
+        multimodal processor role): no prefix reuse or onboarding
+        (placeholder ids under spans don't content-hash the media).
+        Prompts longer than one bucket take the chunked path — each chunk
+        carries its slice of the embedding buffer — so a preempted
+        multimodal request recomputes like any other. Returns a
+        PrefillSeq, "chunked", or None (no KV room)."""
+        cfg = self.config
+        page = cfg.page_size
+        prompt = r.tokens_all
+        n = len(prompt)
+        emb = np.zeros((n, self.runner.spec.hidden_size), np.float32)
+        mask = np.zeros((n,), bool)
+        for span in mm:
+            start = int(span["start"])
+            arr = np.frombuffer(span["b"], dtype=span.get(
+                "dtype", "float32")).reshape(span["shape"])
+            if start < 0 or start + arr.shape[0] > n:
+                raise ValueError(
+                    f"multimodal span [{start}, {start + arr.shape[0]}) "
+                    f"outside the {n}-token prompt")
+            if arr.shape[1] != emb.shape[1]:
+                raise ValueError(
+                    f"multimodal embedding width {arr.shape[1]} != model "
+                    f"hidden size {emb.shape[1]}")
+            emb[start:start + arr.shape[0]] = arr
+            mask[start:start + arr.shape[0]] = True
+        r.mm_buf = (emb, mask)
+        self.prefix_lookup_blocks += max(1, len(r.blocks.block_hashes))
+        total_pages = -(-n // page)
+        pages = self.allocator.allocate(total_pages)
+        if pages is None:
+            return None
+        r.pages = pages
+        r.reuse_tokens = 0
+        self._flush_spills()
+        if n > min(cfg.max_prefill_tokens, cfg.prefill_buckets[-1]):
+            return "chunked"
+        return PrefillSeq(
+            tokens=np.asarray(prompt, np.int32), start_pos=0,
+            chunk_pages=np.asarray(pages, np.int32), hist_pages=None,
+            sampling=self._sampling_of(r),
+            logprobs=r.req.sampling_options.logprobs is not None,
+            penalties=self._penalties_of(r), seed=self._seed_of(r),
+            embeds=emb, embeds_mask=mask)
 
     def _prefill_chunked(self, r: _Request, slot: int) -> None:
         """Long prompt: prefill in page-aligned chunks with history."""
@@ -857,13 +920,20 @@ class TPUEngine(AsyncEngine):
             # for them.
             final = start + n >= len(prompt)
             pen = self._penalties_of(r)
+            emb = emb_mask = None
+            if r.mm_buf is not None:
+                full_emb, full_mask = r.mm_buf
+                sl = full_mask[start:start + n]
+                if sl.any():
+                    emb, emb_mask = full_emb[start:start + n], sl
             token, _ = self.runner.prefill(
                 chunk_tokens, start, chunk_pages,
                 hist if len(hist) else None, self._sampling_of(r),
                 penalties=pen,
                 count_row=self._count_row_of(r)
                 if final and any(pen) else None,
-                seed=self._seed_of(r) if final else None)
+                seed=self._seed_of(r) if final else None,
+                embeds=emb, embeds_mask=emb_mask)
             start += n
             if start >= len(prompt):
                 first_token = token
@@ -906,8 +976,9 @@ class TPUEngine(AsyncEngine):
         it with no override; the host value is emitted when the async
         fetch resolves (_resolve_first)."""
         prompt_len = len(r.tokens_all)
-        for idx, h in enumerate(r.blocks.block_hashes):
-            self.allocator.register(r.pages[idx], h)
+        if not r.no_cache:
+            for idx, h in enumerate(r.blocks.block_hashes):
+                self.allocator.register(r.pages[idx], h)
         r.slot = slot
         r.epoch += 1
         r.last_token = None
@@ -926,9 +997,11 @@ class TPUEngine(AsyncEngine):
                        lp_out: tuple[list, list] | None = None) -> None:
         prompt_len = len(r.tokens_all)
         # The prompt's complete blocks are now resident: register them for
-        # prefix reuse + router events.
-        for idx, h in enumerate(r.blocks.block_hashes):
-            self.allocator.register(r.pages[idx], h)
+        # prefix reuse + router events (multimodal requests skip the
+        # cache: placeholder ids don't content-hash the media).
+        if not r.no_cache:
+            for idx, h in enumerate(r.blocks.block_hashes):
+                self.allocator.register(r.pages[idx], h)
         r.generated += 1
         finish = self._check_finish(r, first_token)
         self._emit(r, [first_token], finish, lp_out)
@@ -1142,7 +1215,7 @@ class TPUEngine(AsyncEngine):
                 token = int(toks[m, i])
                 r.generated += 1
                 new_block = r.blocks.append(inp)
-                if new_block is not None:
+                if new_block is not None and not r.no_cache:
                     # Register the just-completed page under its chained hash.
                     page_idx = (len(r.blocks.tokens) // page) - 1
                     self.allocator.register(r.pages[page_idx], new_block)

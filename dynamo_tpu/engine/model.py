@@ -450,6 +450,8 @@ def prefill_forward(params: Params, spec: ModelSpec,
                     tokens: jax.Array, positions: jax.Array,
                     page_table: jax.Array, seq_lens: jax.Array,
                     sp_shard: bool = False, ring_mesh=None,
+                    x_embeds: jax.Array | None = None,
+                    embeds_mask: jax.Array | None = None,
                     ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Process prompt chunks and write K/V into pages.
 
@@ -467,6 +469,11 @@ def prefill_forward(params: Params, spec: ModelSpec,
     d = spec.head_dim
     page = k_cache.shape[3]
     x = embed_lookup(params["embed"], tokens)  # [B,S,H]
+    if x_embeds is not None:
+        # Multimodal spans: encoder-produced embeddings replace the token
+        # table's rows wherever the mask is set (the placeholder ids
+        # under the span never reach the model).
+        x = jnp.where(embeds_mask[..., None], x_embeds.astype(x.dtype), x)
     if sp_shard:
         x = jax.lax.with_sharding_constraint(x, P(None, "sp", None))
     cos, sin = rope_tables(positions, d, spec.rope_theta)

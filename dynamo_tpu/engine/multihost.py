@@ -103,7 +103,9 @@ def _pack_seq(s) -> dict:
                          float(s.sampling[2])],
             "logprobs": bool(s.logprobs),
             "penalties": [float(s.penalties[0]), float(s.penalties[1])],
-            "seed": None if s.seed is None else int(s.seed)}
+            "seed": None if s.seed is None else int(s.seed),
+            "embeds": _pack_array(s.embeds),
+            "embeds_mask": _pack_array(s.embeds_mask)}
 
 
 def _unpack_seq(d: dict):
@@ -117,7 +119,9 @@ def _unpack_seq(d: dict):
                       sampling=(float(t), int(k), float(p)),
                       logprobs=d["logprobs"],
                       penalties=(float(fp), float(pp)),
-                      seed=d.get("seed"))
+                      seed=d.get("seed"),
+                      embeds=_unpack_array(d.get("embeds")),
+                      embeds_mask=_unpack_array(d.get("embeds_mask")))
 
 
 class LeaderRunner:
@@ -180,17 +184,19 @@ class LeaderRunner:
         return self._inner.set_count_rows(slots, rows)
 
     def prefill(self, tokens, start_pos, chunk_pages, hist_pages, sampling,
-                penalties=(0.0, 0.0), count_row=None, seed=None):
+                penalties=(0.0, 0.0), count_row=None, seed=None,
+                embeds=None, embeds_mask=None):
         from dynamo_tpu.engine.runner import PrefillSeq
         self._publish({"m": "prefill", "seq": _pack_seq(PrefillSeq(
             tokens=np.asarray(tokens, np.int32), start_pos=start_pos,
             chunk_pages=np.asarray(chunk_pages, np.int32),
             hist_pages=hist_pages, sampling=sampling,
-            penalties=penalties, seed=seed)),
+            penalties=penalties, seed=seed,
+            embeds=embeds, embeds_mask=embeds_mask)),
             "count_row": _pack_array(count_row)})
         return self._inner.prefill(tokens, start_pos, chunk_pages,
                                    hist_pages, sampling, penalties,
-                                   count_row, seed)
+                                   count_row, seed, embeds, embeds_mask)
 
     def decode_window(self, packed: np.ndarray, window: int):
         self._publish({"m": "decode_window", "packed": _pack_array(packed),
@@ -301,7 +307,7 @@ async def run_follower(config, client, group: str, node_rank: int,
                     runner.prefill(s.tokens, s.start_pos, s.chunk_pages,
                                    s.hist_pages, s.sampling, s.penalties,
                                    _unpack_array(msg.get("count_row")),
-                                   s.seed)
+                                   s.seed, s.embeds, s.embeds_mask)
                 elif m == "decode_window":
                     runner.decode_window(_unpack_array(msg["packed"]),
                                          msg["window"])

@@ -103,6 +103,11 @@ class PrefillSeq:
     logprobs: bool = False      # row wants first-token logprobs
     penalties: tuple[float, float] = (0.0, 0.0)  # (frequency, presence)
     seed: int | None = None     # per-request sampling seed
+    # Multimodal: encoder embeddings [n, H] + bool mask [n] (n =
+    # len(tokens)): where the mask is set, the embedding row replaces the
+    # token table's row (the token id there is a placeholder).
+    embeds: np.ndarray | None = None
+    embeds_mask: np.ndarray | None = None
 
 
 def _mh_put(value, sharding):
@@ -308,8 +313,9 @@ class ModelRunner:
 
     # -- compiled steps -------------------------------------------------------
     def _get_prefill(self, bucket: int, batch: int, with_history: bool,
-                     penalized: bool = False, seeded: bool = False):
-        key = (bucket, batch, with_history, penalized, seeded)
+                     penalized: bool = False, seeded: bool = False,
+                     with_embeds: bool = False):
+        key = (bucket, batch, with_history, penalized, seeded, with_embeds)
         fn = self._prefill_cache.get(key)
         if fn is not None:
             return fn
@@ -325,8 +331,11 @@ class ModelRunner:
         # ptab[bucket_pages], htab[maxp if with_history].
         # The penalized variant (preemption-recompute of a penalized
         # request) additionally reads prior-generation counts so even the
-        # re-sampled token respects the penalties.
-        def step(params, k_cache, v_cache, packed, rng, counts=None):
+        # re-sampled token respects the penalties. The embeds variant
+        # (multimodal prompts) takes encoder embeddings + a mask that
+        # override the token table under media spans.
+        def step(params, k_cache, v_cache, packed, rng, counts=None,
+                 emb=None, emb_mask=None):
             start = packed[:, 0]
             n = packed[:, 1]
             hist_lens = packed[:, 2]
@@ -346,6 +355,7 @@ class ModelRunner:
             cfg_pp = self.config.pp
             pipelined = (not with_history and cfg_pp > 1
                          and self.config.pp_microbatch and not sp_shard
+                         and not with_embeds
                          and batch % cfg_pp == 0
                          and spec.num_layers % cfg_pp == 0)
             if with_history:
@@ -374,7 +384,8 @@ class ModelRunner:
                     params, spec, k_cache, v_cache, tokens, positions,
                     page_table, seq_lens, sp_shard=sp_shard,
                     ring_mesh=(self.mesh if sp_shard
-                               and self.config.ring_attention else None))
+                               and self.config.ring_attention else None),
+                    x_embeds=emb, embeds_mask=emb_mask)
             if penalized:
                 freq = jax.lax.bitcast_convert_type(packed[:, 7],
                                                     jnp.float32)
@@ -632,7 +643,27 @@ class ModelRunner:
                 packed[i, 2] = s.start_pos
         penalized = count_rows is not None
         seeded = any(s.seed is not None for s in seqs)
-        fn = self._get_prefill(bucket, bp, with_history, penalized, seeded)
+        with_embeds = any(s.embeds is not None for s in seqs)
+        if with_embeds and with_history:
+            raise ValueError(
+                "a multimodal span crosses a prefill-chunk boundary "
+                "(embedding injection supports history-free chunks); "
+                "size prefill_buckets so media spans fit one chunk")
+        kw = {}
+        if with_embeds:
+            import ml_dtypes
+            emb = np.zeros((bp, bucket, self.spec.hidden_size),
+                           ml_dtypes.bfloat16)
+            emb_mask = np.zeros((bp, bucket), bool)
+            for i, s in enumerate(seqs):
+                if s.embeds is None:
+                    continue
+                n_row = len(s.tokens)
+                emb[i, :n_row] = s.embeds.astype(ml_dtypes.bfloat16)
+                emb_mask[i, :n_row] = s.embeds_mask
+            kw = {"emb": jnp.asarray(emb), "emb_mask": jnp.asarray(emb_mask)}
+        fn = self._get_prefill(bucket, bp, with_history, penalized, seeded,
+                               with_embeds)
         with self.mesh:
             if penalized:
                 rows = np.asarray(count_rows, np.uint8)
@@ -643,12 +674,12 @@ class ModelRunner:
                 (sampled, lp, top_v, top_i, logits, self.k_cache,
                  self.v_cache, self._rng) = fn(
                     self.params, self.k_cache, self.v_cache,
-                    jnp.asarray(packed), self._rng, jnp.asarray(rows))
+                    jnp.asarray(packed), self._rng, jnp.asarray(rows), **kw)
             else:
                 (sampled, lp, top_v, top_i, logits, self.k_cache,
                  self.v_cache, self._rng) = fn(
                     self.params, self.k_cache, self.v_cache,
-                    jnp.asarray(packed), self._rng)
+                    jnp.asarray(packed), self._rng, **kw)
         # Device handle (no transfer unless a caller converts it).
         self.last_prefill_logits = logits
         if slots is not None:
@@ -681,14 +712,18 @@ class ModelRunner:
                 sampling: tuple[float, int, float],
                 penalties: tuple[float, float] = (0.0, 0.0),
                 count_row: np.ndarray | None = None,
-                seed: int | None = None) -> tuple[int, jax.Array]:
+                seed: int | None = None,
+                embeds: np.ndarray | None = None,
+                embeds_mask: np.ndarray | None = None
+                ) -> tuple[int, jax.Array]:
         """Single-sequence prefill chunk; returns (sampled_token,
         last-position logits [1,V])."""
         seq = PrefillSeq(tokens=np.asarray(tokens, np.int32),
                          start_pos=start_pos,
                          chunk_pages=np.asarray(chunk_pages, np.int32),
                          hist_pages=hist_pages, sampling=sampling,
-                         penalties=penalties, seed=seed)
+                         penalties=penalties, seed=seed,
+                         embeds=embeds, embeds_mask=embeds_mask)
         token = int(self.prefill_batch(
             [seq], count_rows=None if count_row is None
             else count_row[None])[0])

@@ -1,0 +1,258 @@
+"""Audio modality: WAV -> log-mel features -> encoder -> prompt embeddings.
+
+The reference serves multimodal through per-engine processors
+(components/backends/trtllm multimodal processor; examples/multimodal):
+media is encoded OUTSIDE the LLM and injected as prompt embeddings. This
+module is the TPU-native audio half of that contract:
+
+- :func:`decode_wav` / :func:`log_mel_spectrogram` — stdlib/numpy
+  feature extraction (16 kHz mono, 25 ms windows, 10 ms hop, 80 mels —
+  the Whisper-style front end).
+- :class:`AudioEncoder` — a small JAX conv-downsample + transformer
+  encoder projecting frames to the target LLM's hidden size. Weights
+  load from a safetensors file when provided, else deterministic random
+  init (the serving PATH is what's exercised end to end; swapping in
+  trained weights is a checkpoint question, not a code path question).
+- :func:`embed_audio` — one call: wav bytes -> {"start", "b", "dtype",
+  "shape"} span dict for ``PreprocessedRequest.mm_embeds``.
+
+The engine side (prompt-embedding injection, placeholder ids, no-cache
+handling) lives in engine/runner.py + engine/engine.py; the HTTP side
+(/v1/audio/transcriptions) in llm/http_service.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import wave
+
+import numpy as np
+
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("audio")
+
+SAMPLE_RATE = 16000
+N_FFT = 400        # 25 ms @ 16 kHz
+HOP = 160          # 10 ms
+N_MELS = 80
+
+
+def decode_wav(data: bytes) -> np.ndarray:
+    """PCM WAV bytes -> float32 mono [-1, 1] at the file's rate, then
+    naive-resampled to 16 kHz (linear interpolation — serving front
+    ends resample upstream; this keeps the path dependency-free)."""
+    with wave.open(io.BytesIO(data)) as wf:
+        n = wf.getnframes()
+        raw = wf.readframes(n)
+        width = wf.getsampwidth()
+        channels = wf.getnchannels()
+        rate = wf.getframerate()
+    if width == 2:
+        audio = np.frombuffer(raw, np.int16).astype(np.float32) / 32768.0
+    elif width == 1:
+        audio = (np.frombuffer(raw, np.uint8).astype(np.float32) - 128) / 128
+    elif width == 4:
+        audio = np.frombuffer(raw, np.int32).astype(np.float32) / 2**31
+    else:
+        raise ValueError(f"unsupported WAV sample width {width}")
+    if channels > 1:
+        audio = audio.reshape(-1, channels).mean(axis=1)
+    if rate != SAMPLE_RATE:
+        t_out = np.arange(int(len(audio) * SAMPLE_RATE / rate)) \
+            * (rate / SAMPLE_RATE)
+        audio = np.interp(t_out, np.arange(len(audio)), audio) \
+            .astype(np.float32)
+    return audio
+
+
+def _mel_filterbank(n_mels: int, n_fft: int, sr: int) -> np.ndarray:
+    """Triangular mel filters [n_mels, n_fft//2 + 1] (HTK mel scale)."""
+    def hz_to_mel(f):
+        return 2595.0 * np.log10(1.0 + f / 700.0)
+
+    def mel_to_hz(m):
+        return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+
+    mel_pts = np.linspace(hz_to_mel(0.0), hz_to_mel(sr / 2), n_mels + 2)
+    hz_pts = mel_to_hz(mel_pts)
+    bins = np.floor((n_fft + 1) * hz_pts / sr).astype(int)
+    fb = np.zeros((n_mels, n_fft // 2 + 1), np.float32)
+    for m in range(1, n_mels + 1):
+        lo, c, hi = bins[m - 1], bins[m], bins[m + 1]
+        for k in range(lo, c):
+            if c > lo:
+                fb[m - 1, k] = (k - lo) / (c - lo)
+        for k in range(c, hi):
+            if hi > c:
+                fb[m - 1, k] = (hi - k) / (hi - c)
+    return fb
+
+
+_FB_CACHE: dict = {}
+
+
+def log_mel_spectrogram(audio: np.ndarray) -> np.ndarray:
+    """float32 mono 16 kHz -> log-mel frames [T, N_MELS]."""
+    if len(audio) < N_FFT:
+        audio = np.pad(audio, (0, N_FFT - len(audio)))
+    n_frames = 1 + (len(audio) - N_FFT) // HOP
+    window = np.hanning(N_FFT).astype(np.float32)
+    frames = np.lib.stride_tricks.as_strided(
+        audio, shape=(n_frames, N_FFT),
+        strides=(audio.strides[0] * HOP, audio.strides[0]))
+    spec = np.abs(np.fft.rfft(frames * window, axis=1)) ** 2
+    key = (N_MELS, N_FFT, SAMPLE_RATE)
+    if key not in _FB_CACHE:
+        _FB_CACHE[key] = _mel_filterbank(*key)
+    mel = spec @ _FB_CACHE[key].T
+    logmel = np.log10(np.maximum(mel, 1e-10))
+    return np.maximum(logmel, logmel.max() - 8.0).astype(np.float32)
+
+
+@dataclasses.dataclass
+class AudioEncoderSpec:
+    n_mels: int = N_MELS
+    d_model: int = 256
+    num_layers: int = 2
+    num_heads: int = 4
+    downsample: int = 4  # frames per output embedding (2 conv stride-2)
+
+
+class AudioEncoder:
+    """Conv-downsample + transformer encoder -> LLM hidden size.
+
+    Two stride-2 1D convs (4x frame downsample: 80 mel frames/s ->
+    20 embeddings/s), ``num_layers`` pre-norm self-attention blocks with
+    sinusoidal positions, and a linear projection to ``llm_hidden``.
+    Pure-functional JAX, jit-compiled per input-length bucket."""
+
+    def __init__(self, llm_hidden: int,
+                 spec: AudioEncoderSpec | None = None,
+                 weights_path: str | None = None, seed: int = 0):
+        import jax
+
+        self.spec = spec or AudioEncoderSpec()
+        self.llm_hidden = llm_hidden
+        if weights_path:
+            self.params = self._load(weights_path)
+        else:
+            self.params = self._init(jax.random.key(seed))
+        # jax.jit caches compilations per input shape itself; one wrapper
+        # serves every length bucket.
+        import jax as _jax
+
+        self._fn = _jax.jit(self._forward)
+
+    def _init(self, key):
+        import jax
+        import jax.numpy as jnp
+
+        s = self.spec
+        d = s.d_model
+        keys = iter(jax.random.split(key, 8 + 4 * s.num_layers))
+
+        def lin(k, i, o):
+            return (jax.random.normal(k, (i, o), jnp.float32)
+                    / np.sqrt(i)).astype(jnp.bfloat16)
+
+        params = {
+            "conv1": lin(next(keys), 3 * s.n_mels, d),   # kernel 3, stride 2
+            "conv2": lin(next(keys), 3 * d, d),
+            "proj": lin(next(keys), d, self.llm_hidden),
+            "layers": [],
+        }
+        for _ in range(s.num_layers):
+            params["layers"].append({
+                "wq": lin(next(keys), d, d), "wk": lin(next(keys), d, d),
+                "wv": lin(next(keys), d, d), "wo": lin(next(keys), d, d),
+                "w1": lin(next(keys), d, 4 * d),
+                "w2": lin(next(keys), 4 * d, d),
+            })
+        return params
+
+    def _load(self, path: str):
+        from safetensors import safe_open
+        import ml_dtypes
+
+        with safe_open(path, framework="numpy") as fh:
+            flat = {k: fh.get_tensor(k).astype(ml_dtypes.bfloat16)
+                    for k in fh.keys()}
+        params = {"conv1": flat["conv1"], "conv2": flat["conv2"],
+                  "proj": flat["proj"], "layers": []}
+        i = 0
+        while f"layers.{i}.wq" in flat:
+            params["layers"].append(
+                {k: flat[f"layers.{i}.{k}"]
+                 for k in ("wq", "wk", "wv", "wo", "w1", "w2")})
+            i += 1
+        return params
+
+    def _forward(self, params, mel):
+        import jax
+        import jax.numpy as jnp
+
+        s = self.spec
+        d = s.d_model
+
+        def conv_s2(x, w, cin):
+            # kernel-3 stride-2 conv as a strided window matmul.
+            t = x.shape[0] // 2
+            xp = jnp.pad(x, ((1, 1), (0, 0)))
+            win = jnp.stack([xp[0:2 * t:2], xp[1:2 * t + 1:2],
+                             xp[2:2 * t + 2:2]], axis=1)  # [t, 3, cin]
+            return jax.nn.gelu(win.reshape(t, 3 * cin) @ w)
+
+        x = conv_s2(mel.astype(jnp.bfloat16), params["conv1"], s.n_mels)
+        x = conv_s2(x, params["conv2"], d)
+        t = x.shape[0]
+        pos = jnp.arange(t)[:, None] / (10000 ** (
+            jnp.arange(d)[None, :] / d))
+        x = x + jnp.where(jnp.arange(d)[None, :] % 2 == 0,
+                          jnp.sin(pos), jnp.cos(pos)).astype(jnp.bfloat16)
+
+        def norm(h):
+            hf = h.astype(jnp.float32)
+            var = jnp.mean(hf * hf, axis=-1, keepdims=True)
+            return (hf * jax.lax.rsqrt(var + 1e-5)).astype(h.dtype)
+
+        nh = s.num_heads
+        hd = d // nh
+        for lp in params["layers"]:
+            h = norm(x)
+            q = (h @ lp["wq"]).reshape(t, nh, hd)
+            k = (h @ lp["wk"]).reshape(t, nh, hd)
+            v = (h @ lp["wv"]).reshape(t, nh, hd)
+            scores = jnp.einsum("qnd,knd->nqk", q, k,
+                                preferred_element_type=jnp.float32)
+            probs = jax.nn.softmax(scores / np.sqrt(hd), axis=-1) \
+                .astype(jnp.bfloat16)
+            attn = jnp.einsum("nqk,knd->qnd", probs, v).reshape(t, d)
+            x = x + attn @ lp["wo"]
+            x = x + jax.nn.gelu(norm(x) @ lp["w1"]) @ lp["w2"]
+        return (norm(x) @ params["proj"]).astype(jnp.float32)
+
+    def encode(self, mel: np.ndarray) -> np.ndarray:
+        """log-mel [T, n_mels] -> embeddings [T // downsample, llm_hidden]
+        (length-bucketed compile cache; pad frames are trimmed)."""
+        import jax
+        import jax.numpy as jnp
+
+        t = mel.shape[0]
+        bucket = 64
+        while bucket < t:
+            bucket *= 2
+        padded = np.zeros((bucket, mel.shape[1]), np.float32)
+        padded[:t] = mel
+        out = np.asarray(self._fn(self.params, jnp.asarray(padded)))
+        return out[:max(1, t // self.spec.downsample)]
+
+
+def embed_audio(wav_bytes: bytes, encoder: AudioEncoder,
+                start: int = 0) -> tuple[dict, int]:
+    """WAV bytes -> (mm_embeds span dict at ``start``, span length)."""
+    mel = log_mel_spectrogram(decode_wav(wav_bytes))
+    emb = encoder.encode(mel)
+    return ({"start": start, "b": emb.astype(np.float32).tobytes(),
+             "dtype": "float32", "shape": list(emb.shape)}, emb.shape[0])

@@ -90,6 +90,7 @@ class HttpService:
         app.router.add_post("/v1/chat/completions", self._chat)
         app.router.add_post("/v1/completions", self._completion)
         app.router.add_post("/v1/embeddings", self._embeddings)
+        app.router.add_post("/v1/audio/transcriptions", self._transcriptions)
         app.router.add_post("/v1/responses", self._responses)
         app.router.add_get("/v1/models", self._models)
         app.router.add_post("/clear_kv_blocks", self._clear_kv_blocks)
@@ -326,6 +327,98 @@ class HttpService:
             log.exception("embeddings handler failed")
             self._m_requests.inc(route=route, status="500")
             return _error_body(f"internal error: {exc}", "internal_error", 500)
+        finally:
+            self._m_inflight.dec(route=route)
+            self._m_duration.observe(time.monotonic() - started, route=route)
+
+    async def _transcriptions(self, request: web.Request) -> web.Response:
+        """OpenAI /v1/audio/transcriptions: WAV in (base64 ``file`` field;
+        multipart upstreams decode before us), text out. The audio runs
+        through the mel front end + audio encoder (llm/audio.py) and
+        reaches the LLM as prompt-embedding spans (mm_embeds) — the
+        reference's multimodal-processor contract
+        (components/backends/trtllm multimodal), audio-first here."""
+        route = "audio_transcriptions"
+        started = time.monotonic()
+        self._m_inflight.inc(route=route)
+        try:
+            import base64
+
+            from dynamo_tpu.llm.audio import AudioEncoder, embed_audio
+            from dynamo_tpu.llm.protocols import PreprocessedRequest
+            try:
+                body = await request.json()
+                model = body["model"]
+                wav = base64.b64decode(body["file"])
+                max_tokens = int(body.get("max_tokens", 256))
+                temperature = float(body.get("temperature", 0.0))
+            except (json.JSONDecodeError, KeyError, ValueError,
+                    TypeError) as exc:
+                self._m_requests.inc(route=route, status="400")
+                return _error_body(f"need 'model' and base64 'file' "
+                                   f"(+ numeric options): {exc}")
+            served = self.manager.get(model)
+            if served is None:
+                self._m_requests.inc(route=route, status="404")
+                return _error_body(f"model {model!r} not found",
+                                   "model_not_found", 404)
+            # The encoder projects to the LLM's hidden size, published in
+            # the card's runtime extras (in-process engines expose it
+            # directly).
+            hidden = (served.entry.card.runtime_config.extra or {}) \
+                .get("hidden_size")
+            if hidden is None and served.client is None:
+                hidden = served.preprocessor.inner.inner.runner.spec \
+                    .hidden_size
+            if hidden is None:
+                self._m_requests.inc(route=route, status="400")
+                return _error_body(
+                    f"model {model!r} did not publish hidden_size; "
+                    "audio input needs an embedding-capable worker")
+            cache = getattr(self, "_audio_encoders", None)
+            if cache is None:
+                cache = self._audio_encoders = {}
+            encoder = cache.get((model, hidden))
+            if encoder is None:
+                encoder = cache[(model, hidden)] = AudioEncoder(hidden)
+            span, n_audio = embed_audio(wav, encoder)
+            tokenizer = served.preprocessor.tokenizer
+            prompt_tokens = tokenizer.encode(
+                body.get("prompt") or "Transcribe the audio.")
+            req = PreprocessedRequest(
+                model=model, token_ids=[0] * n_audio + prompt_tokens,
+                mm_embeds=[span])
+            req.stop_conditions.max_tokens = max_tokens
+            req.sampling_options.temperature = temperature
+            req.eos_token_ids = tokenizer.eos_token_ids()
+            ctx = self._make_context(request)
+            toks: list[int] = []
+            try:
+                if served.client is None:
+                    engine = served.preprocessor.inner.inner
+                    stream = engine.generate(req, ctx)
+                else:
+                    stream = await served.client.round_robin(
+                        req.to_wire(), context=ctx)
+                async for out in stream:
+                    toks.extend(out.get("token_ids", []))
+                    if out.get("finish_reason"):
+                        break
+            except NoInstancesError as exc:
+                self._m_requests.inc(route=route, status="503")
+                return _error_body(str(exc), "service_unavailable", 503)
+            self._m_requests.inc(route=route, status="200")
+            return web.json_response({
+                "text": tokenizer.decode(toks),
+                "usage": {"input_tokens": len(req.token_ids),
+                          "output_tokens": len(toks),
+                          "audio_tokens": n_audio},
+            })
+        except Exception as exc:  # noqa: BLE001
+            log.exception("transcriptions handler failed")
+            self._m_requests.inc(route=route, status="500")
+            return _error_body(f"internal error: {exc}", "internal_error",
+                               500)
         finally:
             self._m_inflight.dec(route=route)
             self._m_duration.observe(time.monotonic() - started, route=route)

@@ -72,6 +72,13 @@ class PreprocessedRequest(BaseModel):
     disagg_params: dict[str, Any] | None = None
     # Router-estimated prefix-cache overlap, for engine scheduling.
     estimated_prefix_hit_blocks: int = 0
+    # Multimodal prompt embeddings (the reference's multimodal processor
+    # role, components/backends/trtllm multimodal): spans of token_ids
+    # whose embeddings come from a modality encoder instead of the token
+    # table. Each: {"start": int, "b": bytes, "dtype": str,
+    # "shape": [n, hidden]} — the placeholder token ids under a span are
+    # ignored by the forward pass.
+    mm_embeds: list[dict] | None = None
 
     def to_wire(self) -> dict:
         return self.model_dump(exclude_none=True)
