@@ -203,6 +203,20 @@ class LeaderRunner:
                        "window": int(window)})
         return self._inner.decode_window(packed, window)
 
+    def decode_spec_window(self, packed: np.ndarray, m_outer: int, k: int):
+        self._publish({"m": "decode_spec_window",
+                       "packed": _pack_array(packed),
+                       "m_outer": int(m_outer), "k": int(k)})
+        return self._inner.decode_spec_window(packed, m_outer, k)
+
+    def seed_history(self, entries):
+        self._publish({"m": "seed_history", "entries": [
+            [int(slot), _pack_array(np.asarray(toks, np.int32)),
+             int(start), bool(final),
+             (None if ftok is None else int(ftok))]
+            for slot, toks, start, final, ftok in entries]})
+        return self._inner.seed_history(entries)
+
     def embed(self, token_lists, pooling: str = "last"):
         self._publish({"m": "embed",
                        "token_lists": [[int(t) for t in row]
@@ -311,6 +325,14 @@ async def run_follower(config, client, group: str, node_rank: int,
                 elif m == "decode_window":
                     runner.decode_window(_unpack_array(msg["packed"]),
                                          msg["window"])
+                elif m == "decode_spec_window":
+                    runner.decode_spec_window(_unpack_array(msg["packed"]),
+                                              msg["m_outer"], msg["k"])
+                elif m == "seed_history":
+                    runner.seed_history([
+                        (slot, _unpack_array(toks), start, final, ftok)
+                        for slot, toks, start, final, ftok
+                        in msg["entries"]])
                 elif m == "embed":
                     runner.embed(msg["token_lists"], msg["pooling"])
                 elif m == "extract_pages":

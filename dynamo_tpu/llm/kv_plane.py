@@ -99,10 +99,22 @@ def _send_bulk(sock: socket.socket, arr: np.ndarray) -> None:
         sock.sendall(data[off:off + _SEND_CHUNK])
 
 
-def _recv_bulk_into(sock: socket.socket, buf: memoryview) -> None:
+def _recv_bulk_into(sock: socket.socket, buf: memoryview,
+                    deadline: float | None = None) -> None:
+    """Fill ``buf`` from the socket. ``deadline`` (time.monotonic value)
+    bounds the WHOLE payload, not just each recv: per-recv timeouts
+    reset on every arriving segment, so a trickling peer could stretch a
+    multi-MB transfer arbitrarily while never tripping them (the G4
+    consult's engine-thread budget must be a hard wall clock)."""
     got = 0
     n = len(buf)
     while got < n:
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise socket.timeout(
+                    f"bulk recv deadline exceeded ({got}/{n} bytes)")
+            sock.settimeout(remaining)
         r = sock.recv_into(buf[got:], n - got)
         if r == 0:
             raise ConnectionError("peer closed mid-payload")
@@ -160,14 +172,21 @@ def _get_jax_server():
 
 
 class _Staged:
-    __slots__ = ("meta", "payload", "resolve", "t", "jax_uuid")
+    __slots__ = ("meta", "payload", "resolve", "t", "jax_uuid", "groups")
 
-    def __init__(self, meta: dict, payload, resolve, jax_uuid):
+    def __init__(self, meta: dict, payload, resolve, jax_uuid,
+                 groups=None):
         self.meta = meta
         self.payload = payload      # np.ndarray once resolved
         self.resolve = resolve      # () -> np.ndarray, or None
         self.t = time.monotonic()
         self.jax_uuid = jax_uuid
+        # Pipelined socket path: [(n_pages, () -> np.ndarray), ...] —
+        # page-group resolvers whose D2H copies were dispatched together
+        # at extract time, so sending group i overlaps group i+1's copy
+        # (the extract leg is ~97% of the transfer tax on a tunneled
+        # chip; reference offload.rs MAX_CONCURRENT_TRANSFERS overlap).
+        self.groups = groups
 
     def array(self) -> np.ndarray:
         if self.payload is None:
@@ -253,11 +272,14 @@ class KvPlaneServer:
     # -- staging ------------------------------------------------------------
     def stage(self, kv=None, meta: dict | None = None,
               resolve: Callable[[], np.ndarray] | None = None,
-              device_array=None, prompt_len: int | None = None) -> dict:
+              device_array=None, prompt_len: int | None = None,
+              resolve_groups: list | None = None) -> dict:
         """Stage a parcel; returns the transfer ticket to send over the
-        (small) response stream. Either ``kv`` (host array) or ``resolve``
+        (small) response stream. Either ``kv`` (host array), ``resolve``
         (deferred host fetch — lets the D2H copy overlap decode windows;
-        resolved on the plane thread at pull time) must be given.
+        resolved on the plane thread at pull time), or ``resolve_groups``
+        ([(n_pages, resolver)] page groups streamed pipelined: group i's
+        socket send overlaps group i+1's D2H) must be given.
         ``device_array`` additionally registers the parcel with the jax
         transfer server for a zero-host-copy pull when both ends support
         it."""
@@ -280,7 +302,8 @@ class KvPlaneServer:
                 except Exception:  # noqa: BLE001 — fall back to socket
                     log.exception("jax-path staging failed; socket only")
                     jax_uuid = None
-            self._staged[tid] = _Staged(meta, kv, resolve, jax_uuid)
+            self._staged[tid] = _Staged(meta, kv, resolve, jax_uuid,
+                                        groups=resolve_groups)
             self._gc_locked()
         ticket = {"id": tid, "addr": self.address, **meta}
         if jax_uuid is not None:
@@ -338,10 +361,38 @@ class KvPlaneServer:
                 pass
 
     def _handle_pull(self, conn: socket.socket, req: dict) -> None:
+        tid = int(req["id"])
         with self._lock:
-            staged = self._staged.pop(int(req["id"]), None)
+            staged = self._staged.get(tid)
         if staged is None:
             _send_ctrl(conn, {"err": "unknown or expired transfer id"})
+            return
+        # The entry stays staged until the bulk send COMPLETES: a
+        # transient network failure mid-send would otherwise drop the
+        # parcel permanently and force the sink to re-prefill locally
+        # (its retry would see "expired transfer id"). The TTL GC
+        # remains the backstop for sinks that never come back.
+        if staged.groups is not None:
+            # Pipelined page groups: group i rides the wire while group
+            # i+1's D2H copy (dispatched at extract time) completes.
+            try:
+                first = np.ascontiguousarray(staged.groups[0][1]())
+            except Exception as exc:  # noqa: BLE001
+                log.exception("staged KV group resolve failed")
+                _send_ctrl(conn, {"err": f"resolve failed: {exc}"})
+                return
+            _send_ctrl(conn, {"ok": True, **staged.meta,
+                              "groups": [n for n, _ in staged.groups]})
+            sent = first.nbytes
+            _send_bulk(conn, first)
+            for _, resolver in staged.groups[1:]:
+                arr = np.ascontiguousarray(resolver())
+                _send_bulk(conn, arr)
+                sent += arr.nbytes
+            with self._lock:
+                self._staged.pop(tid, None)
+            self.transfers += 1
+            self.bytes_out += sent
             return
         try:
             arr = np.ascontiguousarray(staged.array())
@@ -351,6 +402,8 @@ class KvPlaneServer:
             return
         _send_ctrl(conn, {"ok": True, **staged.meta})
         _send_bulk(conn, arr)
+        with self._lock:
+            self._staged.pop(tid, None)
         self.transfers += 1
         self.bytes_out += arr.nbytes
 
@@ -477,6 +530,23 @@ class KvPlaneClient:
                     raise ConnectionError(f"KV pull refused: {resp['err']}")
                 shape = resp["shape"]
                 dt = dtype_of(resp["dtype"])
+                if "groups" in resp:
+                    # Pipelined page groups along the pages axis (3):
+                    # reassemble into the full parcel as they arrive.
+                    full = np.empty(shape, dt)
+                    off = 0
+                    for g in resp["groups"]:
+                        gshape = list(shape)
+                        gshape[3] = g
+                        buf = np.empty(
+                            int(np.prod(gshape)) * dt.itemsize, np.uint8)
+                        _recv_bulk_into(sock, memoryview(buf))
+                        full[:, :, :, off:off + g] = \
+                            buf.view(dt).reshape(gshape)
+                        off += g
+                    self.transfers += 1
+                    self.bytes_in += full.nbytes
+                    return full
                 buf = np.empty(int(resp["nbytes"]), np.uint8)
                 _recv_bulk_into(sock, memoryview(buf))
         except (ConnectionError, OSError):
@@ -487,13 +557,20 @@ class KvPlaneClient:
         return buf.view(dt).reshape(shape)
 
     def fetch_blocks_sync(self, addr: str, hashes: list[int],
-                          max_blocks: int = 64
+                          max_blocks: int = 64,
+                          timeout: float | None = None
                           ) -> tuple[list[int], np.ndarray | None]:
         """G4: ask a peer for a consecutive run of block hashes from its
-        host tiers. Returns (hashes found, [n, 2, L, Nkv, page, D])."""
+        host tiers. Returns (hashes found, [n, 2, L, Nkv, page, D]).
+        ``timeout`` overrides the connection's per-recv timeout for this
+        cycle (the G4 consult's overall deadline is the caller's)."""
         sock, conn_lock = self._conn_for(addr)
+        deadline = (time.monotonic() + timeout) if timeout is not None \
+            else None
         try:
             with conn_lock:
+                if timeout is not None:
+                    sock.settimeout(max(0.01, timeout))
                 _send_ctrl(sock, {"op": "blocks", "hashes": hashes,
                                   "max": max_blocks})
                 resp = _recv_ctrl(sock)
@@ -501,10 +578,14 @@ class KvPlaneClient:
                     raise ConnectionError(
                         f"block fetch refused: {resp['err']}")
                 if not resp["hashes"]:
+                    if timeout is not None:
+                        sock.settimeout(self.timeout)
                     return [], None
                 dt = dtype_of(resp["dtype"])
                 buf = np.empty(int(resp["nbytes"]), np.uint8)
-                _recv_bulk_into(sock, memoryview(buf))
+                _recv_bulk_into(sock, memoryview(buf), deadline=deadline)
+                if timeout is not None:
+                    sock.settimeout(self.timeout)
         except (ConnectionError, OSError):
             self._drop_conn(addr)
             raise
@@ -543,43 +624,65 @@ class RemoteBlockSource:
     (kvplane/ registrations), so the engine thread only ever reads a
     consistent list."""
 
-    # G4 fetches run on the ENGINE thread between windows: a dead peer
-    # must cost seconds at most, not the plane's bulk-transfer timeout —
-    # and a peer that keeps failing must stop costing anything at all
-    # until its cooldown expires (its lease usually expires first).
-    G4_TIMEOUT_S = 2.0
+    # G4 fetches run on the ENGINE thread between windows: the WHOLE
+    # consult — every peer together — gets one sub-window budget, so
+    # neither a dead peer nor a slow-but-alive one can stall unrelated
+    # in-flight decode streams for more than ~one window period. A peer
+    # that errors OR overruns the budget cools down and stops costing
+    # anything until the cooldown expires (its lease usually expires
+    # first). Recomputing the prefix is always the cheap safe fallback.
+    G4_BUDGET_S = 0.1
     PEER_COOLDOWN_S = 60.0
 
     def __init__(self, client: KvPlaneClient | None = None,
-                 self_addr: str | None = None, max_peers: int = 4):
-        self.client = client or KvPlaneClient(timeout=self.G4_TIMEOUT_S)
+                 self_addr: str | None = None, max_peers: int = 4,
+                 budget_s: float | None = None):
+        self.budget_s = self.G4_BUDGET_S if budget_s is None else budget_s
+        self.client = client or KvPlaneClient(timeout=self.budget_s)
         self.self_addr = self_addr
         self.max_peers = max_peers
         self.peers: list[str] = []
         self._cooldown: dict[str, float] = {}  # addr -> retry-after
         self.fetched_blocks = 0
         self.fetch_failures = 0
+        self.slow_peer_cooldowns = 0
 
     def fetch(self, hashes: list[int], max_blocks: int
               ) -> list[tuple[int, np.ndarray]]:
         """SYNC (engine thread, between windows): returns the longest
-        consecutive run of requested blocks any single peer holds."""
-        now = time.monotonic()
+        consecutive run of requested blocks any single peer holds,
+        giving the whole consult ``budget_s`` of wall clock."""
+        deadline = time.monotonic() + self.budget_s
         for addr in list(self.peers)[:self.max_peers]:
             if addr == self.self_addr or not addr:
                 continue
+            now = time.monotonic()
+            remaining = deadline - now
+            if remaining <= 0:
+                break
             if self._cooldown.get(addr, 0.0) > now:
                 continue
+            t0 = now
             try:
                 found, arr = self.client.fetch_blocks_sync(
-                    addr, hashes, max_blocks)
-            except (ConnectionError, OSError):
+                    addr, hashes, max_blocks, timeout=remaining)
+            except (ConnectionError, OSError) as exc:
                 self.fetch_failures += 1
-                self._cooldown[addr] = now + self.PEER_COOLDOWN_S
-                log.warning("G4 peer %s unreachable; cooling down %.0fs",
-                            addr, self.PEER_COOLDOWN_S)
+                self._cooldown[addr] = time.monotonic() + self.PEER_COOLDOWN_S
+                slow = isinstance(exc, (socket.timeout, TimeoutError))
+                if slow:
+                    self.slow_peer_cooldowns += 1
+                log.warning("G4 peer %s %s; cooling down %.0fs", addr,
+                            "too slow" if slow else "unreachable",
+                            self.PEER_COOLDOWN_S)
                 continue
-            self._cooldown.pop(addr, None)
+            if time.monotonic() - t0 > self.budget_s:
+                # Answered, but ate the whole consult budget: treat as
+                # slow and stop consulting it for a while.
+                self.slow_peer_cooldowns += 1
+                self._cooldown[addr] = time.monotonic() + self.PEER_COOLDOWN_S
+            else:
+                self._cooldown.pop(addr, None)
             if found:
                 self.fetched_blocks += len(found)
                 return [(h, arr[i]) for i, h in enumerate(found)]

@@ -39,6 +39,9 @@ class _InstanceConn:
         self._reader_task: asyncio.Task | None = None
         self._send_lock = asyncio.Lock()
         self.alive = False
+        # Set when the instance deregisters while streams are in flight:
+        # the connection drains them and closes itself once idle.
+        self.retire_when_idle = False
 
     async def connect(self) -> None:
         self._reader, self._writer = await asyncio.open_connection(
@@ -80,6 +83,8 @@ class _InstanceConn:
 
     def close_stream(self, rid: str) -> None:
         self._streams.pop(rid, None)
+        if self.retire_when_idle and not self._streams:
+            self.close()
 
     def close(self) -> None:
         self.alive = False
@@ -121,11 +126,30 @@ class EndpointClient:
         self._instances[instance.instance_id] = instance
         self._instances_event.set()
 
+    # How long a deregistered instance's in-flight streams may keep
+    # draining before the connection is force-closed. Crashed workers
+    # close the TCP connection themselves (kernel FIN/RST -> immediate
+    # ("lost") wakeup); this deadline covers the silent cases — network
+    # partition, host power loss — where no packet ever arrives and the
+    # lease expiry is the only death signal.
+    RETIRE_DRAIN_S = 30.0
+
     def _remove_instance(self, instance_id: int) -> None:
         self._instances.pop(instance_id, None)
         conn = self._conns.pop(instance_id, None)
         if conn:
-            conn.close()
+            # Deregistration only stops NEW routing to the instance.
+            # In-flight streams on a healthy TCP connection drain to
+            # completion: a lease blip (keepalive starved under load)
+            # must not kill a stream that the worker is still serving —
+            # but only within RETIRE_DRAIN_S, so a partitioned worker
+            # can't hang its streams forever.
+            if conn._streams:
+                conn.retire_when_idle = True
+                asyncio.get_running_loop().call_later(
+                    self.RETIRE_DRAIN_S, conn.close)
+            else:
+                conn.close()
         if not self._instances:
             self._instances_event.clear()
 
@@ -255,6 +279,11 @@ class EndpointClient:
                 elif kind == "err":
                     if payload == "incomplete":
                         raise StreamIncompleteError()
+                    from dynamo_tpu.runtime.errors import InvalidRequestError
+                    if isinstance(payload, str) and payload.startswith(
+                            InvalidRequestError.WIRE_PREFIX):
+                        raise InvalidRequestError(
+                            payload[len(InvalidRequestError.WIRE_PREFIX):])
                     raise EngineError(payload)
                 else:  # lost
                     raise StreamIncompleteError(

@@ -21,3 +21,12 @@ class NoInstancesError(EngineError):
 
 class OverloadedError(EngineError):
     """All workers busy (reference: router 503 busy_threshold path)."""
+
+
+class InvalidRequestError(EngineError):
+    """The request itself is invalid (engine-level validation: unsupported
+    sampling features, over-length prompts). Maps to HTTP 400 at the
+    frontend; workers mark it on the wire with an 'invalid_request: '
+    prefix so the class survives the request plane."""
+
+    WIRE_PREFIX = "invalid_request: "

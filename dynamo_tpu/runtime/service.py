@@ -156,6 +156,16 @@ class EndpointServer:
                 await send({"t": "final", "rid": rid})
         except asyncio.CancelledError:
             raise
+        except ValueError as exc:
+            # Engine request validation: type it on the wire so the
+            # frontend can answer 400, not 500.
+            self._m_errors.inc()
+            from dynamo_tpu.runtime.errors import InvalidRequestError
+            try:
+                await send({"t": "err", "rid": rid,
+                            "e": f"{InvalidRequestError.WIRE_PREFIX}{exc}"})
+            except (ConnectionError, OSError):
+                pass
         except GeneratorExit:
             # Handler signals an incomplete stream (migration trigger;
             # reference docs/guides/backend.md §Migrate).

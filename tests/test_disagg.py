@@ -108,8 +108,13 @@ async def start_stack(prefill_tp=1, decode_tp=1, max_local=8, plane=False):
     s = _Stack()
     s.coord = Coordinator()
     await s.coord.start()
+    # lease_ttl 3s, not 1s: under full-suite load the keepalive task can
+    # starve past a 1s TTL (the engine thread holds the GIL through XLA
+    # compiles) and the spurious expiry used to kill in-flight streams
+    # (round-4 queue-dispatch flake). Nothing here asserts on lease
+    # expiry; the fault-tolerance e2e configures its own TTL.
     cfg = lambda: RuntimeConfig(coordinator_url=s.coord.url,  # noqa: E731
-                                lease_ttl_s=1.0)
+                                lease_ttl_s=3.0)
     s.p_rt = await DistributedRuntime.from_settings(cfg())
     s.d_rt = await DistributedRuntime.from_settings(cfg())
 

@@ -140,3 +140,54 @@ async def test_g4_works_without_local_host_tiers():
         plane_a.close()
         a.stop()
         b.stop()
+
+
+@async_test(timeout=240)
+async def test_slow_peer_cannot_stall_the_consult():
+    """A deliberately SLOW (not dead) peer: the whole G4 consult is
+    bounded by RemoteBlockSource.budget_s — the engine thread (and every
+    unrelated in-flight decode stream) stalls at most ~one budget, and
+    the slow peer cools down so the next consult skips it entirely."""
+    import socket as socket_mod
+    import threading
+    import time as time_mod
+
+    # A TCP server that accepts and then just sits on the request.
+    srv = socket_mod.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    addr = f"127.0.0.1:{srv.getsockname()[1]}"
+    stop = threading.Event()
+
+    def tarpit():
+        while not stop.is_set():
+            try:
+                srv.settimeout(0.2)
+                conn, _ = srv.accept()
+            except OSError:
+                continue
+            # Hold the connection open, never answer.
+            while not stop.is_set():
+                time_mod.sleep(0.05)
+            conn.close()
+
+    t = threading.Thread(target=tarpit, daemon=True)
+    t.start()
+    src = RemoteBlockSource(KvPlaneClient(timeout=0.2), budget_s=0.2)
+    src.peers = [addr]
+    try:
+        t0 = time_mod.monotonic()
+        assert src.fetch([1, 2, 3], 3) == []
+        elapsed = time_mod.monotonic() - t0
+        assert elapsed < 5 * src.budget_s, (
+            f"slow peer stalled the consult {elapsed:.2f}s "
+            f"(budget {src.budget_s}s)")
+        assert src.slow_peer_cooldowns >= 1
+        # Cooled down: the next consult doesn't touch the peer at all.
+        t0 = time_mod.monotonic()
+        assert src.fetch([1, 2, 3], 3) == []
+        assert time_mod.monotonic() - t0 < 0.05
+    finally:
+        stop.set()
+        src.client.close()
+        srv.close()

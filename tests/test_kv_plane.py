@@ -8,6 +8,8 @@ Reference semantics: lib/llm/src/block_manager/storage/nixl.rs (RDMA KV
 plane), docs/architecture/dynamo_flow.md §NIXL (metadata handshake).
 """
 
+import asyncio
+
 import numpy as np
 import pytest
 from conftest import async_test
@@ -171,3 +173,93 @@ async def test_plane_death_falls_back_to_local_prefill():
         assert s.handler.local_prefills == 1
     finally:
         await stop_stack(s)
+
+
+# ---------------------------------------------------------------------------
+# jax.experimental.transfer device path (the NIXL role's defining feature)
+# ---------------------------------------------------------------------------
+
+@async_test
+async def test_jax_device_path_stage_pull():
+    """The device-to-device path END TO END on a backend whose PJRT
+    supports the transfer engine (pure-CPU jax here; tunneled TPU raises
+    UNIMPLEMENTED and falls back to the socket path): stage(device_array)
+    -> client _pull_jax -> bytes identical, no socket bulk transfer, and
+    the fire-and-forget "done" releases the staged entry."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.llm.kv_plane import jax_transfer_usable
+
+    if not jax_transfer_usable():
+        pytest.skip("transfer engine unsupported on this backend")
+    server = KvPlaneServer(use_jax_path=True)
+    server.start()
+    client = KvPlaneClient()
+    try:
+        host = np.arange(2 * 3 * 2 * 4 * 16 * 8, dtype=np.float32) \
+            .reshape(2, 3, 2, 4, 16, 8)
+        dev = jnp.asarray(host)
+        ticket = server.stage(
+            meta={"shape": list(host.shape), "dtype": str(host.dtype)},
+            resolve=lambda: host, device_array=dev, prompt_len=64)
+        assert "jax_addr" in ticket, "device path was not offered"
+        out = await client.pull(ticket)
+        np.testing.assert_array_equal(np.asarray(out), host)
+        assert client.jax_pulls == 1, "pull did not take the device path"
+        assert server.transfers == 0, "bulk socket path should be unused"
+        for _ in range(100):  # the "done" release is fire-and-forget
+            if not server._staged:
+                break
+            await asyncio.sleep(0.02)
+        assert not server._staged, "done op did not release the parcel"
+    finally:
+        client.close()
+        server.close()
+
+
+@async_test(timeout=240)
+async def test_disagg_device_path_e2e():
+    """Full disaggregated 1P+1D e2e with the KV parcel moving over the
+    jax transfer engine (no host-staged socket bulk): the 128-token
+    prompt fills its page bucket exactly, so the prefill worker offers
+    the device array, and the decode side's pull must take the jax path
+    — token-identical to aggregated serving."""
+    from dynamo_tpu.llm.kv_plane import jax_transfer_usable
+
+    if not jax_transfer_usable():
+        pytest.skip("transfer engine unsupported on this backend")
+    s = await start_stack(max_local=8, plane=True)
+    try:
+        prompt = _prompt(33, 128)  # 8 pages == the extract page bucket
+        got = await run_request(s.caller, prompt, 8)
+        assert s.handler.remote_prefills == 1
+        assert s.handler.plane_client.jax_pulls == 1, (
+            "KV parcel did not ride the device path")
+        assert s.plane.transfers == 0, (
+            "socket bulk path used despite the device path")
+        ref = await run_agg(prompt, 8)
+        assert got == ref
+    finally:
+        await stop_stack(s)
+
+
+@async_test
+async def test_grouped_stage_pull_roundtrip(plane):
+    """Pipelined socket path: page groups streamed in order reassemble
+    into the exact parcel bytes."""
+    server, client = plane
+    kv = _rand_kv(shape=(2, 2, 2, 7, 16, 32), seed=5)
+    groups = [(3, lambda: np.ascontiguousarray(kv[:, :, :, :3])),
+              (3, lambda: np.ascontiguousarray(kv[:, :, :, 3:6])),
+              (1, lambda: np.ascontiguousarray(kv[:, :, :, 6:]))]
+    ticket = server.stage(meta={"shape": list(kv.shape),
+                                "dtype": str(kv.dtype)},
+                          resolve_groups=groups, prompt_len=112)
+    out = await client.pull(ticket)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(kv))
+    assert client.transfers == 1
+    for _ in range(200):  # server thread counts after its last send
+        if server.transfers == 1:
+            break
+        await asyncio.sleep(0.01)
+    assert server.transfers == 1

@@ -105,6 +105,57 @@ async def test_queue_reply_timeout_falls_back_local():
         await stop_queue_stack(s)
 
 
+@async_test(timeout=240)
+async def test_conn_killed_mid_queue_dispatch_migrates_and_completes():
+    """The frontend's connection to the decode worker dies WHILE a
+    queue-dispatched prefill is in flight: the Migration operator
+    re-issues the request (the worker itself is healthy) and the stream
+    completes token-identical — a StreamIncompleteError must never
+    reach the client below migration_limit (round-4 in-suite flake)."""
+    from dynamo_tpu.llm.migration import Migration
+    from dynamo_tpu.runtime.engine import AsyncEngine
+
+    class _CallerEngine(AsyncEngine):
+        def __init__(self, caller):
+            self.caller = caller
+
+        async def generate(self, request, context):
+            stream = await self.caller.round_robin(request, context)
+            async for out in stream:
+                yield out
+
+    s = await start_queue_stack(max_local=8)
+    try:
+        migration = Migration(migration_limit=2,
+                              inner=_CallerEngine(s.caller))
+        prompt = _prompt(44, 24)
+        req = PreprocessedRequest(model="tiny-test", token_ids=list(prompt))
+        req.stop_conditions.max_tokens = 10
+
+        async def kill_conn_mid_dispatch():
+            # Wait for the dispatch to be in flight, then sever the
+            # caller->decode TCP connection out from under the stream.
+            for _ in range(2000):
+                if s.dispatcher.enqueued >= 1:
+                    break
+                await asyncio.sleep(0.005)
+            for conn in list(s.caller._conns.values()):
+                conn.close()
+
+        killer = asyncio.ensure_future(kill_conn_mid_dispatch())
+        toks = []
+        async for out in migration.generate(req, Context()):
+            toks.extend(out.token_ids)
+            if out.finish_reason:
+                break
+        await killer
+        assert s.dispatcher.enqueued >= 1, "kill landed before any dispatch"
+        ref = await run_agg(prompt, 10)
+        assert toks == ref
+    finally:
+        await stop_queue_stack(s)
+
+
 def test_worker_cli_flags():
     from dynamo_tpu.backends.tpu import parse_args
     args = parse_args(["--mode", "decode", "--prefill-dispatch", "queue",

@@ -113,9 +113,15 @@ async def test_worker_death_incomplete_stream_and_deregistration():
         task = asyncio.create_task(consume())
         await asyncio.wait_for(started.wait(), 5)
         # Hard-kill the worker's server (connection drops mid-stream).
+        # Close the accepted sockets too: a SIGKILLed process's kernel
+        # does this, and connection death — not the lease-delete event —
+        # is what ends in-flight streams (deregistration only stops NEW
+        # routing; streams on a live connection drain).
         server._server.close()
         for conn_task in list(server._inflight.values()):
             conn_task[0].cancel()
+        for w in list(server._conn_writers):
+            w.close()
         await worker.close()  # revokes lease -> delete event -> client drops instance
         try:
             await asyncio.wait_for(task, 10)
