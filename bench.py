@@ -32,6 +32,7 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -99,7 +100,7 @@ async def run_round(engine, spec, rng, tag, batch=BATCH, osl=OSL):
     }
 
 
-async def main_async():
+async def main_async(mode: str = "serve"):
     import jax
 
     from dynamo_tpu.engine.config import EngineConfig, PRESETS
@@ -128,6 +129,35 @@ async def main_async():
     engine.start()
     rng = np.random.default_rng(0)
 
+    if mode == "prefill":
+        # Worker-level prefill bench: the disaggregated prefill worker's
+        # serving pattern (every request is prompt -> first token). The
+        # engine dispatches NO decode windows for these slots.
+        await run_round(engine, spec, rng, "warmup", osl=1)
+        pres = [await run_round(engine, spec, rng, f"prefill{i}", osl=1)
+                for i in range(max(3, ROUNDS))]
+        by_el = sorted(r["elapsed_s"] for r in pres)
+        med_round = sorted(pres, key=lambda r: r["elapsed_s"])[len(pres) // 2]
+        med = BATCH * ISL / by_el[len(by_el) // 2]
+        engine.stop()
+        print(json.dumps({
+            "metric": f"prefill_tok_s_per_chip_{spec.name}_bs{BATCH}"
+                      f"_isl{ISL}",
+            "value": round(med, 1),
+            "unit": "tok/s/chip",
+            "vs_baseline": round(
+                med / (BATCH * ISL / by_el[0]), 3) if by_el[0] else 0.0,
+            "detail": {
+                "vs_baseline_semantics": "median/best across rounds "
+                                         "(stability; 1.0 = no outliers)",
+                "rounds": [round(BATCH * ISL / e, 1) for e in by_el],
+                "ttft_p99_ms": round(med_round["ttft_p99_ms"], 1),
+                "platform": jax.devices()[0].platform,
+                "device": str(jax.devices()[0]),
+            },
+        }))
+        return
+
     t0 = time.monotonic()
     await run_round(engine, spec, rng, "warmup")  # compiles all buckets
     warm_s = time.monotonic() - t0
@@ -152,10 +182,16 @@ async def main_async():
     for bs in (8, 16):
         r = await run_round(engine, spec, rng, f"bs{bs}", batch=bs)
         sweep[f"bs{bs}_decode_tok_s"] = round(r["decode_tok_s"], 1)
-    # MEASURED prefill throughput: max_tokens=1 round — the clock stops
+    # MEASURED prefill throughput: max_tokens=1 rounds — the clock stops
     # when every first token has arrived (not the TTFT-derived proxy).
-    pre = await run_round(engine, spec, rng, "prefill", osl=1)
-    prefill_tok_s_measured = BATCH * ISL / pre["elapsed_s"]
+    # Median of 3: a single tunnel stall once reported 240 tok/s for a
+    # round whose own TTFT implied ~15K (round-4 capture); one outlier
+    # round must not carry (or sink) the claim.
+    pres = [await run_round(engine, spec, rng, f"prefill{i}", osl=1)
+            for i in range(3)]
+    pre_elapsed = sorted(r["elapsed_s"] for r in pres)
+    prefill_tok_s_measured = BATCH * ISL / pre_elapsed[1]
+    prefill_spread = [round(BATCH * ISL / e, 1) for e in pre_elapsed]
     engine.stop()
 
     # Roofline context: one decode step must read all weights once.
@@ -182,6 +218,7 @@ async def main_async():
             "osl": OSL,
             "round_s": round(steady["elapsed_s"], 2),
             "prefill_tok_s": round(prefill_tok_s_measured, 1),
+            "prefill_tok_s_rounds": prefill_spread,
             "sweep": sweep,
             "warmup_s": round(warm_s, 1),
             "roofline_tok_s_weight_read": round(roofline_tok_s, 0),
@@ -198,7 +235,21 @@ async def main_async():
 
 
 def main() -> None:
-    asyncio.run(main_async())
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--mode", choices=("serve", "prefill"),
+                    default=os.environ.get("BENCH_MODE", "serve"),
+                    help="serve: full continuous-batching bench (default); "
+                         "prefill: disagg prefill-worker pattern "
+                         "(max_tokens=1 bursts, headline = prefill tok/s)")
+    args = ap.parse_args()
+    asyncio.run(main_async(args.mode))
+    # Hard-exit after the JSON line: interpreter teardown races the
+    # tunnel client's destructor and prints a harmless-but-ugly Rust
+    # panic ("AxonClient not initialized") into every driver capture.
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)
 
 
 if __name__ == "__main__":

@@ -91,6 +91,22 @@ def parse_args(argv=None) -> argparse.Namespace:
                              "offload here and onboard on prefix hits")
     parser.add_argument("--kv-disk-cache-dir", default=None,
                         help="G3 disk tier directory behind the host cache")
+    parser.add_argument("--spec-decode", default=None, choices=["ngram"],
+                        help="speculative decoding: 'ngram' = prompt-"
+                             "lookup self-drafting verified in-window "
+                             "(greedy-only serving)")
+    parser.add_argument("--spec-k", type=int, default=3,
+                        help="drafts verified per speculative step")
+    parser.add_argument("--ttft-budget-ms", type=float, default=None,
+                        help="SLA-aware admission: defer admitting cold "
+                             "prefills while the projected TTFT (measured "
+                             "prefill rate x cold-token backlog) exceeds "
+                             "this budget")
+    parser.add_argument("--admission-reject-factor", type=float, default=2.0,
+                        help="with --ttft-budget-ms: reject (503) requests "
+                             "whose projected TTFT through the backlog "
+                             "exceeds budget x this factor, so the router "
+                             "retries another worker; 0 = queue unboundedly")
     parser.add_argument("--migration-limit", type=int, default=0)
     parser.add_argument("--tool-call-parser", default=None,
                         help="tool-call format on the backward edge "
@@ -171,7 +187,13 @@ def build_engine_config(args) -> EngineConfig:
         pipeline_depth=getattr(args, "pipeline_depth", 4),
         warmup_windows=True,
         host_cache_pages=args.host_cache_pages,
-        kv_disk_cache_dir=args.kv_disk_cache_dir)
+        kv_disk_cache_dir=args.kv_disk_cache_dir,
+        spec_decode=getattr(args, "spec_decode", None),
+        spec_k=getattr(args, "spec_k", 3),
+        ttft_budget_ms=getattr(args, "ttft_budget_ms", None),
+        admission_reject_factor=(
+            getattr(args, "admission_reject_factor", 0.0)
+            if getattr(args, "ttft_budget_ms", None) else 0.0))
 
 
 def _window_arg(value) -> int | str:

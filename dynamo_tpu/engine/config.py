@@ -208,6 +208,35 @@ class EngineConfig:
     host_cache_pages: int = 0
     kv_disk_cache_dir: str | None = None
     disk_cache_pages: int = 4096
+    # Speculative decoding (reference SpecDecodeStats protocols.rs:32-56;
+    # the reference delegates spec decode to its engines — here the
+    # engine IS ours). "ngram" = prompt-lookup self-drafting: the window
+    # program matches the sequence's trailing bigram against its own
+    # on-device token history, proposes the spec_k tokens that followed
+    # the previous occurrence, and VERIFIES them in one multi-token
+    # forward — one weight read covers up to spec_k+1 positions, which
+    # on an HBM-bound decode is up to a (spec_k+1)x ITL win on
+    # repetitive text (summaries, code edits, RAG). GREEDY ONLY:
+    # requests with temperature/logprobs/penalties/seeds are rejected
+    # while this is enabled (rejection sampling for stochastic
+    # equivalence is a later step). Off by default; plain serving is
+    # untouched.
+    spec_decode: str | None = None  # None | "ngram"
+    spec_k: int = 3                 # drafts verified per step
+    # SLA-aware admission (reference pre_deployment_profiling.md:36-38
+    # role): with a TTFT budget set, admission projects the time to
+    # prefill every already-admitted cold token plus the candidate's
+    # (from the measured end-to-end prefill rate, EWMA over batched-
+    # prefill readbacks) and defers the candidate in the waiting queue
+    # while the projection exceeds the budget. One request is always
+    # admissible when nothing else is in flight (a single over-budget
+    # prompt must not starve). None disables the limiter.
+    ttft_budget_ms: float | None = None
+    # With a budget set, generate() additionally raises OverloadedError
+    # (HTTP 503 at the frontend; the router retries elsewhere) when the
+    # projected TTFT including QUEUED cold tokens exceeds budget x this
+    # factor. 0 disables rejection: requests queue unboundedly instead.
+    admission_reject_factor: float = 0.0
 
     def bucket_for(self, length: int) -> int:
         for b in self.prefill_buckets:

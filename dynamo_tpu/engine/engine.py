@@ -36,7 +36,8 @@ from dynamo_tpu.engine.runner import (
     PK_TOPK, PK_TEMP, PK_TOPP, PK_CAP, PK_LOGPROB, PK_FREQPEN, PK_PRESPEN,
     PK_SEED, PK_SEEDED, PK_PREFIX, TOP_LOGPROBS)
 from dynamo_tpu.engine.sampler import MAX_TOPK
-from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics, KvStats, WorkerStats
+from dynamo_tpu.llm.kv_router.protocols import (ForwardPassMetrics, KvStats,
+                                                SpecDecodeStats, WorkerStats)
 from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput, PreprocessedRequest
 from dynamo_tpu.llm.tokens import TokenBlockSequence
 from dynamo_tpu.runtime.context import Context
@@ -75,6 +76,11 @@ class _Request:
     # (embeddings, mask) for the chunked path.
     no_cache: bool = False
     mm_buf: tuple | None = None
+    # SLA-admission ledger entries: cold tokens this request contributes
+    # while queued (full prompt; reuse unknown until planned) and while
+    # admitted-but-first-token-unresolved (prompt minus prefix reuse).
+    queued_cold: int = 0
+    cold_tokens: int = 0
 
     def push(self, item) -> None:
         self.loop.call_soon_threadsafe(self.out_q.put_nowait, item)
@@ -87,6 +93,10 @@ class _Window:
     frozen: dict  # slot -> (request, epoch, "requeue" | "oom")
     size: int
     serial: int = 0  # dispatch order (pipelined deferred-release fencing)
+    # Speculative windows: toks = (outs [m,B,S], emits [m,B],
+    # ndrafts [m,B]); slots snaps carry the ASSUMED advance so
+    # processing can correct the host's upper-bound positions.
+    spec: bool = False
 
 
 class TPUEngine(AsyncEngine):
@@ -136,6 +146,32 @@ class TPUEngine(AsyncEngine):
         self.overrides: dict[int, int] = {}  # slot -> first token next window
         self.waiting: queue.Queue[_Request] = queue.Queue()
         self.num_waiting = 0
+        # SLA-aware admission (config.ttft_budget_ms): the measured
+        # end-to-end prefill rate (EWMA over batched-prefill dispatch ->
+        # first-token-readback intervals, so queueing behind decode
+        # windows is priced in) and the cold-token ledger the TTFT
+        # projection runs on. The disagg prefill-extract job path
+        # (run_job) bypasses this — its admission belongs to the queue
+        # dispatcher's depth backpressure (llm/prefill_queue.py).
+        self.prefill_rate_tok_s: float | None = None
+        self._cold_inflight = 0   # admitted; first token not yet resolved
+        self._waiting_cold = 0    # queued; not yet admitted
+        self.admission_deferred = 0  # gate held the queue head back
+        # Deferred queue HEAD: the SLA gate parks the over-budget head
+        # here instead of re-queueing at the tail — strict FIFO, so a
+        # large prompt can't be starved by a stream of later small ones
+        # slipping under the budget.
+        self._deferred_head: _Request | None = None
+        # Speculative decoding (config.spec_decode="ngram"): outer verify
+        # steps per window sized so the worst case (nothing accepted
+        # costs m_outer weight reads, everything accepted yields the
+        # full M tokens for m_outer reads). Stats feed SpecDecodeStats.
+        self.spec_m_outer = (max(1, self.decode_window
+                                 // (config.spec_k + 1))
+                             if config.spec_decode else 0)
+        self.spec_drafts = 0        # verify steps that had drafts
+        self.spec_tokens = 0        # draft tokens proposed
+        self.spec_accepted = 0      # draft tokens accepted
         # Control jobs executed on the engine thread between windows
         # (disagg prefill-extract, KV injection helpers, etc.).
         self._jobs: queue.Queue = queue.Queue()
@@ -184,6 +220,24 @@ class TPUEngine(AsyncEngine):
     def _validate(self, req: PreprocessedRequest) -> None:
         if not req.token_ids:
             raise ValueError("empty token_ids")
+        if self.config.spec_decode:
+            s = req.sampling_options
+            unsupported = []
+            if s.temperature:
+                unsupported.append("temperature > 0")
+            if s.logprobs is not None:
+                unsupported.append("logprobs")
+            if getattr(s, "frequency_penalty", None) or \
+                    getattr(s, "presence_penalty", None):
+                unsupported.append("penalties")
+            if getattr(s, "seed", None) is not None:
+                unsupported.append("seed")
+            if unsupported:
+                raise ValueError(
+                    f"speculative decoding ({self.config.spec_decode}) "
+                    f"serves greedy only; unsupported here: "
+                    f"{', '.join(unsupported)}. Disable spec_decode or "
+                    f"drop these sampling options")
         if len(req.token_ids) >= self.config.max_model_len:
             raise ValueError(
                 f"prompt length {len(req.token_ids)} exceeds max model len "
@@ -218,6 +272,58 @@ class TPUEngine(AsyncEngine):
                 setattr(s, field, clamped)
 
 
+    # -- SLA-aware admission ---------------------------------------------------
+    def _queue_put(self, r: _Request, cold: int | None = None) -> None:
+        """Enqueue for admission, tracking the queued cold tokens the
+        TTFT projection counts (every put site must come through here)."""
+        r.queued_cold = len(r.tokens_all) if cold is None else cold
+        self._waiting_cold += r.queued_cold
+        self.waiting.put(r)
+        self.num_waiting += 1
+
+    def _queue_pop_accounting(self, r: _Request) -> None:
+        self._waiting_cold -= r.queued_cold
+        r.queued_cold = 0
+
+    def _maybe_reject(self, prompt_tokens: int) -> None:
+        """Raise OverloadedError (frontend: HTTP 503, router retries
+        elsewhere) when the projected TTFT through the current backlog
+        exceeds budget x reject_factor. Never rejects an idle engine:
+        with no backlog the request's TTFT is its own prefill, which the
+        budget can't improve by bouncing it."""
+        cfg = self.config
+        if not (cfg.ttft_budget_ms and cfg.admission_reject_factor):
+            return
+        backlog = self._cold_inflight + self._waiting_cold
+        rate = self.prefill_rate_tok_s
+        if backlog <= 0 or not rate:
+            return
+        projected = (backlog + prompt_tokens) / rate * 1e3
+        limit = cfg.ttft_budget_ms * cfg.admission_reject_factor
+        if projected > limit:
+            from dynamo_tpu.runtime.errors import OverloadedError
+            raise OverloadedError(
+                f"projected TTFT {projected:.0f} ms exceeds "
+                f"{limit:.0f} ms ({backlog} cold tokens backlogged at "
+                f"{rate:.0f} tok/s)")
+
+    def _prefill_rate_sample(self, tokens: int, elapsed_s: float) -> None:
+        if tokens <= 0 or elapsed_s <= 1e-6:
+            return
+        s = tokens / elapsed_s
+        self.prefill_rate_tok_s = (
+            s if self.prefill_rate_tok_s is None
+            else 0.7 * self.prefill_rate_tok_s + 0.3 * s)
+
+    def estimated_ttft_ms(self, extra_tokens: int = 0) -> float | None:
+        """Projected TTFT for a hypothetical arrival, from the measured
+        prefill rate and the cold-token backlog. None until the first
+        prefill has calibrated the rate."""
+        if not self.prefill_rate_tok_s:
+            return None
+        return ((self._cold_inflight + self._waiting_cold + extra_tokens)
+                / self.prefill_rate_tok_s * 1e3)
+
     async def generate(self, request, context: Context) -> AsyncIterator[dict]:
         self.start()
         req = (request if isinstance(request, PreprocessedRequest)
@@ -228,8 +334,8 @@ class TPUEngine(AsyncEngine):
                      tokens_all=list(req.token_ids),
                      len_cap=len(req.token_ids)
                      + (req.stop_conditions.max_tokens or 2**30))
-        self.waiting.put(r)
-        self.num_waiting += 1
+        self._maybe_reject(len(req.token_ids))
+        self._queue_put(r)
         while True:
             item = await r.out_q.get()
             if item is None:
@@ -260,8 +366,9 @@ class TPUEngine(AsyncEngine):
                      # placeholder-id hash chain must not enter the
                      # prefix cache pointing at media-conditioned KV.
                      no_cache=bool(getattr(req, "mm_embeds", None)))
-        self.waiting.put(r)
-        self.num_waiting += 1
+        # Injected requests carry their KV with them — no cold prefill,
+        # so the SLA gate and the cold ledger both skip them.
+        self._queue_put(r, cold=0)
         while True:
             item = await r.out_q.get()
             if item is None:
@@ -303,10 +410,15 @@ class TPUEngine(AsyncEngine):
         first_token, handle, prompt_len = self._prefill_for_extract(req)
         return first_token, self.runner.finalize_extract(handle), prompt_len
 
-    def _prefill_for_extract(self, req: PreprocessedRequest):
+    def _prefill_for_extract(self, req: PreprocessedRequest,
+                             grouped: bool = False):
         """Prefill + dispatch the page gather; returns the UNRESOLVED
         extract handle so the device->host copy can overlap whatever the
-        caller does next (stage-for-pull, decode windows)."""
+        caller does next (stage-for-pull, decode windows). With
+        ``grouped``, dispatches up to 4 page-group gathers instead of one
+        (their D2H copies all start now; the plane then streams group i
+        while group i+1's copy completes) and returns a list of
+        handles."""
         self._validate(req)
         r = _Request(req=req, ctx=Context(), out_q=None, loop=None,  # type: ignore[arg-type]
                      tokens_all=list(req.token_ids))
@@ -321,7 +433,13 @@ class TPUEngine(AsyncEngine):
             if not r.no_cache:
                 for idx, h in enumerate(r.blocks.block_hashes):
                     self.allocator.register(r.pages[idx], h)
-            handle = self.runner.extract_pages_async(r.pages)
+            if grouped:
+                n = len(r.pages)
+                per = -(-n // min(4, max(1, n)))
+                handle = [self.runner.extract_pages_async(r.pages[i:i + per])
+                          for i in range(0, n, per)]
+            else:
+                handle = self.runner.extract_pages_async(r.pages)
         finally:
             # The gather is dispatched: device-stream order guarantees it
             # reads the pages before any later program can overwrite them,
@@ -332,25 +450,46 @@ class TPUEngine(AsyncEngine):
 
     def prefill_extract_staged(self, req: PreprocessedRequest, plane):
         """ENGINE-THREAD ONLY (call via run_job). Disaggregated prefill
-        over the direct KV data plane: prefill, stage the extract handle
-        with the plane (host fetch resolves lazily on the plane thread,
+        over the direct KV data plane: prefill, stage the extract with
+        the plane (host fetches resolve lazily on the plane thread,
         overlapping this engine's next windows), return (first_token,
         ticket, prompt_len). The ticket rides the small response stream;
-        the KV bytes take the plane's direct path (llm/kv_plane.py)."""
-        first_token, handle, prompt_len = self._prefill_for_extract(req)
+        the KV bytes take the plane's direct path (llm/kv_plane.py) —
+        the jax device path when the parcel shape allows it, else the
+        socket path with PIPELINED page groups (extract was ~97% of the
+        round-4 transfer tax; reference offload.rs overlap role)."""
         spec = self.runner.spec
-        n = handle[1]
-        shape = [2, spec.num_layers, self.runner.canonical_nkv, n,
-                 self.config.page_size, spec.head_dim]
+        page = self.config.page_size
+        n = -(-len(req.token_ids) // page)
         # The jax device-path needs the staged array to be EXACTLY the
         # advertised shape; the gather output is bucket-padded and
         # kv-head-replicated, so only offer it when neither applies.
-        dev = (handle[0] if handle[0].shape[3] == n
-               and self.runner.kv_rep == 1 else None)
-        ticket = plane.stage(
-            meta={"shape": shape, "dtype": "bfloat16"},
-            resolve=lambda: self.runner.finalize_extract(handle),
-            device_array=dev, prompt_len=prompt_len)
+        dev_ok = (getattr(plane, "_use_jax", False)
+                  and self.runner.kv_rep == 1
+                  and self.runner._page_bucket(n) == n)
+        # Socket-path grouping only helps when per-fetch D2H latency is
+        # small (local attachment); a tunneled chip pays its ~100 ms RTT
+        # floor PER GROUP (measured 0.21x — profile_kv_transfer.py), so
+        # gate on the measured floor.
+        grouped = (not dev_ok
+                   and self.runner.d2h_fetch_floor_ms() < 10.0 and n > 1)
+        first_token, handle, prompt_len = self._prefill_for_extract(
+            req, grouped=grouped)
+        shape = [2, spec.num_layers, self.runner.canonical_nkv, n,
+                 self.config.page_size, spec.head_dim]
+        meta = {"shape": shape, "dtype": "bfloat16"}
+        if grouped:
+            groups = [(h[1], (lambda hh=h:
+                              self.runner.finalize_extract(hh)))
+                      for h in handle]
+            ticket = plane.stage(meta=meta, resolve_groups=groups,
+                                 prompt_len=prompt_len)
+        else:
+            ticket = plane.stage(
+                meta=meta,
+                resolve=lambda: self.runner.finalize_extract(handle),
+                device_array=handle[0] if dev_ok else None,
+                prompt_len=prompt_len)
         return first_token, ticket, prompt_len
 
     async def embed(self, token_lists: list[list[int]],
@@ -401,6 +540,25 @@ class TPUEngine(AsyncEngine):
         bucket_pages = self.runner.bucket_pages_for(1)
         packed = np.zeros((self.config.max_num_seqs,
                            PK_PREFIX + bucket_pages), np.int32)
+        if self.config.spec_decode:
+            # Spec mode serves greedy only: one program to warm, none of
+            # the penalized/seeded variants (rejected at validation).
+            outs = self.runner.decode_spec_window(
+                packed, self.spec_m_outer, self.config.spec_k)
+            np.asarray(outs[0])
+            log.info("warmed spec window program m=%d k=%d in %.1fs",
+                     self.spec_m_outer, self.config.spec_k,
+                     time.monotonic() - t0)
+            t0 = time.monotonic()
+            bucket = self.config.prefill_buckets[0]
+            seq = PrefillSeq(tokens=np.zeros(min(4, bucket), np.int32),
+                             start_pos=0,
+                             chunk_pages=np.zeros(1, np.int32),
+                             hist_pages=None, sampling=(0.0, 0, 1.0))
+            self.runner.prefill_batch([seq])
+            log.info("warmed prefill bucket %d in %.1fs", bucket,
+                     time.monotonic() - t0)
+            return
         outs = self.runner.decode_window(packed, self.decode_window)
         np.asarray(outs[0])  # force compile + execute
         # The penalized variant too: a first penalized request must not
@@ -617,6 +775,14 @@ class TPUEngine(AsyncEngine):
                 self._resolve_first(entry)
 
     def _resolve_first(self, entry: dict) -> None:
+        cold = entry.get("cold", 0)
+        if cold:
+            # The batch's cold tokens leave the SLA ledger, and its
+            # dispatch->readback interval calibrates the projection rate
+            # (end-to-end: queueing behind decode windows is priced in).
+            self._cold_inflight -= cold
+            self._prefill_rate_sample(
+                cold, time.monotonic() - entry.get("t0", 0.0))
         h = entry["handle"]
         want_lp = any(r.req.sampling_options.logprobs is not None
                       for _, r, _, _ in entry["rows"])
@@ -671,11 +837,15 @@ class TPUEngine(AsyncEngine):
         free_slots = [i for i, r in enumerate(self.slot_req) if r is None]
         staged: list[tuple[_Request, int, PrefillSeq]] = []
         while free_slots:
-            try:
-                r = self.waiting.get_nowait()
-            except queue.Empty:
-                break
+            if self._deferred_head is not None:
+                r, self._deferred_head = self._deferred_head, None
+            else:
+                try:
+                    r = self.waiting.get_nowait()
+                except queue.Empty:
+                    break
             self.num_waiting -= 1
+            self._queue_pop_accounting(r)
             if r.ctx.is_killed or r.ctx.is_stopped:
                 r.push(LLMEngineOutput(
                     token_ids=[], finish_reason=FinishReason.CANCELLED).to_wire())
@@ -694,6 +864,23 @@ class TPUEngine(AsyncEngine):
                 # local prefill of the full prompt (correctness preserved).
                 free_slots.insert(0, slot)
                 r.injected = None
+            if (self.config.ttft_budget_ms and self._cold_inflight > 0
+                    and self.prefill_rate_tok_s):
+                # SLA gate: admitting this prompt must not push the
+                # projected prefill backlog past the TTFT budget. With
+                # nothing cold in flight the head always admits (an
+                # over-budget single prompt must not starve).
+                projected = ((self._cold_inflight + len(r.tokens_all))
+                             / self.prefill_rate_tok_s * 1e3)
+                if projected > self.config.ttft_budget_ms:
+                    # Park at the HEAD (strict FIFO): re-queueing at the
+                    # tail would let later small prompts starve this one.
+                    r.queued_cold = len(r.tokens_all)
+                    self._waiting_cold += r.queued_cold
+                    self.num_waiting += 1
+                    self._deferred_head = r
+                    self.admission_deferred += 1
+                    break
             try:
                 plan = self._plan_prefill(r)
             except Exception as exc:  # noqa: BLE001
@@ -702,20 +889,30 @@ class TPUEngine(AsyncEngine):
                 continue
             if plan is None:
                 # No KV room: put back and stop admitting.
-                self.waiting.put(r)
-                self.num_waiting += 1
+                self._queue_put(r)
                 break
             slot = free_slots.pop(0)
             if plan == "chunked":
+                cold = len(r.tokens_all) - r.reuse_tokens
+                self._cold_inflight += cold
+                t0 = time.monotonic()
                 try:
                     self._prefill_chunked(r, slot)
+                    # Success only: a fast FAILURE would sample an
+                    # absurd tok/s and poison the admission projection.
+                    self._prefill_rate_sample(cold,
+                                              time.monotonic() - t0)
                 except Exception as exc:  # noqa: BLE001
                     log.exception("chunked prefill failed")
                     self.allocator.release(r.pages)
                     r.pages = []
                     r.push(RuntimeError(f"prefill failed: {exc}"))
                     free_slots.insert(0, slot)
+                finally:
+                    self._cold_inflight -= cold
                 continue
+            r.cold_tokens = len(r.tokens_all) - r.reuse_tokens
+            self._cold_inflight += r.cold_tokens
             staged.append((r, slot, plan))
         if not staged:
             return False
@@ -738,6 +935,8 @@ class TPUEngine(AsyncEngine):
                 except Exception as exc:  # noqa: BLE001
                     log.exception("batched prefill failed")
                     for r, _, _ in chunk:
+                        self._cold_inflight -= r.cold_tokens
+                        r.cold_tokens = 0
                         self.allocator.release(r.pages)
                         r.pages = []
                         r.push(RuntimeError(f"prefill failed: {exc}"))
@@ -746,9 +945,21 @@ class TPUEngine(AsyncEngine):
                 for row, (r, slot, _) in enumerate(chunk):
                     self._place_in_slot_pending(r, slot)
                     rows.append((row, r, slot, r.epoch))
+                if self.runner.hist_dev is not None:
+                    # Spec decode: full prompts (including any reused
+                    # prefix; tokens_all also covers requeued requests'
+                    # generated tokens) into the on-device draft
+                    # history; the chained first token rides from
+                    # tokens_dev.
+                    self.runner.seed_history([
+                        (slot, np.asarray(r.tokens_all, np.int32), 0,
+                         True, None) for r, slot, _ in chunk])
                 # First tokens are already chained on-device (tokens_dev);
                 # their host values arrive asynchronously.
-                self._pending_first.append({"handle": handle, "rows": rows})
+                self._pending_first.append({
+                    "handle": handle, "rows": rows,
+                    "cold": sum(r.cold_tokens for r, _, _ in chunk),
+                    "t0": time.monotonic()})
         return True
 
     def _admit_injected(self, r: _Request, slot: int) -> bool:
@@ -771,6 +982,12 @@ class TPUEngine(AsyncEngine):
         self.runner.insert_pages(kv, pages)
         r.pages = pages
         r.injected = None
+        if self.runner.hist_dev is not None:
+            # No local prefill ran, so the draft history and position
+            # seed from host values (first_token is known here).
+            self.runner.seed_history([
+                (slot, np.asarray(prompt, np.int32), 0, True,
+                 int(first_token))])
         self._place_in_slot(r, slot, first_token)
         return True
 
@@ -877,6 +1094,10 @@ class TPUEngine(AsyncEngine):
     def _prefill_chunked(self, r: _Request, slot: int) -> None:
         """Long prompt: prefill in page-aligned chunks with history."""
         token = self._prefill_chunked_token(r)
+        if self.runner.hist_dev is not None:
+            self.runner.seed_history([
+                (slot, np.asarray(r.tokens_all, np.int32), 0, True,
+                 token)])
         lp_out = None
         if r.req.sampling_options.logprobs is not None:
             lg = np.asarray(self.runner.last_prefill_logits[0], np.float32)
@@ -1044,6 +1265,7 @@ class TPUEngine(AsyncEngine):
         b = cfg.max_num_seqs
         frozen: dict[int, tuple] = {}
         stalled: set[int] = set()
+        satisfied: set[int] = set()
         deficits: dict[int, int] = {}
         needed_max = 1
         live = [i for i, r in enumerate(self.slot_req) if r is not None]
@@ -1054,6 +1276,15 @@ class TPUEngine(AsyncEngine):
         order = sorted(live, key=lambda j: self.slot_req[j].enqueue_t)
         for i in order:
             r = self.slot_req[i]
+            if int(self.disp_seq_lens[i]) >= r.len_cap:
+                # Every token this request may emit is already produced
+                # (the prefill's first token) or covered by an in-flight
+                # window: more decode steps are dead compute. For a
+                # max_tokens=1 burst — the disagg prefill-worker serving
+                # pattern — this slot is only waiting on its first-token
+                # readback, and a dispatched window would delay it.
+                satisfied.add(i)
+                continue
             last_pos = int(self.disp_positions[i]) + M - 1
             # Clamp to the model-length cap AND the request's own length
             # cap: the slot decodes up to its allocated capacity within the
@@ -1095,21 +1326,26 @@ class TPUEngine(AsyncEngine):
             for j in reversed(order[1:]):
                 if freed >= want:
                     break
-                if j in frozen:
+                if j in frozen or j in satisfied:
+                    # A satisfied slot's pages free the moment its
+                    # first-token readback lands — preempting it would
+                    # throw away a finished prefill for pages we get
+                    # back on the next loop pass anyway.
                     continue
                 r_j = self.slot_req[j]
                 want -= deficits.pop(j, 0)  # a victim needs no pages
                 stalled.discard(j)
                 frozen[j] = (r_j, r_j.epoch, "requeue")
                 freed += len(r_j.pages)
-        active_rows = [i for i in live if i not in frozen and i not in stalled]
+        active_rows = [i for i in live if i not in frozen
+                       and i not in stalled and i not in satisfied]
         # A slot frozen at a PREVIOUS dispatch that this dispatch decided
         # to keep (allocation succeeded, or it merely stalls) is live again:
         # cancel the pending preemption records so processing the earlier
         # windows doesn't spuriously requeue or oom-fail it — this
         # dispatch's decision supersedes the previous ones.
         for w in self._inflight:
-            for i in (*active_rows, *stalled):
+            for i in (*active_rows, *stalled, *satisfied):
                 w.frozen.pop(i, None)
         self._dispatch_serial += 1
         if not active_rows:
@@ -1146,16 +1382,24 @@ class TPUEngine(AsyncEngine):
             self.disp_positions[i] += adv
             self.disp_seq_lens[i] += adv
         self._flush_spills()
-        outs = self.runner.decode_window(packed, M)
+        if self.config.spec_decode:
+            outs = self.runner.decode_spec_window(
+                packed, self.spec_m_outer, self.config.spec_k)
+        else:
+            outs = self.runner.decode_window(packed, M)
         for arr in outs:
             try:
                 arr.copy_to_host_async()
             except Exception:  # noqa: BLE001 — not all backends support it
                 pass
         return _Window(toks=outs, slots=slots, frozen=frozen, size=M,
-                       serial=self._dispatch_serial)
+                       serial=self._dispatch_serial,
+                       spec=bool(self.config.spec_decode))
 
     def _process_window(self, w: _Window) -> None:
+        if w.spec and w.toks is not None:
+            self._process_spec_window(w)
+            return
         page = self.config.page_size
         if w.toks is not None:
             toks = np.asarray(w.toks[0])
@@ -1239,6 +1483,91 @@ class TPUEngine(AsyncEngine):
             if finish is not None:
                 self._finish_slot(i, register=True)
 
+    def _process_spec_window(self, w: _Window) -> None:
+        """Host walk for a speculative window: per outer step the device
+        emitted ``e`` tokens (1 + accepted drafts, 0 when frozen); the
+        host appends them in order, applies stop conditions per token,
+        and CORRECTS its dispatch-time position upper bound down to the
+        actual advance (pipelined dispatches assumed the worst case)."""
+        page = self.config.page_size
+        outs = np.asarray(w.toks[0])     # [m, B, S]
+        emits = np.asarray(w.toks[1])    # [m, B]
+        ndrafts = np.asarray(w.toks[2])  # [m, B]
+        self._release_ready_pages()
+        if self._pending_first:
+            need = {i for i, snap in enumerate(w.slots)
+                    if snap is not None and snap[0].last_token is None}
+            need |= {i for i, (fr, _, _) in w.frozen.items()
+                     if fr.last_token is None}
+            if need:
+                self._force_resolve_first_for(need)
+        for i, (fr, fepoch, reason) in w.frozen.items():
+            r = self.slot_req[i]
+            if r is not fr or r is None or r.epoch != fepoch:
+                continue
+            if reason == "oom":
+                r.push(RuntimeError(
+                    "KV pool exhausted and no other request to preempt"))
+                self._finish_slot(i, register=False)
+            else:
+                self._requeue_slot(i)
+        steps = outs.shape[0]
+        for i, snap in enumerate(w.slots):
+            if snap is None:
+                continue
+            r, epoch, start, cap = snap
+            if self.slot_req[i] is not r or r.epoch != epoch:
+                continue
+            if r.ctx.is_killed:
+                r.push(None)
+                self._finish_slot(i, register=True)
+                continue
+            accepted: list[int] = []
+            finish = None
+            inp = r.last_token
+            pos = start
+            for m in range(steps):
+                e = int(emits[m, i])
+                if e == 0:
+                    if pos >= cap:
+                        finish = FinishReason.LENGTH
+                    break
+                nd = int(ndrafts[m, i])
+                if nd:
+                    self.spec_drafts += 1
+                    self.spec_tokens += nd
+                    self.spec_accepted += e - 1
+                for j in range(e):
+                    token = int(outs[m, i, j])
+                    r.generated += 1
+                    new_block = r.blocks.append(inp)
+                    if new_block is not None and not r.no_cache:
+                        page_idx = (len(r.blocks.tokens) // page) - 1
+                        self.allocator.register(r.pages[page_idx],
+                                                new_block)
+                    accepted.append(token)
+                    r.tokens_all.append(token)
+                    inp = token
+                    finish = self._check_finish(r, token)
+                    if finish is not None:
+                        break
+                pos += e
+                if finish is not None:
+                    break
+            r.last_token = inp
+            if finish is None and r.ctx.is_stopped:
+                finish = FinishReason.CANCELLED
+            if finish is None:
+                # Undo the dispatch-time worst-case advance assumption.
+                assumed = min(w.size, max(0, cap - start))
+                delta = assumed - (pos - start)
+                if delta > 0:
+                    self.disp_positions[i] -= delta
+                    self.disp_seq_lens[i] -= delta
+            self._emit(r, accepted, finish, None)
+            if finish is not None:
+                self._finish_slot(i, register=True)
+
     def _check_finish(self, r: _Request, token: int) -> FinishReason | None:
         sc = r.req.stop_conditions
         if r.generated >= (sc.max_tokens or 2**30):
@@ -1297,8 +1626,7 @@ class TPUEngine(AsyncEngine):
         log.warning("KV pool exhausted: preempting slot %d (request %s, "
                     "%d tokens so far) and requeueing", slot, r.ctx.id,
                     len(r.tokens_all))
-        self.waiting.put(r)
-        self.num_waiting += 1
+        self._queue_put(r)
 
     # -- metrics + events -----------------------------------------------------
     def _publish(self) -> None:
@@ -1320,7 +1648,12 @@ class TPUEngine(AsyncEngine):
                 kv_total_blocks=self.allocator.num_pages,
                 gpu_cache_usage_perc=(self.allocator.num_active
                                       / self.allocator.num_pages),
-                gpu_prefix_cache_hit_rate=hit))
+                gpu_prefix_cache_hit_rate=hit),
+            spec_decode_stats=(SpecDecodeStats(
+                num_spec_tokens=self.spec_tokens,
+                num_drafts=self.spec_drafts,
+                num_accepted_tokens=self.spec_accepted)
+                if self.config.spec_decode else None))
 
         async def do_publish():
             try:

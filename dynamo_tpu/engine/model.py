@@ -289,7 +289,10 @@ def ring_causal_attention(mesh, q: jax.Array, k: jax.Array, v: jax.Array,
     operands — same numerics recipe as the dense path. The reference has
     no sequence parallelism at all (SURVEY §2.7); this is a
     beyond-parity capability."""
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map  # jax >= 0.8 home
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
 
     n_shards = mesh.shape["sp"]
     scale = 1.0 / math.sqrt(q.shape[-1])
@@ -750,6 +753,96 @@ def decode_forward(params: Params, spec: ModelSpec,
     x = rms_norm(x, params["final_norm"], spec.rms_norm_eps)
     logits = lm_logits(x, params, spec)
     return logits, k_cache, v_cache
+
+
+def decode_window_multi_step(params: Params, spec: ModelSpec,
+                             k_cache: jax.Array, v_cache: jax.Array,
+                             k_buf: jax.Array, v_buf: jax.Array,
+                             wlen: jax.Array, tokens: jax.Array,
+                             positions: jax.Array, page_table: jax.Array,
+                             hist_lens: jax.Array
+                             ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Speculative-verification step INSIDE a window: S tokens per slot
+    (the chained token + S-1 n-gram drafts) forwarded TOGETHER — one
+    weight read verifies S positions, which is the whole point of
+    speculative decoding on an HBM-bound decode (SURVEY §5.7; reference
+    delegates spec decode to its engines, protocols.rs:32-56 stats).
+
+    tokens/positions [B,S]; wlen [B] = valid columns already committed to
+    the in-window buffer k_buf/v_buf [L,Nkv,B,W,D]; hist_lens [B] =
+    cache-resident tokens. Attention per query j: paged history +
+    window-buffer cols < wlen + in-block causal (cols <= j).
+    Returns (logits [B,S,V], k_new, v_new [L,B,S,Nkv,D])."""
+    b, s = tokens.shape
+    d = spec.head_dim
+    nkv = spec.num_kv_heads
+    page = k_cache.shape[3]
+    maxp = page_table.shape[1]
+    W = k_buf.shape[3]
+    x = embed_lookup(params["embed"], tokens)          # [B,S,H]
+    cos, sin = rope_tables(positions, d, spec.rope_theta)
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    L = spec.num_layers
+
+    def layer_fn(x, scan_in):
+        lp, layer, kb_l, vb_l = scan_in                # kb_l [Nkv,B,W,D]
+        h = rms_norm(x, lp["input_norm"], spec.rms_norm_eps)
+        q = mm(h, lp["wq"], "bsh,hd->bsd")
+        k = mm(h, lp["wk"], "bsh,hd->bsd")
+        v = mm(h, lp["wv"], "bsh,hd->bsd")
+        if spec.qkv_bias:
+            q = q + lp["bq"]
+            k = k + lp["bk"]
+            v = v + lp["bv"]
+        q = _split_heads(q, spec.num_heads, d)         # [B,S,Nh,D]
+        k = _split_heads(k, nkv, d)                    # [B,S,Nkv,D]
+        v = _split_heads(v, nkv, d)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        qg = q.reshape(b, s, nkv, spec.q_per_kv, d)
+        # Paged history (layer-folded gather, same as the window impl).
+        idx_l = jnp.broadcast_to(layer, page_table.shape)
+        k_all = (k_cache[idx_l, :, page_table]
+                 .transpose(2, 0, 1, 3, 4).reshape(nkv, b, maxp * page, d))
+        v_all = (v_cache[idx_l, :, page_table]
+                 .transpose(2, 0, 1, 3, 4).reshape(nkv, b, maxp * page, d))
+        s_hist = jnp.einsum("bsngd,nbld->bnsgl", qg, k_all,
+                            preferred_element_type=jnp.float32) * scale
+        lpos = jnp.arange(maxp * page)[None, :]
+        s_hist = jnp.where(
+            (lpos < hist_lens[:, None])[:, None, None, None, :],
+            s_hist, -1e30)
+        # This window's committed columns (< wlen per slot).
+        s_win = jnp.einsum("bsngd,nbjd->bnsgj", qg, kb_l,
+                           preferred_element_type=jnp.float32) * scale
+        wvalid = (jnp.arange(W)[None, :]
+                  < wlen[:, None])[:, None, None, None, :]
+        s_win = jnp.where(jnp.broadcast_to(wvalid, s_win.shape),
+                          s_win, -1e30)
+        # In-block causal among the S verify tokens.
+        s_blk = jnp.einsum("bsngd,btnd->bnsgt", qg, k,
+                           preferred_element_type=jnp.float32) * scale
+        causal = (jnp.arange(s)[:, None] >= jnp.arange(s)[None, :])
+        s_blk = jnp.where(causal[None, None, :, None, :], s_blk, -1e30)
+        full = jnp.concatenate([s_hist, s_win, s_blk], axis=-1)
+        probs = jax.nn.softmax(full, axis=-1)
+        p_hist = probs[..., :maxp * page].astype(q.dtype)
+        p_win = probs[..., maxp * page:maxp * page + W].astype(q.dtype)
+        p_blk = probs[..., maxp * page + W:].astype(q.dtype)
+        out = (jnp.einsum("bnsgl,nbld->bsngd", p_hist, v_all)
+               + jnp.einsum("bnsgj,nbjd->bsngd", p_win, vb_l)
+               + jnp.einsum("bnsgt,btnd->bsngd", p_blk, v))
+        attn = out.reshape(b, s, -1)
+        x = x + mm(attn, lp["wo"], "bsd,dh->bsh")
+        h2 = rms_norm(x, lp["post_attn_norm"], spec.rms_norm_eps)
+        x = x + ffn_block(h2, lp, spec)
+        return x, (k, v)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer_fn, x, (params["layers"], jnp.arange(L), k_buf, v_buf))
+    x = rms_norm(x, params["final_norm"], spec.rms_norm_eps)
+    logits = lm_logits(x.reshape(b * s, -1), params, spec)
+    return logits.reshape(b, s, -1), k_new, v_new
 
 
 def embed_forward(params: Params, spec: ModelSpec, tokens: jax.Array,

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -241,6 +242,24 @@ class ModelRunner:
         self.tokens_dev = _mh_zeros(
             (config.max_num_seqs,), jnp.int32,
             NamedSharding(self.mesh, P()))
+        # Speculative decoding (config.spec_decode="ngram"): the full
+        # per-slot token history rides ON DEVICE — hist_dev feeds the
+        # in-graph n-gram draft lookup, positions_dev chains the
+        # DATA-DEPENDENT sequence position between pipelined spec
+        # windows (the host can't know how many drafts were accepted in
+        # a window it hasn't processed yet, so device state is the only
+        # correct source). Allocated lazily: plain serving never pays.
+        self.hist_dev = None
+        self.positions_dev = None
+        if config.spec_decode:
+            hist_w = config.max_pages_per_seq * config.page_size
+            self.hist_dev = _mh_zeros(
+                (config.max_num_seqs, hist_w), jnp.int32,
+                NamedSharding(self.mesh, P()))
+            self.positions_dev = _mh_zeros(
+                (config.max_num_seqs,), jnp.int32,
+                NamedSharding(self.mesh, P()))
+        self._seed_hist_cache: dict = {}
         # Per-slot generated-token counts [slots, vocab] for OpenAI
         # frequency/presence penalties (vLLM semantics: output tokens
         # only). uint8 with saturation at 255; read ONLY by the penalized
@@ -372,7 +391,8 @@ class ModelRunner:
                 logits, k_cache, v_cache = _prefill_with_history(
                     params, spec, k_cache, v_cache, tokens, positions,
                     page_table, seq_lens, hist_table, hist_lens,
-                    self._attention_impl, sp_shard=sp_shard)
+                    self._attention_impl, sp_shard=sp_shard,
+                    x_embeds=emb, embeds_mask=emb_mask)
             elif pipelined:
                 from dynamo_tpu.engine.model import (
                     prefill_forward_pipelined)
@@ -583,6 +603,202 @@ class ModelRunner:
         self._window_cache[key] = fn
         return fn
 
+    def _get_spec_window(self, m_outer: int, k: int, bucket_pages: int):
+        """Speculative window program: m_outer verify steps, each
+        drafting up to ``k`` tokens by bigram prompt-lookup against the
+        ON-DEVICE token history and verifying them in one forward
+        (model.decode_window_multi_step). Sequence position is carried in
+        positions_dev between windows — the advance is data-dependent
+        (accepted drafts), so pipelined dispatches must chain on-device.
+        Greedy only (argmax); the engine rejects stochastic sampling
+        while spec decode is enabled."""
+        key = ("spec", m_outer, k, bucket_pages)
+        fn = self._window_cache.get(key)
+        if fn is not None:
+            return fn
+        spec = self.spec
+        page = self.config.page_size
+        S = k + 1
+        W = m_outer * S  # in-window KV columns (worst case: all accepted)
+
+        def run_spec(params, k_cache, v_cache, tokens_dev, hist_dev,
+                     positions_dev, packed):
+            from dynamo_tpu.engine.model import decode_window_multi_step
+            override = packed[:, PK_OVERRIDE] > 0
+            tokens0 = jnp.where(override, packed[:, PK_TOKEN], tokens_dev)
+            pos0 = jnp.where(override, packed[:, PK_POS], positions_dev)
+            active = packed[:, PK_SEQLEN] > 0
+            cap = packed[:, PK_CAP]
+            page_table = packed[:, PK_PREFIX:]
+            B = tokens0.shape[0]
+            H = hist_dev.shape[1]
+            L, nkv, d = spec.num_layers, spec.num_kv_heads, spec.head_dim
+            b_idx = jnp.arange(B)
+            kbuf0 = jnp.zeros((L, nkv, B, W, d), k_cache.dtype)
+            vbuf0 = jnp.zeros((L, nkv, B, W, d), v_cache.dtype)
+
+            def step(carry, _):
+                tokens, pos, wlen, hist, kbuf, vbuf = carry
+                live = active & (pos < cap)
+                safe_pos = jnp.clip(pos, 0, H - 1)
+                # Invariant: hist[pos] = the token being fed this step.
+                hist = hist.at[b_idx, safe_pos].set(
+                    jnp.where(live, tokens, hist[b_idx, safe_pos]))
+                # Bigram prompt-lookup: most recent earlier occurrence of
+                # (hist[pos-1], tokens); drafts = what followed it.
+                x1 = hist[b_idx, jnp.clip(pos - 1, 0, H - 1)]
+                jidx = jnp.arange(H - 1)
+                match = ((hist[:, :-1] == x1[:, None])
+                         & (hist[:, 1:] == tokens[:, None])
+                         & (jidx[None, :] + 1 < pos[:, None]))
+                jstar = jnp.max(jnp.where(match, jidx[None, :], -1), axis=1)
+                found = (jstar >= 0) & (pos >= 1) & live
+                didx = jstar[:, None] + 2 + jnp.arange(k)[None, :]  # [B,k]
+                drafts = hist[b_idx[:, None], jnp.clip(didx, 0, H - 1)]
+                dvalid = (found[:, None]
+                          & (didx <= pos[:, None])
+                          & (pos[:, None] + 1 + jnp.arange(k)[None, :]
+                             < cap[:, None]))
+                # Draft validity must be a prefix (cumulative AND).
+                dvalid = jnp.cumprod(
+                    dvalid.astype(jnp.int32), axis=1).astype(bool)
+                ndraft = dvalid.sum(axis=1)
+                tok_blk = jnp.concatenate(
+                    [tokens[:, None], jnp.where(dvalid, drafts, 0)], axis=1)
+                pos_blk = pos[:, None] + jnp.arange(S)[None, :]
+                # Cache-resident history is FIXED across the window
+                # (pos0): everything this window produced lives in
+                # kbuf/vbuf cols < wlen, and the pool pages for those
+                # positions hold garbage until the post-scan commit.
+                logits, k_new, v_new = decode_window_multi_step(
+                    params, spec, k_cache, v_cache, kbuf, vbuf, wlen,
+                    tok_blk, pos_blk, page_table, hist_lens=pos0)
+                out = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B,S]
+                eq = (drafts == out[:, :k]) & dvalid
+                accflags = jnp.cumprod(
+                    eq.astype(jnp.int32), axis=1).astype(bool)
+                a = accflags.sum(axis=1)              # accepted drafts
+                e = jnp.where(live, a + 1, 0)         # emitted / advance
+                # Commit t0 + accepted drafts (block cols < e) into the
+                # window buffer at cols wlen..wlen+e-1; invalid -> W
+                # (dropped). k_new [L,B,S,Nkv,D] -> kbuf [L,Nkv,B,W,D].
+                cols = wlen[:, None] + jnp.arange(S)[None, :]
+                kvvalid = jnp.arange(S)[None, :] < e[:, None]
+                cols = jnp.where(kvvalid, cols, W)
+                kn = k_new.transpose(0, 3, 1, 2, 4)   # [L,Nkv,B,S,D]
+                vn = v_new.transpose(0, 3, 1, 2, 4)
+                kbuf = kbuf.at[:, :, b_idx[:, None], cols].set(
+                    kn, mode="drop")
+                vbuf = vbuf.at[:, :, b_idx[:, None], cols].set(
+                    vn, mode="drop")
+                # History gains every emitted token out[0..a] at pos+1+j.
+                hidx = pos[:, None] + 1 + jnp.arange(S)[None, :]
+                hidx = jnp.where(kvvalid & (hidx < H), hidx, H)
+                hist = hist.at[b_idx[:, None], hidx].set(out, mode="drop")
+                tokens = jnp.where(live, out[b_idx, a], tokens)
+                pos = pos + e
+                wlen = wlen + e
+                # Emit e (not a): e == 0 distinguishes a frozen/inactive
+                # slot from "zero drafts accepted" (e == 1) — the host
+                # walk needs that to mirror the in-graph freeze.
+                return (tokens, pos, wlen, hist, kbuf, vbuf), (
+                    out, e.astype(jnp.int32), ndraft.astype(jnp.int32))
+
+            carry0 = (tokens0, pos0, jnp.zeros((B,), jnp.int32), hist_dev,
+                      kbuf0, vbuf0)
+            (tokens, pos, wlen, hist, kbuf, vbuf), (outs, emits, ndrafts) = \
+                jax.lax.scan(step, carry0, jnp.arange(m_outer))
+            # Commit the window buffer: col c holds the token at absolute
+            # position pos0 + c; cols >= wlen land on scratch page 0.
+            c_idx = jnp.broadcast_to(jnp.arange(W)[None, :], (B, W))
+            abspos = pos0[:, None] + c_idx
+            valid = c_idx < wlen[:, None]
+            pidx = jnp.clip(abspos // page, 0, page_table.shape[1] - 1)
+            dest = jnp.take_along_axis(page_table, pidx, axis=1)
+            dest = jnp.where(valid, dest, 0)
+            off = jnp.where(valid, abspos % page, 0)
+            k_cache = k_cache.at[:, :, dest, off].set(kbuf)
+            v_cache = v_cache.at[:, :, dest, off].set(vbuf)
+            return (outs, emits, ndrafts, tokens, pos, hist,
+                    k_cache, v_cache)
+
+        fn = jax.jit(run_spec, donate_argnums=(1, 2, 4))
+        self._window_cache[key] = fn
+        return fn
+
+    def decode_spec_window(self, packed: np.ndarray, m_outer: int, k: int):
+        """Dispatch one speculative window (m_outer verify steps x up to
+        k drafts each). Returns (toks [m_outer,B,k+1], accs [m_outer,B],
+        ndrafts [m_outer,B]) device arrays; positions/tokens/history
+        chain on-device (see _get_spec_window)."""
+        bucket_pages = packed.shape[1] - PK_PREFIX
+        fn = self._get_spec_window(m_outer, k, bucket_pages)
+        with self.mesh:
+            (outs, accs, ndrafts, self.tokens_dev, self.positions_dev,
+             self.hist_dev, self.k_cache, self.v_cache) = fn(
+                self.params, self.k_cache, self.v_cache, self.tokens_dev,
+                self.hist_dev, self.positions_dev, jnp.asarray(packed))
+        return outs, accs, ndrafts
+
+    def seed_history(self, entries: list[tuple]) -> None:
+        """Scatter prefill-chunk tokens into the on-device history +
+        position buffers (spec decode only; no-op otherwise). Entries:
+        (slot, tokens_np, start_pos, final, first_token) — ``final``
+        rows also record the chained sampled token (from tokens_dev,
+        or ``first_token`` >= 0 for paths that know it host-side, e.g.
+        KV-injected disagg decode) and set positions_dev."""
+        if self.hist_dev is None or not entries:
+            return
+        n_max = max(len(t) for _, t, _, _, _ in entries)
+        bucket = 64  # pow2 buckets; full prompts can exceed prefill buckets
+        while bucket < n_max:
+            bucket *= 2
+        bp = 1
+        while bp < len(entries):
+            bp *= 2
+        toks = np.zeros((bp, bucket), np.int32)
+        meta = np.zeros((bp, 4), np.int32)  # slot, start, len, final_tok
+        meta[:, 3] = -2  # inactive rows
+        for i, (slot, t, start, final, first_tok) in enumerate(entries):
+            toks[i, :len(t)] = t
+            meta[i] = (slot, start, len(t),
+                       (first_tok if final and first_tok is not None
+                        else (-1 if final else -2)))
+        key = ("seedh", bucket, bp)
+        fn = self._seed_hist_cache.get(key)
+        if fn is None:
+            H = self.hist_dev.shape[1]
+
+            def scatter(hist, pos_dev, tokens_dev, toks, meta):
+                slots = meta[:, 0]
+                starts = meta[:, 1]
+                lens = meta[:, 2]
+                ftok = meta[:, 3]
+                idx = starts[:, None] + jnp.arange(bucket)[None, :]
+                ok = ((jnp.arange(bucket)[None, :] < lens[:, None])
+                      & (idx < H))  # padding rows have lens == 0
+                idx = jnp.where(ok, idx, H)
+                hist = hist.at[slots[:, None], idx].set(toks, mode="drop")
+                # Final rows: the sampled token sits at start+len and
+                # becomes the slot's next fed position. Non-final and
+                # inactive rows scatter to dropped (out-of-range)
+                # indices — duplicate in-range indices across rows would
+                # have unspecified write order.
+                final = ftok >= -1
+                fpos = jnp.where(final, starts + lens, H)
+                fval = jnp.where(ftok >= 0, ftok, tokens_dev[slots])
+                hist = hist.at[slots, fpos].set(fval, mode="drop")
+                pslot = jnp.where(final, slots, pos_dev.shape[0])
+                pos_dev = pos_dev.at[pslot].set(starts + lens, mode="drop")
+                return hist, pos_dev
+
+            fn = jax.jit(scatter, donate_argnums=(0, 1))
+            self._seed_hist_cache[key] = fn
+        with self.mesh:
+            self.hist_dev, self.positions_dev = fn(
+                self.hist_dev, self.positions_dev, self.tokens_dev,
+                jnp.asarray(toks), jnp.asarray(meta))
+
     # -- public API (blocking; called from the engine thread) -----------------
     def prefill_batch(self, seqs: list[PrefillSeq],
                       slots: list[int] | None = None,
@@ -644,11 +860,6 @@ class ModelRunner:
         penalized = count_rows is not None
         seeded = any(s.seed is not None for s in seqs)
         with_embeds = any(s.embeds is not None for s in seqs)
-        if with_embeds and with_history:
-            raise ValueError(
-                "a multimodal span crosses a prefill-chunk boundary "
-                "(embedding injection supports history-free chunks); "
-                "size prefill_buckets so media spans fit one chunk")
         kw = {}
         if with_embeds:
             import ml_dtypes
@@ -851,6 +1062,28 @@ class ModelRunner:
             b *= 2
         return b
 
+    def d2h_fetch_floor_ms(self) -> float:
+        """Measured per-fetch device->host latency floor (cached probe).
+        Local attachments: ~0.1 ms. Tunneled chips: ~100 ms — there,
+        SPLITTING an extract into pipelined page groups is
+        counterproductive (each group pays the floor; measured 0.21x on
+        the dev tunnel, profile_kv_transfer.py), so extract grouping
+        gates on this number."""
+        if getattr(self, "_d2h_floor_ms", None) is None:
+            with self.mesh:
+                arr = jnp.arange(256, dtype=jnp.int32)
+            np.asarray(arr)  # warm any lazy init
+            best = float("inf")
+            for i in range(3):
+                with self.mesh:
+                    a = jnp.full((256,), i, jnp.int32)
+                a.block_until_ready()
+                t0 = time.monotonic()
+                np.asarray(a)
+                best = min(best, (time.monotonic() - t0) * 1e3)
+            self._d2h_floor_ms = best
+        return self._d2h_floor_ms
+
     def extract_pages_async(self, pages: list[int]):
         """Dispatch the page gather and start the device->host copy WITHOUT
         blocking (offload path: the extract is stream-ordered before any
@@ -966,9 +1199,13 @@ def _replicate_kv_heads(params, spec, rep: int):
 
 def _prefill_with_history(params, spec, k_cache, v_cache, tokens, positions,
                           page_table, seq_lens, hist_table, hist_lens,
-                          attention_impl, sp_shard: bool = False):
+                          attention_impl, sp_shard: bool = False,
+                          x_embeds=None, embeds_mask=None):
     """Chunked prefill: like prefill_forward but queries also attend to the
-    sequence's earlier pages (read via the paged path)."""
+    sequence's earlier pages (read via the paged path). x_embeds/embeds_mask
+    override token embeddings under multimodal media spans (rows are
+    chunk-relative), so media anywhere in a long prompt — not just the
+    first chunk — injects correctly."""
     import jax
     import jax.numpy as jnp
     from dynamo_tpu.engine.model import (
@@ -981,6 +1218,8 @@ def _prefill_with_history(params, spec, k_cache, v_cache, tokens, positions,
     page = k_cache.shape[3]
     L = spec.num_layers
     x = embed_lookup(params["embed"], tokens)
+    if x_embeds is not None:
+        x = jnp.where(embeds_mask[..., None], x_embeds.astype(x.dtype), x)
     if sp_shard:
         x = jax.lax.with_sharding_constraint(x, P(None, "sp", None))
     cos, sin = rope_tables(positions, d, spec.rope_theta)

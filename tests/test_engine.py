@@ -329,3 +329,116 @@ async def test_warmup_windows_precompiles_and_serves():
         assert calls[4] == ("prefill", None)
     finally:
         eng.stop()
+
+
+@async_test
+async def test_prefill_only_burst_dispatches_no_decode_windows():
+    """A burst of max_tokens=1 requests — the disaggregated prefill
+    worker's serving pattern (reference vllm handlers.py:167-199) — must
+    be served by prefill alone: the first token is produced by the
+    prefill program, so dispatching decode windows for these slots is
+    dead compute that delays the first-token readback (round-4 bench
+    regression: prefill_tok_s collapsed 52x when windows were
+    dispatched for satisfied slots)."""
+    eng = TPUEngine(tiny_config(max_num_seqs=8))
+    eng.start()
+    try:
+        rng = np.random.default_rng(11)
+
+        async def one():
+            prompt = rng.integers(0, SPEC.vocab_size, size=24).tolist()
+            return await collect(eng, prompt, 1)
+
+        # Land one normal request first so the engine is fully warm and
+        # step_count reflects only the burst below.
+        got, finish = await one()
+        assert finish == "length" and len(got) == 1
+        while eng._inflight or eng._pending_first:
+            await asyncio.sleep(0.01)
+        steps_before = eng.step_count
+        results = await asyncio.gather(*[one() for _ in range(8)])
+        for got, finish in results:
+            assert finish == "length" and len(got) == 1
+        assert eng.step_count == steps_before, (
+            "decode windows were dispatched for max_tokens=1 slots")
+    finally:
+        eng.stop()
+
+
+@async_test
+async def test_prefill_only_mixed_with_decode(engine):
+    """max_tokens=1 requests sharing the engine with a decoding request
+    neither stall it nor are stalled by it."""
+    rng = np.random.default_rng(12)
+    long_prompt = rng.integers(0, SPEC.vocab_size, size=20).tolist()
+    short = [rng.integers(0, SPEC.vocab_size, size=20).tolist()
+             for _ in range(3)]
+    results = await asyncio.gather(
+        collect(engine, long_prompt, 24),
+        *[collect(engine, p, 1) for p in short])
+    got, finish = results[0]
+    assert finish == "length" and len(got) == 24
+    for got, finish in results[1:]:
+        assert finish == "length" and len(got) == 1
+
+
+@async_test
+async def test_sla_admission_defers_over_budget():
+    """With a TTFT budget set, admission serializes cold prefills so the
+    projected backlog stays inside the budget (an over-budget head still
+    admits when nothing is cold in flight — no starvation), and every
+    request still completes."""
+    eng = TPUEngine(tiny_config(ttft_budget_ms=1.0, max_num_seqs=4))
+    # Pre-seed the measured rate: the gate is calibration-dependent and
+    # the first pass would otherwise admit everything at once.
+    eng.prefill_rate_tok_s = 1.0
+    eng.start()
+    try:
+        rng = np.random.default_rng(21)
+
+        async def one():
+            prompt = rng.integers(0, SPEC.vocab_size, size=24).tolist()
+            return await collect(eng, prompt, 2)
+
+        results = await asyncio.gather(*[one() for _ in range(6)])
+        for got, finish in results:
+            assert finish == "length" and len(got) == 2
+        assert eng.admission_deferred > 0, (
+            "the SLA gate never deferred a request under a 1 ms budget")
+        assert eng._cold_inflight == 0 and eng._waiting_cold == 0
+    finally:
+        eng.stop()
+
+
+@async_test
+async def test_sla_admission_disabled_never_defers(engine):
+    rng = np.random.default_rng(22)
+    before = engine.admission_deferred
+    prompts = [rng.integers(0, SPEC.vocab_size, size=24).tolist()
+               for _ in range(4)]
+    await asyncio.gather(*[collect(engine, p, 2) for p in prompts])
+    assert engine.admission_deferred == before
+
+
+@async_test
+async def test_sla_rejection_503():
+    """With admission_reject_factor set, a request whose projected TTFT
+    through the backlog exceeds budget x factor raises OverloadedError
+    (HTTP 503 at the frontend) instead of queueing unboundedly."""
+    from dynamo_tpu.runtime.errors import OverloadedError
+    eng = TPUEngine(tiny_config(ttft_budget_ms=100.0,
+                                admission_reject_factor=1.0))
+    eng.prefill_rate_tok_s = 1000.0
+    eng._waiting_cold = 5000  # 5 s of backlog against a 100 ms budget
+    rng = np.random.default_rng(23)
+    prompt = rng.integers(0, SPEC.vocab_size, size=24).tolist()
+    try:
+        with pytest.raises(OverloadedError):
+            await collect(eng, prompt, 2)
+        assert eng.estimated_ttft_ms() is not None
+        assert eng.estimated_ttft_ms() > 100.0
+        eng._waiting_cold = 0  # backlog drained -> serves normally
+        got, finish = await collect(eng, prompt, 2)
+        assert finish == "length" and len(got) == 2
+    finally:
+        eng.stop()
