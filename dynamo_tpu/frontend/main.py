@@ -38,6 +38,11 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument("--grpc-port", type=int, default=None,
                         help="also serve the KServe v2 gRPC inference "
                              "service on this port")
+    parser.add_argument("--tls-cert-path", default=None,
+                        help="serve HTTPS with this certificate chain "
+                             "(reference frontend TLS flags; needs "
+                             "--tls-key-path too)")
+    parser.add_argument("--tls-key-path", default=None)
     return parser.parse_args(argv)
 
 
@@ -62,7 +67,9 @@ async def run(args: argparse.Namespace) -> None:
     watcher = ModelWatcher(runtime, manager, router_mode=args.router_mode,
                            kv_router_factory=kv_router_factory)
     await watcher.start()
-    service = HttpService(runtime, manager, args.http_host, args.http_port)
+    service = HttpService(runtime, manager, args.http_host, args.http_port,
+                          tls_cert_path=args.tls_cert_path,
+                          tls_key_path=args.tls_key_path)
     await service.start()
     grpc_server = None
     if args.grpc_port is not None:

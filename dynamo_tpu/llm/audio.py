@@ -118,6 +118,14 @@ class AudioEncoderSpec:
     num_layers: int = 2
     num_heads: int = 4
     downsample: int = 4  # frames per output embedding (2 conv stride-2)
+    # "native": the TPU-first bf16 encoder below. "whisper": the exact
+    # OpenAI Whisper encoder architecture (conv1 stride 1 + conv2 stride
+    # 2, LayerNorm with bias, biased q/v/out/fc projections, concat
+    # sin|cos positions, ln_post), run in fp32 — weights converted from
+    # a real Whisper checkpoint by scripts/convert_whisper_encoder.py
+    # compute the true Whisper encoding (golden-tested against the HF
+    # implementation).
+    arch: str = "native"
 
 
 class AudioEncoder:
@@ -135,6 +143,7 @@ class AudioEncoder:
 
         self.spec = spec or AudioEncoderSpec()
         self.llm_hidden = llm_hidden
+        self.untrained = not weights_path  # surfaced in API responses
         if weights_path:
             self.params = self._load(weights_path)
         else:
@@ -177,8 +186,43 @@ class AudioEncoder:
         import ml_dtypes
 
         with safe_open(path, framework="numpy") as fh:
-            flat = {k: fh.get_tensor(k).astype(ml_dtypes.bfloat16)
-                    for k in fh.keys()}
+            raw = {k: fh.get_tensor(k) for k in fh.keys()}
+        if any(k.startswith("whisper.") for k in raw):
+            # Converted Whisper checkpoint: fp32, exact architecture.
+            # meta[1] (when present) records whether the llm projection
+            # is trained/lossless; a RANDOM projector still produces
+            # babble and must keep the API warning.
+            meta = raw["whisper.meta"]
+            if len(meta) > 1 and not int(meta[1]):
+                self.untrained = True
+            proj = raw["whisper.proj"]
+            if proj.shape[1] != self.llm_hidden:
+                raise ValueError(
+                    f"checkpoint projects to {proj.shape[1]}, model "
+                    f"hidden is {self.llm_hidden}: re-run "
+                    f"convert_whisper_encoder.py with --llm-hidden "
+                    f"{self.llm_hidden}")
+            self.spec = dataclasses.replace(
+                self.spec, arch="whisper",
+                n_mels=raw["whisper.conv1.w"].shape[0] // 3,
+                d_model=raw["whisper.conv1.w"].shape[1],
+                num_layers=max(
+                    int(k.split(".")[2]) + 1 for k in raw
+                    if k.startswith("whisper.layers.")),
+                num_heads=int(raw["whisper.meta"][0]),
+                downsample=2)
+            f32 = {k: v.astype(np.float32) for k, v in raw.items()}
+            params = {k[len("whisper."):]: f32[k] for k in f32
+                      if not k.startswith("whisper.layers.")
+                      and k != "whisper.meta"}
+            params["layers"] = []
+            for i in range(self.spec.num_layers):
+                pre = f"whisper.layers.{i}."
+                params["layers"].append(
+                    {k[len(pre):]: f32[k] for k in f32
+                     if k.startswith(pre)})
+            return params
+        flat = {k: v.astype(ml_dtypes.bfloat16) for k, v in raw.items()}
         params = {"conv1": flat["conv1"], "conv2": flat["conv2"],
                   "proj": flat["proj"], "layers": []}
         i = 0
@@ -189,9 +233,60 @@ class AudioEncoder:
             i += 1
         return params
 
+    def _forward_whisper(self, params, mel):
+        """Exact Whisper encoder forward (fp32): gelu(conv1 s1) ->
+        gelu(conv2 s2) -> +sinusoid positions -> pre-norm blocks with
+        biased q/v/out/fc projections (k unbiased, q scaled) -> ln_post
+        -> llm projection. Golden-tested against the HF implementation
+        (tests/test_audio.py)."""
+        import jax
+        import jax.numpy as jnp
+
+        s = self.spec
+        nh = s.num_heads
+        d = s.d_model
+        hd = d // nh
+
+        def conv(x, w, b, cin, stride):
+            t_out = x.shape[0] // stride
+            xp = jnp.pad(x, ((1, 1), (0, 0)))
+            idx0 = jnp.arange(t_out) * stride
+            win = jnp.stack([xp[idx0], xp[idx0 + 1], xp[idx0 + 2]],
+                            axis=1)                       # [t, 3, cin]
+            return jax.nn.gelu(win.reshape(t_out, 3 * cin) @ w + b)
+
+        def ln(h, w, b):
+            m = h.mean(-1, keepdims=True)
+            v = ((h - m) ** 2).mean(-1, keepdims=True)
+            return (h - m) * jax.lax.rsqrt(v + 1e-5) * w + b
+
+        x = conv(mel.astype(jnp.float32), params["conv1.w"],
+                 params["conv1.b"], s.n_mels, 1)
+        x = conv(x, params["conv2.w"], params["conv2.b"], d, 2)
+        t = x.shape[0]
+        x = x + params["pos"][:t]
+        for lp in params["layers"]:
+            h = ln(x, lp["ln1.w"], lp["ln1.b"])
+            q = ((h @ lp["wq"] + lp["bq"]) * (hd ** -0.5)) \
+                .reshape(t, nh, hd)
+            k = (h @ lp["wk"]).reshape(t, nh, hd)
+            v = (h @ lp["wv"] + lp["bv"]).reshape(t, nh, hd)
+            scores = jnp.einsum("qnd,knd->nqk", q, k)
+            probs = jax.nn.softmax(scores, axis=-1)
+            attn = jnp.einsum("nqk,knd->qnd", probs, v).reshape(t, d)
+            x = x + (attn @ lp["wo"] + lp["bo"])
+            h2 = ln(x, lp["ln2.w"], lp["ln2.b"])
+            x = x + (jax.nn.gelu(h2 @ lp["w1"] + lp["b1"])
+                     @ lp["w2"] + lp["b2"])
+        x = ln(x, params["ln_post.w"], params["ln_post.b"])
+        return (x @ params["proj"]).astype(jnp.float32)
+
     def _forward(self, params, mel):
         import jax
         import jax.numpy as jnp
+
+        if self.spec.arch == "whisper":
+            return self._forward_whisper(params, mel)
 
         s = self.spec
         d = s.d_model

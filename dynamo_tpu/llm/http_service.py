@@ -26,7 +26,8 @@ from dynamo_tpu.llm.protocols import (
     usage_block,
 )
 from dynamo_tpu.runtime.context import Context
-from dynamo_tpu.runtime.errors import NoInstancesError, OverloadedError
+from dynamo_tpu.runtime.errors import (InvalidRequestError, NoInstancesError,
+                                       OverloadedError)
 from dynamo_tpu.runtime.logging import get_logger, parse_traceparent
 
 log = get_logger("http")
@@ -65,10 +66,17 @@ def _error_body(message: str, err_type: str = "invalid_request_error",
 
 class HttpService:
     def __init__(self, runtime, manager: ModelManager,
-                 host: str = "0.0.0.0", port: int = 8000):
+                 host: str = "0.0.0.0", port: int = 8000,
+                 tls_cert_path: str | None = None,
+                 tls_key_path: str | None = None):
         self._runtime = runtime
         self.manager = manager
         self.host, self.port = host, port
+        # TLS (reference frontend main.py --tls-cert-path/--tls-key-path):
+        # both paths -> serve HTTPS; one without the other is a config
+        # error surfaced at start().
+        self.tls_cert_path = tls_cert_path
+        self.tls_key_path = tls_key_path
         self._runner: web.AppRunner | None = None
         metrics = runtime.metrics.namespace("http")
         self._m_requests = metrics.counter(
@@ -99,10 +107,20 @@ class HttpService:
         app.router.add_get("/metrics", self._metrics)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.host, self.port)
+        ssl_ctx = None
+        if self.tls_cert_path or self.tls_key_path:
+            if not (self.tls_cert_path and self.tls_key_path):
+                raise ValueError(
+                    "TLS needs BOTH tls_cert_path and tls_key_path")
+            import ssl
+            ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ssl_ctx.load_cert_chain(self.tls_cert_path, self.tls_key_path)
+        site = web.TCPSite(self._runner, self.host, self.port,
+                           ssl_context=ssl_ctx)
         await site.start()
         self.port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
-        log.info("OpenAI HTTP service on %s:%d", self.host, self.port)
+        log.info("OpenAI %s service on %s:%d",
+                 "HTTPS" if ssl_ctx else "HTTP", self.host, self.port)
 
     async def stop(self) -> None:
         if self._runner:
@@ -187,6 +205,12 @@ class HttpService:
             except OverloadedError as exc:
                 self._m_requests.inc(route=route, status="503")
                 return _error_body(str(exc), "overloaded", 503)
+            except (ValueError, InvalidRequestError) as exc:
+                # Engine-level request validation (unsupported sampling
+                # features, over-length prompts): the caller's fault —
+                # whether raised in-process or typed over the wire.
+                self._m_requests.inc(route=route, status="400")
+                return _error_body(str(exc))
             except Exception as exc:  # noqa: BLE001
                 log.exception("chat handler failed")
                 self._m_requests.inc(route=route, status="500")
@@ -380,7 +404,17 @@ class HttpService:
                 cache = self._audio_encoders = {}
             encoder = cache.get((model, hidden))
             if encoder is None:
-                encoder = cache[(model, hidden)] = AudioEncoder(hidden)
+                # Trained weights: card runtime extras or env override
+                # (scripts/convert_whisper_encoder.py produces the
+                # checkpoint). Without them the encoder is DETERMINISTIC
+                # RANDOM INIT — the route works end to end but emits
+                # model babble, flagged in the response.
+                import os as _os
+                weights = (_os.environ.get("DTPU_AUDIO_ENCODER_WEIGHTS")
+                           or (served.entry.card.runtime_config.extra
+                               or {}).get("audio_encoder_weights"))
+                encoder = cache[(model, hidden)] = AudioEncoder(
+                    hidden, weights_path=weights)
             span, n_audio = embed_audio(wav, encoder)
             tokenizer = served.preprocessor.tokenizer
             prompt_tokens = tokenizer.encode(
@@ -408,12 +442,18 @@ class HttpService:
                 self._m_requests.inc(route=route, status="503")
                 return _error_body(str(exc), "service_unavailable", 503)
             self._m_requests.inc(route=route, status="200")
-            return web.json_response({
+            resp = {
                 "text": tokenizer.decode(toks),
                 "usage": {"input_tokens": len(req.token_ids),
                           "output_tokens": len(toks),
                           "audio_tokens": n_audio},
-            })
+            }
+            if getattr(encoder, "untrained", False):
+                resp["warnings"] = [
+                    "audio encoder is random-init (no "
+                    "audio_encoder_weights configured): output is not a "
+                    "real transcription"]
+            return web.json_response(resp)
         except Exception as exc:  # noqa: BLE001
             log.exception("transcriptions handler failed")
             self._m_requests.inc(route=route, status="500")

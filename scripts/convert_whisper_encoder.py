@@ -1,0 +1,136 @@
+"""Convert a Whisper encoder checkpoint into the AudioEncoder layout.
+
+Role parity with the reference's multimodal examples (examples/multimodal:
+encoder checkpoints feed the LLM's prompt-embedding path): takes a local
+HF Whisper model (e.g. openai/whisper-tiny already on disk — this
+environment has no network egress) and writes a safetensors file that
+``llm/audio.py AudioEncoder(weights_path=...)`` loads as the EXACT
+Whisper encoder architecture (arch="whisper", fp32). Architecture parity
+is golden-tested offline against the HF implementation with random-init
+weights (tests/test_audio.py::test_whisper_conversion_golden), so a real
+checkpoint dropped in computes the true Whisper encoding.
+
+The final LLM projection ("proj") is identity when --llm-hidden equals
+the encoder width, else RANDOM — mapping Whisper embeddings into a text
+LLM's prompt space needs a jointly-trained projector (Qwen-audio style),
+which no public checkpoint provides for arbitrary LLMs; the flag makes
+that explicit instead of hiding it.
+
+Usage:
+  python scripts/convert_whisper_encoder.py /path/to/whisper-tiny \
+      --out audio_encoder.safetensors --llm-hidden 896
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def _conv_w(hf_w: np.ndarray) -> np.ndarray:
+    """HF Conv1d weight [out, cin, k=3] -> window-matmul [3*cin, out]
+    (row tap*cin + c multiplies window tap ``tap`` channel ``c``)."""
+    out, cin, k = hf_w.shape
+    assert k == 3
+    w = np.zeros((3 * cin, out), np.float32)
+    for tap in range(3):
+        w[tap * cin:(tap + 1) * cin] = hf_w[:, :, tap].T
+    return w
+
+
+def convert_state_dict(sd: dict, num_heads: int,
+                       llm_hidden: int | None = None,
+                       seed: int = 0) -> dict:
+    """HF WhisperModel (or WhisperEncoder) state dict -> flat tensors in
+    the AudioEncoder "whisper.*" safetensors layout."""
+    def get(key):
+        for prefix in ("model.encoder.", "encoder.", ""):
+            k = prefix + key
+            if k in sd:
+                v = sd[k]
+                return v.detach().cpu().numpy() if hasattr(v, "detach") \
+                    else np.asarray(v)
+        raise KeyError(key)
+
+    d = get("conv1.weight").shape[0]
+    hidden = llm_hidden or d
+    out = {
+        # meta = [num_heads, proj_trained]: identity projection (hidden
+        # == encoder width) counts as trained — it's lossless; a random
+        # projection is NOT and the serving route must flag it.
+        "whisper.meta": np.asarray([num_heads, int(hidden == d)],
+                                   np.int32),
+        "whisper.conv1.w": _conv_w(get("conv1.weight")),
+        "whisper.conv1.b": get("conv1.bias").astype(np.float32),
+        "whisper.conv2.w": _conv_w(get("conv2.weight")),
+        "whisper.conv2.b": get("conv2.bias").astype(np.float32),
+        "whisper.pos": get("embed_positions.weight").astype(np.float32),
+        "whisper.ln_post.w": get("layer_norm.weight").astype(np.float32),
+        "whisper.ln_post.b": get("layer_norm.bias").astype(np.float32),
+    }
+    i = 0
+    while any(k.endswith(f"layers.{i}.self_attn.q_proj.weight")
+              for k in sd):
+        pre = f"layers.{i}."
+        out.update({
+            f"whisper.layers.{i}.ln1.w":
+                get(pre + "self_attn_layer_norm.weight"),
+            f"whisper.layers.{i}.ln1.b":
+                get(pre + "self_attn_layer_norm.bias"),
+            f"whisper.layers.{i}.wq": get(pre + "self_attn.q_proj.weight").T,
+            f"whisper.layers.{i}.bq": get(pre + "self_attn.q_proj.bias"),
+            f"whisper.layers.{i}.wk": get(pre + "self_attn.k_proj.weight").T,
+            f"whisper.layers.{i}.wv": get(pre + "self_attn.v_proj.weight").T,
+            f"whisper.layers.{i}.bv": get(pre + "self_attn.v_proj.bias"),
+            f"whisper.layers.{i}.wo":
+                get(pre + "self_attn.out_proj.weight").T,
+            f"whisper.layers.{i}.bo": get(pre + "self_attn.out_proj.bias"),
+            f"whisper.layers.{i}.ln2.w":
+                get(pre + "final_layer_norm.weight"),
+            f"whisper.layers.{i}.ln2.b":
+                get(pre + "final_layer_norm.bias"),
+            f"whisper.layers.{i}.w1": get(pre + "fc1.weight").T,
+            f"whisper.layers.{i}.b1": get(pre + "fc1.bias"),
+            f"whisper.layers.{i}.w2": get(pre + "fc2.weight").T,
+            f"whisper.layers.{i}.b2": get(pre + "fc2.bias"),
+        })
+        i += 1
+    out = {k: np.ascontiguousarray(np.asarray(v, np.float32))
+           if k != "whisper.meta" else v for k, v in out.items()}
+    if hidden == d:
+        out["whisper.proj"] = np.eye(d, dtype=np.float32)
+    else:
+        print(f"WARNING: llm projection {d}->{hidden} is RANDOM-INIT "
+              f"(no trained audio->LLM projector in this checkpoint); "
+              f"transcription quality requires a trained projector",
+              file=sys.stderr)
+        rng = np.random.default_rng(seed)
+        out["whisper.proj"] = (rng.standard_normal((d, hidden))
+                               / np.sqrt(d)).astype(np.float32)
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("model", help="local HF Whisper model dir or name")
+    ap.add_argument("--out", default="audio_encoder.safetensors")
+    ap.add_argument("--llm-hidden", type=int, default=None,
+                    help="LLM hidden size for the output projection "
+                         "(default: encoder width, identity projection)")
+    args = ap.parse_args()
+    from transformers import WhisperConfig, WhisperModel
+    model = WhisperModel.from_pretrained(args.model)
+    cfg: WhisperConfig = model.config
+    flat = convert_state_dict(model.state_dict(),
+                              cfg.encoder_attention_heads,
+                              args.llm_hidden)
+    from safetensors.numpy import save_file
+    save_file(flat, args.out)
+    print(f"wrote {args.out}: {cfg.encoder_layers} layers, "
+          f"d={cfg.d_model}, {cfg.encoder_attention_heads} heads")
+
+
+if __name__ == "__main__":
+    main()

@@ -166,3 +166,77 @@ async def test_model_removed_when_worker_dies():
         await watcher.stop()
         await frontend_rt.close()
         await coord.stop()
+
+
+@async_test
+async def test_tls_serves_https(tmp_path):
+    """--tls-cert-path/--tls-key-path (reference frontend TLS flags):
+    the service serves HTTPS — a TLS client completes a chat round trip,
+    a plaintext client is refused, and half-configured TLS fails fast."""
+    import ssl
+    import subprocess
+
+    cert, key = tmp_path / "c.pem", tmp_path / "k.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=localhost"],
+        check=True, capture_output=True)
+    coord = Coordinator()
+    await coord.start()
+    cfg = RuntimeConfig(coordinator_url=coord.url, lease_ttl_s=3.0)
+    worker_rt = await DistributedRuntime.from_settings(cfg)
+    frontend_rt = await DistributedRuntime.from_settings(cfg)
+    tokenizer = make_test_tokenizer()
+    endpoint = worker_rt.namespace("test").component("echo") \
+        .endpoint("generate")
+    server = await endpoint.serve_endpoint(EchoEngine().handler())
+    await register_llm(worker_rt, endpoint, "echo-model", tokenizer)
+    manager = ModelManager()
+    watcher = ModelWatcher(frontend_rt, manager)
+    await watcher.start()
+    service = HttpService(frontend_rt, manager, host="127.0.0.1", port=0,
+                          tls_cert_path=str(cert), tls_key_path=str(key))
+    await service.start()
+    try:
+        for _ in range(100):
+            if manager.get("echo-model"):
+                break
+            await asyncio.sleep(0.02)
+        ctx = ssl.create_default_context(cafile=str(cert))
+        ctx.check_hostname = False
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                    f"https://127.0.0.1:{service.port}/health",
+                    ssl=ctx) as resp:
+                assert resp.status == 200
+            async with session.post(
+                    f"https://127.0.0.1:{service.port}/v1/chat/completions",
+                    ssl=ctx,
+                    json={"model": "echo-model", "max_tokens": 4,
+                          "messages": [{"role": "user",
+                                        "content": "hi there"}]}) as resp:
+                assert resp.status == 200
+                body = await resp.json()
+                assert body["choices"][0]["message"]["content"]
+            # Plaintext against the TLS port fails.
+            try:
+                async with session.get(
+                        f"http://127.0.0.1:{service.port}/health") as resp:
+                    assert resp.status >= 400
+            except aiohttp.ClientError:
+                pass  # refused outright is also correct
+        bad = HttpService(frontend_rt, manager, host="127.0.0.1", port=0,
+                          tls_cert_path=str(cert))
+        try:
+            await bad.start()
+            raise AssertionError("half-configured TLS must fail")
+        except ValueError:
+            pass
+    finally:
+        await service.stop()
+        await watcher.stop()
+        await server.shutdown()
+        await frontend_rt.close()
+        await worker_rt.close()
+        await coord.stop()
