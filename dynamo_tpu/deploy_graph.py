@@ -316,6 +316,99 @@ def render_yaml(spec: dict) -> str:
     return yaml.safe_dump_all(render(spec), sort_keys=False)
 
 
+# ---------------------------------------------------------------------------
+# Helm packaging (reference deploy/helm/ role)
+# ---------------------------------------------------------------------------
+
+def write_helm_chart(spec: dict, outdir: str) -> list[str]:
+    """Package the rendered graph as a helm chart.
+
+    The renderer stays the single source of truth: the chart's one
+    template is the renderer's own multi-doc output with the image
+    string lifted into ``{{ .Values.image }}`` — ``helm template``
+    (or any engine substituting values.image) reproduces
+    ``render_yaml(spec)`` byte for byte, which the deploy-graph test
+    asserts. Re-render the chart when the graph spec changes (or run
+    ``--apply --watch`` for the operatorless reconcile loop)."""
+    rendered = render_yaml(spec)
+    image = spec.get("image", "dynamo-tpu:latest")
+    template = rendered.replace(image, "{{ .Values.image }}")
+    files = {
+        "Chart.yaml": yaml.safe_dump(
+            {"apiVersion": "v2", "name": spec["name"],
+             "description": "dynamo-tpu serving graph "
+                            "(generated by dynamo_tpu.deploy_graph)",
+             "type": "application", "version": "0.1.0",
+             "appVersion": "0.1.0"}, sort_keys=False),
+        "values.yaml": yaml.safe_dump({"image": image}, sort_keys=False),
+        os.path.join("templates", "graph.yaml"): template,
+    }
+    written = []
+    for rel, content in files.items():
+        path = os.path.join(outdir, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(content)
+        written.append(path)
+    return written
+
+
+# ---------------------------------------------------------------------------
+# Apply + watch (operator-optional reconcile: re-render on spec change)
+# ---------------------------------------------------------------------------
+
+async def apply_graph(api, manifests: list[dict]) -> list[tuple[str, str]]:
+    """Apply rendered manifests through planner.kube.KubernetesAPI.
+    Returns [(name, "created"|"replaced")]."""
+    results = []
+    for m in manifests:
+        outcome = await api.apply(m)
+        results.append((m["metadata"]["name"], outcome))
+    return results
+
+
+async def watch_graph(path: str, api, interval: float = 2.0,
+                      iterations: int | None = None) -> int:
+    """The re-render loop the Go operator's reconcile provides
+    (deploy/cloud/operator/internal/dynamo/graph.go role): poll the
+    graph spec file; whenever its rendered output changes, re-apply
+    every manifest. ``iterations`` bounds the loop for tests; None runs
+    until cancelled. Returns the number of applies performed."""
+    import asyncio
+    last = None
+    applies = 0
+    n = 0
+    while iterations is None or n < iterations:
+        n += 1
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                spec = yaml.safe_load(fh)
+            manifests = render(spec)
+            rendered = yaml.safe_dump_all(manifests, sort_keys=False)
+        except (OSError, GraphError, yaml.YAMLError) as exc:
+            print(f"watch: spec invalid, keeping last applied state: {exc}",
+                  file=sys.stderr)
+            await asyncio.sleep(interval)
+            continue
+        if rendered != last:
+            try:
+                results = await apply_graph(api, manifests)
+            except Exception as exc:  # noqa: BLE001 — transient API error
+                # 5xx blip, 409 conflict, RBAC hiccup: the reconcile
+                # loop's whole job is to retry next interval, not die.
+                print(f"watch: apply failed, retrying next interval: "
+                      f"{exc}", file=sys.stderr)
+                await asyncio.sleep(interval)
+                continue
+            applies += 1
+            last = rendered
+            created = sum(1 for _, o in results if o == "created")
+            print(f"watch: applied {len(results)} manifests "
+                  f"({created} created)")
+        await asyncio.sleep(interval)
+    return applies
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(
         description="Render a dynamo-tpu graph deployment to k8s YAML")
@@ -323,6 +416,19 @@ def main() -> None:
     parser.add_argument("-o", "--out", default=None,
                         help="output directory (default: stdout, one "
                              "multi-doc stream)")
+    parser.add_argument("--helm", default=None, metavar="DIR",
+                        help="write a helm chart to DIR instead "
+                             "(templates = this renderer's output; helm "
+                             "template reproduces it byte-for-byte)")
+    parser.add_argument("--apply", action="store_true",
+                        help="apply the manifests to the cluster via the "
+                             "in-cluster (or --kube-url) API")
+    parser.add_argument("--watch", action="store_true",
+                        help="with --apply: keep running and re-apply "
+                             "whenever the spec's rendered output changes")
+    parser.add_argument("--interval", type=float, default=2.0)
+    parser.add_argument("--kube-url", default=None,
+                        help="API server base URL (default: in-cluster)")
     args = parser.parse_args()
     with open(args.graph, "r", encoding="utf-8") as fh:
         spec = yaml.safe_load(fh)
@@ -330,6 +436,22 @@ def main() -> None:
         manifests = render(spec)
     except GraphError as exc:
         sys.exit(f"invalid graph: {exc}")
+    if args.helm:
+        written = write_helm_chart(spec, args.helm)
+        print(f"wrote helm chart ({len(written)} files) to {args.helm}")
+        return
+    if args.apply:
+        import asyncio
+
+        from dynamo_tpu.planner.kube import KubernetesAPI
+        api = KubernetesAPI(base_url=args.kube_url)
+        if args.watch:
+            asyncio.run(watch_graph(args.graph, api, args.interval))
+        else:
+            results = asyncio.run(apply_graph(api, manifests))
+            for name, outcome in results:
+                print(f"{outcome}: {name}")
+        return
     if args.out:
         os.makedirs(args.out, exist_ok=True)
         for m in manifests:

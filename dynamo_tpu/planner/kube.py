@@ -131,6 +131,44 @@ class KubernetesAPI:
                 "PATCH", self._sts_path(name, "/scale"), body,
                 "application/merge-patch+json"))
 
+    # -- generic manifests (deploy_graph re-render loop) ----------------------
+    def _collection_path(self, manifest: dict) -> str:
+        """Collection URL for a namespaced manifest. Plural = lowercased
+        kind + 's' — correct for every kind the graph renderer emits
+        (Deployment, StatefulSet, Service, ConfigMap, ServiceAccount,
+        Role, RoleBinding)."""
+        api = manifest.get("apiVersion", "v1")
+        plural = manifest["kind"].lower() + "s"
+        prefix = "/api/v1" if api == "v1" else f"/apis/{api}"
+        return f"{prefix}/namespaces/{self.namespace}/{plural}"
+
+    async def apply(self, manifest: dict) -> str:
+        """Create-or-replace one manifest. Returns "created" |
+        "replaced". (GET -> POST on 404, else PUT carrying the live
+        resourceVersion — the stdlib-client equivalent of kubectl
+        apply for the renderer's fully-specified manifests.)"""
+        name = manifest["metadata"]["name"]
+        base = self._collection_path(manifest)
+
+        def do() -> str:
+            try:
+                cur = self._request("GET", f"{base}/{name}")
+            except KubeAPIError as exc:
+                if exc.status != 404:
+                    raise
+                self._request("POST", base, manifest)
+                return "created"
+            body = dict(manifest)
+            md = dict(body.get("metadata") or {})
+            rv = (cur.get("metadata") or {}).get("resourceVersion")
+            if rv:
+                md["resourceVersion"] = rv
+            body["metadata"] = md
+            self._request("PUT", f"{base}/{name}", body)
+            return "replaced"
+
+        return await asyncio.get_running_loop().run_in_executor(None, do)
+
 
 class KubeAPIError(RuntimeError):
     def __init__(self, status: int, msg: str):

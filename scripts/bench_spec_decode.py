@@ -1,0 +1,132 @@
+"""Speculative-decoding bench: plain vs ngram self-drafting on the chip.
+
+Workload: repetitive prompts (looping token patterns — the shape of
+summaries-with-quotes, code edits, RAG answers that restate context),
+greedy, BS concurrent streams. The HBM-bound decode reads all weights
+once per step; verifying k+1 positions per read is the entire win, so
+the headline is decode tok/s and mean ITL, plain vs spec, plus the
+measured acceptance rate. Prints one JSON line.
+
+Env: SPEC_MODEL (default qwen2.5-0.5b), SPEC_BS (8), SPEC_ISL (256),
+SPEC_OSL (128), SPEC_K (3), SPEC_WINDOW (32), BENCH_QUANT (int8).
+
+Run: python scripts/bench_spec_decode.py        (real chip)
+     JAX_PLATFORMS=cpu ... (smoke; conftest-free, set env yourself)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MODEL = os.environ.get("SPEC_MODEL", "qwen2.5-0.5b")
+BS = int(os.environ.get("SPEC_BS", "8"))
+ISL = int(os.environ.get("SPEC_ISL", "256"))
+OSL = int(os.environ.get("SPEC_OSL", "128"))
+K = int(os.environ.get("SPEC_K", "3"))
+WINDOW = int(os.environ.get("SPEC_WINDOW", "32"))
+
+
+def prompts(vocab: int) -> list[list[int]]:
+    rng = np.random.default_rng(0)
+    out = []
+    for i in range(BS):
+        period = int(rng.integers(8, 24))
+        base = rng.integers(1, vocab, size=period).tolist()
+        out.append((base * (ISL // period + 1))[:ISL])
+    return out
+
+
+async def run(spec_decode: str | None):
+    from dynamo_tpu.engine.config import EngineConfig, PRESETS
+    from dynamo_tpu.engine.engine import TPUEngine
+    from dynamo_tpu.llm.protocols import PreprocessedRequest
+    from dynamo_tpu.runtime.context import Context
+
+    spec = PRESETS[MODEL]
+    quant = os.environ.get("BENCH_QUANT", "int8")
+    if quant and quant != "none":
+        spec = dataclasses.replace(spec, quant=quant)
+    maxp = -(-(ISL + OSL) // 16) + 1
+    config = EngineConfig(
+        model=spec, page_size=16, num_pages=BS * maxp + 16,
+        max_pages_per_seq=maxp, max_num_seqs=BS,
+        prefill_buckets=(256, 512), max_prefill_tokens=512,
+        attention_backend=os.environ.get("BENCH_ATTN", "auto"),
+        decode_window=WINDOW, pipeline_depth=4,
+        spec_decode=spec_decode, spec_k=K)
+    engine = TPUEngine(config)
+    engine.start()
+
+    async def one(prompt):
+        req = PreprocessedRequest(model="b", token_ids=list(prompt))
+        req.stop_conditions.max_tokens = OSL
+        req.stop_conditions.ignore_eos = True
+        t0 = time.monotonic()
+        t_first = None
+        n = 0
+        async for out in engine.generate(req, Context()):
+            got = len(out.get("token_ids", []))
+            if got and t_first is None:
+                t_first = time.monotonic()
+            n += got
+            if out.get("finish_reason"):
+                break
+        return t_first - t0, time.monotonic() - t_first, n
+
+    ps = prompts(spec.vocab_size)
+    await asyncio.gather(*[one(p) for p in ps])  # warmup/compile
+    t0 = time.monotonic()
+    results = await asyncio.gather(*[one(p) for p in ps])
+    elapsed = time.monotonic() - t0
+    decode_tokens = sum(max(0, n - 1) for _, _, n in results)
+    decode_span = max(span for _, span, _ in results)
+    out = {
+        "decode_tok_s": decode_tokens / decode_span if decode_span else 0.0,
+        "itl_mean_ms": 1e3 * decode_span / (decode_tokens / BS)
+        if decode_tokens else 0.0,
+        "elapsed_s": elapsed,
+        "spec_drafts": engine.spec_drafts,
+        "spec_tokens": engine.spec_tokens,
+        "spec_accepted": engine.spec_accepted,
+        "acceptance": (engine.spec_accepted / engine.spec_tokens
+                       if engine.spec_tokens else None),
+    }
+    engine.stop()
+    return out
+
+
+async def main_async():
+    plain = await run(None)
+    spec = await run("ngram")
+    print(json.dumps({
+        "metric": f"spec_decode_{MODEL}_bs{BS}_k{K}",
+        "value": round(spec["decode_tok_s"] / plain["decode_tok_s"], 3)
+        if plain["decode_tok_s"] else 0.0,
+        "unit": "speedup_x",
+        "detail": {
+            "plain_decode_tok_s": round(plain["decode_tok_s"], 1),
+            "spec_decode_tok_s": round(spec["decode_tok_s"], 1),
+            "plain_itl_ms": round(plain["itl_mean_ms"], 3),
+            "spec_itl_ms": round(spec["itl_mean_ms"], 3),
+            "acceptance": round(spec["acceptance"], 3)
+            if spec["acceptance"] is not None else None,
+            "spec_drafts": spec["spec_drafts"],
+            "workload": f"repetitive isl{ISL} osl{OSL} bs{BS} "
+                        f"window{WINDOW} k{K}",
+        },
+    }))
+
+
+if __name__ == "__main__":
+    asyncio.run(main_async())
+    sys.stdout.flush()
+    os._exit(0)  # tunnel-client teardown panic (see bench.py)

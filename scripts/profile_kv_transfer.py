@@ -88,6 +88,32 @@ def main() -> None:
         client.pull_sync(ticket)
 
     t_socket = timed(socket_leg)
+
+    # End-to-end staged paths, extract INCLUDED (what a disagg decode
+    # worker actually waits for): single deferred resolve (round-4
+    # behavior) vs PIPELINED page groups (round-5: group i rides the
+    # wire while group i+1's D2H completes — extract was ~97% of the
+    # tax on the tunneled attachment).
+    def staged_single():
+        h = runner.extract_pages_async(pages)
+        ticket = server.stage(
+            meta={"shape": list(kv.shape), "dtype": str(kv.dtype)},
+            resolve=lambda: runner.finalize_extract(h))
+        client.pull_sync(ticket)
+
+    def staged_pipelined(n_groups=4):
+        per = -(-len(pages) // n_groups)
+        hs = [runner.extract_pages_async(pages[i:i + per])
+              for i in range(0, len(pages), per)]
+        groups = [(h[1], (lambda hh=h: runner.finalize_extract(hh)))
+                  for h in hs]
+        ticket = server.stage(
+            meta={"shape": list(kv.shape), "dtype": str(kv.dtype)},
+            resolve_groups=groups)
+        client.pull_sync(ticket)
+
+    t_staged_single = timed(staged_single)
+    t_staged_pipelined = timed(staged_pipelined)
     client.close()
     server.close()
 
@@ -108,6 +134,11 @@ def main() -> None:
         "socket_gb_s": round(gbps(t_socket), 2),
         "insert_ms": round(1e3 * t_insert, 2),
         "insert_gb_s": round(gbps(t_insert), 2),
+        "staged_single_ms": round(1e3 * t_staged_single, 2),
+        "staged_pipelined_ms": round(1e3 * t_staged_pipelined, 2),
+        "pipelining_speedup": round(
+            t_staged_single / t_staged_pipelined, 2)
+        if t_staged_pipelined else 0.0,
         "parcel_path_ms_total": round(parcel_ms, 2),
         "plane_path_ms_total": round(plane_ms, 2),
         "us_per_token_plane": round(1e3 * plane_ms / n_tokens, 1),

@@ -1,0 +1,56 @@
+"""Sinusoidal load curves for planner benchmarks.
+
+Role parity with the reference's benchmarks/sin_load_generator/: emit a
+request-rate curve r(t) = base + amplitude * sin(2*pi*t / period) (clamped
+at >= 0, optional linear ramp), sampled every ``dt`` — the canonical
+workload for testing that the planner's scaling decisions TRACK a load
+pattern rather than react to a single step.
+
+Usage:
+  python scripts/sin_load_generator.py --duration 600 --period 120 \
+      --base 8 --amplitude 6 > curve.jsonl          # {"t": s, "rps": r}
+
+Importable: ``rate_at(t, ...)`` and ``generate_curve(...)``; the planner
+fake-kube e2e (tests/test_planner_kube.py) replays a curve through the
+metrics aggregator and asserts replicas follow it up AND down.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+
+
+def rate_at(t: float, base: float = 8.0, amplitude: float = 6.0,
+            period: float = 120.0, ramp: float = 0.0) -> float:
+    """Request rate at time t (>= 0 always)."""
+    r = base + amplitude * math.sin(2.0 * math.pi * t / period) + ramp * t
+    return max(0.0, r)
+
+
+def generate_curve(duration: float = 600.0, dt: float = 5.0,
+                   base: float = 8.0, amplitude: float = 6.0,
+                   period: float = 120.0, ramp: float = 0.0) -> list[dict]:
+    n = int(duration / dt) + 1
+    return [{"t": round(i * dt, 3),
+             "rps": round(rate_at(i * dt, base, amplitude, period, ramp), 4)}
+            for i in range(n)]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--duration", type=float, default=600.0)
+    ap.add_argument("--dt", type=float, default=5.0)
+    ap.add_argument("--base", type=float, default=8.0)
+    ap.add_argument("--amplitude", type=float, default=6.0)
+    ap.add_argument("--period", type=float, default=120.0)
+    ap.add_argument("--ramp", type=float, default=0.0)
+    args = ap.parse_args()
+    for row in generate_curve(args.duration, args.dt, args.base,
+                              args.amplitude, args.period, args.ramp):
+        print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()
