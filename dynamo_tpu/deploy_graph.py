@@ -331,7 +331,9 @@ def write_helm_chart(spec: dict, outdir: str) -> list[str]:
     asserts. Re-render the chart when the graph spec changes (or run
     ``--apply --watch`` for the operatorless reconcile loop)."""
     rendered = render_yaml(spec)
-    image = spec.get("image", "dynamo-tpu:latest")
+    # MUST match the renderer's own default, or a spec without 'image'
+    # ships a chart whose template never references .Values.image.
+    image = spec.get("image", "dynamo-tpu")
     template = rendered.replace(image, "{{ .Values.image }}")
     files = {
         "Chart.yaml": yaml.safe_dump(
@@ -385,7 +387,12 @@ async def watch_graph(path: str, api, interval: float = 2.0,
                 spec = yaml.safe_load(fh)
             manifests = render(spec)
             rendered = yaml.safe_dump_all(manifests, sort_keys=False)
-        except (OSError, GraphError, yaml.YAMLError) as exc:
+        except (OSError, GraphError, yaml.YAMLError, AttributeError,
+                TypeError, KeyError) as exc:
+            # AttributeError/TypeError/KeyError: yaml-valid but
+            # malformed specs (an editor's truncate-then-write lets the
+            # watcher read an empty/partial file mid-save) — the loop's
+            # whole job is to keep the last applied state and retry.
             print(f"watch: spec invalid, keeping last applied state: {exc}",
                   file=sys.stderr)
             await asyncio.sleep(interval)

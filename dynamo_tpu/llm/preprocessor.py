@@ -55,7 +55,60 @@ class OpenAIPreprocessor(Operator):
                         ) -> PreprocessedRequest:
         prompt = self.apply_chat_template(request)
         token_ids = self.tokenizer.encode(prompt)
-        return self._build(request.model, token_ids, request, prompt)
+        images = self._collect_images(request)
+        if not images:
+            return self._build(request.model, token_ids, request, prompt)
+        # Image modality (reference examples/multimodal, image-first):
+        # encoder embeddings PREPEND as placeholder-token spans (llava
+        # convention) and ride mm_embeds through the same injection path
+        # as audio — disagg, no-cache, and chunk handling compose
+        # identically (llm/vision.py).
+        encoder = self._vision_encoder()
+        from dynamo_tpu.llm.vision import embed_image
+        spans = []
+        offset = 0
+        for img_bytes in images:
+            span, n = embed_image(img_bytes, encoder, start=offset)
+            spans.append(span)
+            offset += n
+        pre = self._build(request.model, [0] * offset + token_ids,
+                          request, prompt)
+        pre.mm_embeds = spans
+        if encoder.untrained:
+            pre.annotations["vision_encoder"] = "untrained-random-init"
+        return pre
+
+    @staticmethod
+    def _collect_images(request: ChatCompletionRequest) -> list[bytes]:
+        from dynamo_tpu.llm.vision import data_uri_bytes
+        out = []
+        for m in request.messages:
+            if isinstance(m.content, list):
+                for part in m.content:
+                    if part.get("type") == "image_url":
+                        url = (part.get("image_url") or {}).get("url", "")
+                        out.append(data_uri_bytes(url))
+        return out
+
+    def _vision_encoder(self):
+        enc = getattr(self, "_vision_enc", None)
+        if enc is None:
+            import os
+
+            from dynamo_tpu.llm.vision import VisionEncoder
+            hidden = (self.card.runtime_config.extra or {}) \
+                .get("hidden_size")
+            if hidden is None:
+                raise ValueError(
+                    f"model {self.card.name!r} did not publish "
+                    "hidden_size; image input needs an embedding-capable "
+                    "worker")
+            weights = (os.environ.get("DTPU_VISION_ENCODER_WEIGHTS")
+                       or (self.card.runtime_config.extra or {})
+                       .get("vision_encoder_weights"))
+            enc = self._vision_enc = VisionEncoder(
+                int(hidden), weights_path=weights)
+        return enc
 
     def preprocess_completion(self, request: CompletionRequest
                               ) -> PreprocessedRequest:
@@ -122,7 +175,14 @@ class OpenAIPreprocessor(Operator):
                        context: Context) -> AsyncIterator[dict]:
         """Full chat pipeline edge: forward preprocess, stream deltas back."""
         assert self.inner is not None, "preprocessor not linked to an engine"
-        pre = self.preprocess_chat(request)
+        if self._collect_images(request):
+            # Image encode (and its first jit compile) runs for seconds
+            # on CPU frontends: off the event loop, or every concurrent
+            # SSE stream on this frontend freezes for the duration.
+            import asyncio
+            pre = await asyncio.to_thread(self.preprocess_chat, request)
+        else:
+            pre = self.preprocess_chat(request)
         delta_gen = ChatDeltaGenerator(
             request, prompt_tokens=len(pre.token_ids),
             tool_call_parser=self.card.tool_call_parser,

@@ -1,0 +1,191 @@
+"""Image modality: image bytes -> patch embeddings -> prompt-embedding
+spans (mm_embeds) injected into the prefill.
+
+Role parity with the reference's image-first multimodal examples
+(examples/multimodal, components/backends/trtllm multimodal processor):
+the frontend decodes and encodes media, the LLM worker consumes
+placeholder tokens whose embeddings are overridden by encoder output —
+the same modality-agnostic injection path the audio modality uses
+(llm/audio.py), so disagg/no-cache/chunk handling compose identically.
+
+TPU-first: the encoder is a pure-functional JAX ViT (patchify as one
+reshape+matmul onto the MXU, pre-norm attention blocks, jit-compiled;
+fixed 224x224 input so there is exactly one compiled shape). Weights
+load from a safetensors file (DTPU_VISION_ENCODER_WEIGHTS or the model
+card's runtime extras) and default to deterministic random init,
+flagged ``untrained`` — mapping patches into a text LLM's prompt space
+needs a jointly-trained projector, which no public checkpoint provides
+for arbitrary LLMs (same caveat as the audio encoder, stated rather
+than hidden).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+
+import numpy as np
+
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("vision")
+
+IMAGE_SIZE = 224
+# CLIP-convention normalization (public-domain constants).
+_MEAN = np.asarray([0.48145466, 0.4578275, 0.40821073], np.float32)
+_STD = np.asarray([0.26862954, 0.26130258, 0.27577711], np.float32)
+
+
+def decode_image(data: bytes) -> np.ndarray:
+    """Image bytes (PNG/JPEG/...) -> [IMAGE_SIZE, IMAGE_SIZE, 3] float32,
+    CLIP-normalized. Bilinear resize; alpha dropped."""
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(data)).convert("RGB")
+    img = img.resize((IMAGE_SIZE, IMAGE_SIZE), Image.BILINEAR)
+    arr = np.asarray(img, np.float32) / 255.0
+    return (arr - _MEAN) / _STD
+
+
+@dataclasses.dataclass
+class VisionEncoderSpec:
+    patch: int = 16
+    d_model: int = 256
+    num_layers: int = 2
+    num_heads: int = 4
+    image_size: int = IMAGE_SIZE
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch) ** 2
+
+
+class VisionEncoder:
+    """Patchify -> linear embed + 2D sinusoidal positions -> pre-norm
+    transformer blocks -> projection to the LLM hidden size."""
+
+    def __init__(self, llm_hidden: int,
+                 spec: VisionEncoderSpec | None = None,
+                 weights_path: str | None = None, seed: int = 0):
+        import jax
+
+        self.spec = spec or VisionEncoderSpec()
+        self.llm_hidden = llm_hidden
+        self.untrained = not weights_path
+        if weights_path:
+            self.params = self._load(weights_path)
+        else:
+            self.params = self._init(jax.random.key(seed))
+        self._fn = jax.jit(self._forward)
+
+    def _init(self, key):
+        import jax
+        import jax.numpy as jnp
+
+        s = self.spec
+        d = s.d_model
+        pdim = 3 * s.patch * s.patch
+        keys = iter(jax.random.split(key, 2 + 6 * s.num_layers))
+
+        def lin(k, i, o):
+            return (jax.random.normal(k, (i, o), jnp.float32)
+                    / np.sqrt(i)).astype(jnp.bfloat16)
+
+        params = {"patch": lin(next(keys), pdim, d),
+                  "proj": lin(next(keys), d, self.llm_hidden),
+                  "layers": []}
+        for _ in range(s.num_layers):
+            params["layers"].append({
+                "wq": lin(next(keys), d, d), "wk": lin(next(keys), d, d),
+                "wv": lin(next(keys), d, d), "wo": lin(next(keys), d, d),
+                "w1": lin(next(keys), d, 4 * d),
+                "w2": lin(next(keys), 4 * d, d),
+            })
+        return params
+
+    def _load(self, path: str):
+        from safetensors import safe_open
+        import ml_dtypes
+
+        with safe_open(path, framework="numpy") as fh:
+            flat = {k: fh.get_tensor(k).astype(ml_dtypes.bfloat16)
+                    for k in fh.keys()}
+        params = {"patch": flat["patch"], "proj": flat["proj"],
+                  "layers": []}
+        i = 0
+        while f"layers.{i}.wq" in flat:
+            params["layers"].append(
+                {k: flat[f"layers.{i}.{k}"]
+                 for k in ("wq", "wk", "wv", "wo", "w1", "w2")})
+            i += 1
+        return params
+
+    def _forward(self, params, img):
+        import jax
+        import jax.numpy as jnp
+
+        s = self.spec
+        d = s.d_model
+        p = s.patch
+        g = s.image_size // p
+        # Patchify: [H, W, 3] -> [g*g, p*p*3] in one reshape/transpose.
+        x = img.reshape(g, p, g, p, 3).transpose(0, 2, 1, 3, 4) \
+            .reshape(g * g, p * p * 3).astype(jnp.bfloat16)
+        x = x @ params["patch"]
+        t = x.shape[0]
+        pos = jnp.arange(t)[:, None] / (10000 ** (
+            jnp.arange(d)[None, :] / d))
+        x = x + jnp.where(jnp.arange(d)[None, :] % 2 == 0,
+                          jnp.sin(pos), jnp.cos(pos)).astype(jnp.bfloat16)
+
+        def norm(h):
+            hf = h.astype(jnp.float32)
+            var = jnp.mean(hf * hf, axis=-1, keepdims=True)
+            return (hf * jax.lax.rsqrt(var + 1e-5)).astype(h.dtype)
+
+        nh = s.num_heads
+        hd = d // nh
+        for lp in params["layers"]:
+            h = norm(x)
+            q = (h @ lp["wq"]).reshape(t, nh, hd)
+            k = (h @ lp["wk"]).reshape(t, nh, hd)
+            v = (h @ lp["wv"]).reshape(t, nh, hd)
+            scores = jnp.einsum("qnd,knd->nqk", q, k,
+                                preferred_element_type=jnp.float32)
+            probs = jax.nn.softmax(scores / np.sqrt(hd), axis=-1) \
+                .astype(jnp.bfloat16)
+            attn = jnp.einsum("nqk,knd->qnd", probs, v).reshape(t, d)
+            x = x + attn @ lp["wo"]
+            x = x + jax.nn.gelu(norm(x) @ lp["w1"]) @ lp["w2"]
+        return (norm(x) @ params["proj"]).astype(jnp.float32)
+
+    def encode(self, img: np.ndarray) -> np.ndarray:
+        """Normalized image [S, S, 3] -> [n_patches, llm_hidden]."""
+        import jax.numpy as jnp
+
+        return np.asarray(self._fn(self.params, jnp.asarray(img)))
+
+
+def embed_image(image_bytes: bytes, encoder: VisionEncoder,
+                start: int = 0) -> tuple[dict, int]:
+    """Image bytes -> (mm_embeds span dict at ``start``, span length)."""
+    emb = encoder.encode(decode_image(image_bytes))
+    return {"start": start, "b": emb.astype(np.float32).tobytes(),
+            "dtype": "float32", "shape": list(emb.shape)}, emb.shape[0]
+
+
+def data_uri_bytes(url: str) -> bytes:
+    """Decode a data: URI's payload. Remote http(s) URLs are rejected —
+    this deployment model keeps media fetching out of the serving path
+    (no egress; clients inline their images)."""
+    import base64
+
+    if not url.startswith("data:"):
+        raise ValueError(
+            "image_url must be a data: URI (base64-inlined); remote "
+            "fetching is not supported")
+    try:
+        _, payload = url.split(",", 1)
+        return base64.b64decode(payload)
+    except (ValueError, TypeError) as exc:
+        raise ValueError(f"malformed data: URI: {exc}") from exc

@@ -74,7 +74,6 @@ async def start_mocker(coord):
 async def run_policy(policy: str, corpus, workers: int, concurrency: int,
                      osl: int) -> dict:
     from dynamo_tpu.llm.discovery import RouterEngine
-    from dynamo_tpu.llm.kv_router import make_kv_router_factory
     from dynamo_tpu.llm.kv_router.router import KvPushRouter
     from dynamo_tpu.llm.kv_router.scheduler import KvRouterConfig
     from dynamo_tpu.llm.protocols import PreprocessedRequest

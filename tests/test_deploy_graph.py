@@ -145,3 +145,17 @@ def test_helm_cli(tmp_path):
         capture_output=True, text=True, check=True)
     assert "helm chart" in out.stdout
     assert (tmp_path / "c" / "templates" / "graph.yaml").exists()
+
+
+def test_helm_chart_default_image_parameterized(tmp_path):
+    """A spec WITHOUT an 'image' key must still produce a chart whose
+    template references .Values.image (the chart and renderer share one
+    default)."""
+    from dynamo_tpu.deploy_graph import write_helm_chart
+    spec = {k: v for k, v in DISAGG.items() if k != "image"}
+    write_helm_chart(spec, str(tmp_path / "c"))
+    template = (tmp_path / "c" / "templates" / "graph.yaml").read_text()
+    values = yaml.safe_load((tmp_path / "c" / "values.yaml").read_text())
+    assert "{{ .Values.image }}" in template
+    assert template.replace("{{ .Values.image }}", values["image"]) \
+        == render_yaml(spec)

@@ -22,7 +22,7 @@ from dynamo_tpu.runtime.distributed import DistributedRuntime
 async def start_stack(migration_limit=0):
     coord = Coordinator()
     await coord.start()
-    cfg = lambda: RuntimeConfig(coordinator_url=coord.url, lease_ttl_s=1.0)  # noqa: E731
+    cfg = lambda: RuntimeConfig(coordinator_url=coord.url, lease_ttl_s=3.0)  # noqa: E731
     worker_rt = await DistributedRuntime.from_settings(cfg())
     frontend_rt = await DistributedRuntime.from_settings(cfg())
 
