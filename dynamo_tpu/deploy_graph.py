@@ -330,11 +330,14 @@ def write_helm_chart(spec: dict, outdir: str) -> list[str]:
     ``render_yaml(spec)`` byte for byte, which the deploy-graph test
     asserts. Re-render the chart when the graph spec changes (or run
     ``--apply --watch`` for the operatorless reconcile loop)."""
-    rendered = render_yaml(spec)
-    # MUST match the renderer's own default, or a spec without 'image'
-    # ships a chart whose template never references .Values.image.
+    # Parameterize the image STRUCTURALLY: render with a sentinel image
+    # and substitute the sentinel — textual replace of the real image
+    # string could corrupt resource names that happen to contain it
+    # (e.g. a graph literally named after the default image).
+    sentinel = "__DTPU_HELM_IMAGE__"
     image = spec.get("image", "dynamo-tpu")
-    template = rendered.replace(image, "{{ .Values.image }}")
+    template = render_yaml({**spec, "image": sentinel}) \
+        .replace(sentinel, "{{ .Values.image }}")
     files = {
         "Chart.yaml": yaml.safe_dump(
             {"apiVersion": "v2", "name": spec["name"],
@@ -385,14 +388,14 @@ async def watch_graph(path: str, api, interval: float = 2.0,
         try:
             with open(path, "r", encoding="utf-8") as fh:
                 spec = yaml.safe_load(fh)
+            if not isinstance(spec, dict):
+                # Truncate-then-write editors let the watcher read an
+                # empty/partial file mid-save; keep last applied state.
+                raise GraphError(f"spec is {type(spec).__name__}, "
+                                 f"expected a mapping")
             manifests = render(spec)
             rendered = yaml.safe_dump_all(manifests, sort_keys=False)
-        except (OSError, GraphError, yaml.YAMLError, AttributeError,
-                TypeError, KeyError) as exc:
-            # AttributeError/TypeError/KeyError: yaml-valid but
-            # malformed specs (an editor's truncate-then-write lets the
-            # watcher read an empty/partial file mid-save) — the loop's
-            # whole job is to keep the last applied state and retry.
+        except (OSError, GraphError, yaml.YAMLError) as exc:
             print(f"watch: spec invalid, keeping last applied state: {exc}",
                   file=sys.stderr)
             await asyncio.sleep(interval)

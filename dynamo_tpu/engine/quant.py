@@ -84,3 +84,70 @@ def quantize_params(params: dict) -> dict:
 def weight_dtype_bytes(quant: str | None) -> float:
     """Bytes per weight element for capacity/roofline accounting."""
     return 1.0 if quant == "int8" else 2.0
+
+
+def random_params_for_timing(spec, seed: int = 7, scale: float = 1.0):
+    """Build a (quantized, if spec.quant) param tree with random values
+    DIRECTLY on the default device — for benches/profilers only. Host
+    init of an 8B model costs ~15 min of host RNG on a small VM; timing
+    runs don't care about the values. Shapes come from eval_shape over
+    the real init+quantize path, so the tree structure is exactly what
+    ModelRunner expects."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamo_tpu.engine.model import init_params
+
+    def build(key):
+        p = init_params(spec, key)
+        if spec.quant == "int8":
+            # Traceable twin of quantize_params (which is host/numpy).
+            def qw(w, emb=False):
+                wf = w.astype(jnp.float32)
+                amax = jnp.max(jnp.abs(wf), axis=0 if emb else -2,
+                               keepdims=True)
+                s = jnp.where(amax == 0, 1.0, amax / 127.0)
+                return QTensor(q=jnp.clip(jnp.rint(wf / s), -127, 127)
+                               .astype(jnp.int8), s=s)
+
+            layers = dict(p["layers"])
+            for k in QUANT_LAYER_KEYS:
+                if k in layers:
+                    layers[k] = qw(layers[k])
+            p = dict(p)
+            p["layers"] = layers
+            p["embed"] = qw(p["embed"], emb=True)
+            if "lm_head" in p:
+                p["lm_head"] = qw(p["lm_head"])
+        return p
+
+    flat, treedef = jax.tree.flatten(jax.eval_shape(build,
+                                                    jax.random.key(0)))
+
+    # numpy RNG per leaf: ~2 orders of magnitude faster than jax's CPU
+    # threefry for bulk int8 (the values are irrelevant here), and peak
+    # memory stays ~one leaf (a single fused jit program materializing
+    # every leaf's RNG intermediate OOMed at 8B).
+    import ml_dtypes
+
+    rng = np.random.default_rng(seed)
+    leaves = []
+    for sds in flat:
+        if np.issubdtype(sds.dtype, np.integer):
+            leaves.append(rng.integers(-127, 128, size=sds.shape,
+                                       dtype=np.int8))
+        else:
+            # ``scale`` ~0 zeroes every float leaf INCLUDING int8
+            # dequant scales -> logits ~0 -> greedy emits one constant
+            # token: a stand-in for maximally repetitive text in
+            # spec-decode benches (verification still runs the full
+            # real-shaped math).
+            arr = ((rng.standard_normal(sds.shape, dtype=np.float32)
+                    * 0.02 + 0.01) * scale)
+            if sds.dtype == jnp.bfloat16:
+                arr = arr.astype(ml_dtypes.bfloat16)
+            else:
+                arr = arr.astype(sds.dtype)
+            leaves.append(arr)
+    return jax.tree.unflatten(treedef, [jnp.asarray(a) for a in leaves])

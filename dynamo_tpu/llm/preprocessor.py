@@ -79,6 +79,13 @@ class OpenAIPreprocessor(Operator):
         return pre
 
     @staticmethod
+    def _has_images(request: ChatCompletionRequest) -> bool:
+        """Cheap predicate (no base64 decoding on the event loop)."""
+        return any(part.get("type") == "image_url"
+                   for m in request.messages
+                   if isinstance(m.content, list) for part in m.content)
+
+    @staticmethod
     def _collect_images(request: ChatCompletionRequest) -> list[bytes]:
         from dynamo_tpu.llm.vision import data_uri_bytes
         out = []
@@ -175,10 +182,10 @@ class OpenAIPreprocessor(Operator):
                        context: Context) -> AsyncIterator[dict]:
         """Full chat pipeline edge: forward preprocess, stream deltas back."""
         assert self.inner is not None, "preprocessor not linked to an engine"
-        if self._collect_images(request):
-            # Image encode (and its first jit compile) runs for seconds
-            # on CPU frontends: off the event loop, or every concurrent
-            # SSE stream on this frontend freezes for the duration.
+        if self._has_images(request):
+            # base64 decode + image encode (and its first jit compile)
+            # run for seconds on CPU frontends: off the event loop, or
+            # every concurrent SSE stream on this frontend freezes.
             import asyncio
             pre = await asyncio.to_thread(self.preprocess_chat, request)
         else:

@@ -15,7 +15,7 @@ import uuid
 from enum import Enum
 from typing import Any, Literal
 
-from pydantic import BaseModel, ConfigDict, Field
+from pydantic import BaseModel, ConfigDict, Field, model_validator
 
 
 # ---------------------------------------------------------------------------
@@ -156,6 +156,22 @@ class ChatCompletionRequest(BaseModel):
     top_logprobs: int | None = None
     ignore_eos: bool | None = None  # extension
     min_tokens: int | None = None  # extension
+    # Reference nvext extension block (vendored async-openai's NvExt,
+    # lib/llm/src/protocols/openai/nvext.rs role): same knobs nested
+    # under "nvext" for clients written against the reference API. Flat
+    # fields win when both are set. Lifted BEFORE validation so nvext
+    # values get full pydantic coercion/422s, not raw setattr.
+    nvext: dict[str, Any] | None = None
+
+    @model_validator(mode="before")
+    @classmethod
+    def _merge_nvext(cls, data):
+        if isinstance(data, dict) and isinstance(data.get("nvext"), dict):
+            for key in ("ignore_eos", "top_k", "min_tokens", "seed",
+                        "frequency_penalty", "presence_penalty"):
+                if data.get(key) is None and key in data["nvext"]:
+                    data[key] = data["nvext"][key]
+        return data
 
     def stop_list(self) -> list[str]:
         if self.stop is None:
@@ -177,6 +193,16 @@ class CompletionRequest(BaseModel):
     seed: int | None = None
     echo: bool = False
     ignore_eos: bool | None = None
+    nvext: dict[str, Any] | None = None  # reference NvExt block
+
+    @model_validator(mode="before")
+    @classmethod
+    def _merge_nvext(cls, data):
+        if isinstance(data, dict) and isinstance(data.get("nvext"), dict):
+            for key in ("ignore_eos", "seed", "min_tokens"):
+                if data.get(key) is None and key in data["nvext"]:
+                    data[key] = data["nvext"][key]
+        return data
     min_tokens: int | None = None
 
     def stop_list(self) -> list[str]:

@@ -45,11 +45,13 @@ def prompts(vocab: int) -> list[list[int]]:
     return out
 
 
-async def run(spec_decode: str | None):
+async def run(spec_decode: str | None, weight_scale: float = 1.0):
     from dynamo_tpu.engine.config import EngineConfig, PRESETS
     from dynamo_tpu.engine.engine import TPUEngine
     from dynamo_tpu.llm.protocols import PreprocessedRequest
     from dynamo_tpu.runtime.context import Context
+
+    from dynamo_tpu.engine.quant import random_params_for_timing
 
     spec = PRESETS[MODEL]
     quant = os.environ.get("BENCH_QUANT", "int8")
@@ -63,7 +65,22 @@ async def run(spec_decode: str | None):
         attention_backend=os.environ.get("BENCH_ATTN", "auto"),
         decode_window=WINDOW, pipeline_depth=4,
         spec_decode=spec_decode, spec_k=K)
-    engine = TPUEngine(config)
+    # Fast random weights: patch the runner's init_params to the
+    # jit-based builder (host init of 8B costs ~15 min of host RNG on
+    # this VM; under the runner's CPU default-device context this
+    # builds in seconds and uploads once — passing a prebuilt device
+    # tree would double HBM during re-placement). weight_scale ~0 makes
+    # the model loop on one constant token — the maximally repetitive
+    # workload (no trained checkpoint exists in this environment to
+    # produce naturally repetitive text).
+    import dynamo_tpu.engine.runner as runner_mod
+    orig_init = runner_mod.init_params
+    runner_mod.init_params = (
+        lambda s, key: random_params_for_timing(s, scale=weight_scale))
+    try:
+        engine = TPUEngine(config)
+    finally:
+        runner_mod.init_params = orig_init
     engine.start()
 
     async def one(prompt):
@@ -101,27 +118,49 @@ async def run(spec_decode: str | None):
                        if engine.spec_tokens else None),
     }
     engine.stop()
+    # Sequential engines at 8B: the previous engine's ~8 GB of HBM must
+    # actually be released before the next build, or run 2+ OOMs.
+    import gc
+
+    import jax
+    del engine
+    gc.collect()
+    jax.clear_caches()
     return out
 
 
 async def main_async():
-    plain = await run(None)
-    spec = await run("ngram")
+    # Repetitive endpoint (weight_scale ~0: the model loops, acceptance
+    # -> 1 — the workload spec decode exists for) and the adversarial
+    # endpoint (random weights: no repetition, drafts rarely accepted).
+    plain_rep = await run(None, weight_scale=1e-4)
+    spec_rep = await run("ngram", weight_scale=1e-4)
+    plain_rnd = await run(None, weight_scale=1.0)
+    spec_rnd = await run("ngram", weight_scale=1.0)
+
+    def ratio(a, b):
+        return round(a["decode_tok_s"] / b["decode_tok_s"], 3) \
+            if b["decode_tok_s"] else 0.0
+
     print(json.dumps({
         "metric": f"spec_decode_{MODEL}_bs{BS}_k{K}",
-        "value": round(spec["decode_tok_s"] / plain["decode_tok_s"], 3)
-        if plain["decode_tok_s"] else 0.0,
-        "unit": "speedup_x",
+        "value": ratio(spec_rep, plain_rep),
+        "unit": "speedup_x_repetitive",
         "detail": {
-            "plain_decode_tok_s": round(plain["decode_tok_s"], 1),
-            "spec_decode_tok_s": round(spec["decode_tok_s"], 1),
-            "plain_itl_ms": round(plain["itl_mean_ms"], 3),
-            "spec_itl_ms": round(spec["itl_mean_ms"], 3),
-            "acceptance": round(spec["acceptance"], 3)
-            if spec["acceptance"] is not None else None,
-            "spec_drafts": spec["spec_drafts"],
-            "workload": f"repetitive isl{ISL} osl{OSL} bs{BS} "
-                        f"window{WINDOW} k{K}",
+            "repetitive": {
+                "plain_decode_tok_s": round(plain_rep["decode_tok_s"], 1),
+                "spec_decode_tok_s": round(spec_rep["decode_tok_s"], 1),
+                "plain_itl_ms": round(plain_rep["itl_mean_ms"], 3),
+                "spec_itl_ms": round(spec_rep["itl_mean_ms"], 3),
+                "acceptance": spec_rep["acceptance"],
+            },
+            "nonrepetitive": {
+                "speedup": ratio(spec_rnd, plain_rnd),
+                "acceptance": spec_rnd["acceptance"],
+                "plain_decode_tok_s": round(plain_rnd["decode_tok_s"], 1),
+                "spec_decode_tok_s": round(spec_rnd["decode_tok_s"], 1),
+            },
+            "workload": f"isl{ISL} osl{OSL} bs{BS} window{WINDOW} k{K}",
         },
     }))
 

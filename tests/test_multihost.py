@@ -249,3 +249,101 @@ def test_two_process_spmd_engine_matches_single_process(tmp_path):
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+def test_multihost_spec_decode_matches_single_process(tmp_path):
+    """Speculative decoding under the multihost SPMD dispatch replay:
+    decode_spec_window + seed_history replay to the follower (a
+    non-replayed spec program would hang the mesh at the first
+    collective), and greedy tokens on a repetitive prompt match an
+    in-process tp=4 spec engine bit-for-bit."""
+    from dynamo_tpu.engine.config import EngineConfig, PRESETS
+    from dynamo_tpu.engine.engine import TPUEngine
+
+    rep_prompt = ([5, 9, 13, 17, 21, 25] * 8)[:40]
+
+    config = EngineConfig(model=PRESETS["tiny-test"], page_size=16,
+                          num_pages=64, max_pages_per_seq=16,
+                          max_num_seqs=4, prefill_buckets=(32, 64),
+                          max_prefill_tokens=64, attention_backend="xla",
+                          tp=4, decode_window=8, spec_decode="ngram",
+                          spec_k=3)
+    engine = TPUEngine(config)
+    engine.start()
+
+    async def one(prompt):
+        req = PreprocessedRequest(model="tiny-test",
+                                  token_ids=list(prompt))
+        req.stop_conditions.max_tokens = MAX_TOKENS
+        req.stop_conditions.ignore_eos = True
+        toks = []
+        async for out in engine.generate(req, Context()):
+            toks.extend(out.get("token_ids", []))
+            if out.get("finish_reason"):
+                break
+        return toks
+
+    try:
+        expected = asyncio.run(asyncio.wait_for(one(rep_prompt), 240))
+    finally:
+        engine.stop()
+    assert len(expected) == MAX_TOKENS
+
+    procs = []
+    try:
+        procs.append(_spawn(["dynamo_tpu.runtime.coordinator", "--host",
+                             "127.0.0.1", "--port", str(COORD_PORT)],
+                            tmp_path / "coord.log"))
+        time.sleep(2)
+        worker_args = ["dynamo_tpu.backends.tpu", "--model", "tiny-test",
+                       "--num-pages", "64", "--tp", "4",
+                       "--decode-window", "8",
+                       "--spec-decode", "ngram", "--spec-k", "3",
+                       "--num-nodes", "2"]
+        leader = _spawn(worker_args + ["--node-rank", "0"],
+                        tmp_path / "leader.log",
+                        {"JAX_COORDINATOR_ADDRESS": JAX_COORD})
+        procs.append(leader)
+        follower = _spawn(worker_args + ["--node-rank", "1"],
+                          tmp_path / "follower.log",
+                          {"JAX_COORDINATOR_ADDRESS": JAX_COORD})
+        procs.append(follower)
+        _wait_for(tmp_path / "follower.log", "TPU_FOLLOWER_READY",
+                  proc=follower)
+        _wait_for(tmp_path / "leader.log", "TPU_WORKER_READY", proc=leader)
+
+        async def client_one():
+            rt = await DistributedRuntime.from_settings(
+                RuntimeConfig(coordinator_url=COORD_URL))
+            try:
+                ep = rt.namespace(None).component("tpu") \
+                    .endpoint("generate")
+                client = await ep.client()
+                await client.wait_for_instances(timeout=60)
+                req = PreprocessedRequest(model="tiny-test",
+                                          token_ids=list(rep_prompt))
+                req.stop_conditions.max_tokens = MAX_TOKENS
+                req.stop_conditions.ignore_eos = True
+                toks = []
+                stream = await client.round_robin(req.to_wire(),
+                                                  context=Context())
+                async for out in stream:
+                    toks.extend(out.get("token_ids", []))
+                    if out.get("finish_reason"):
+                        break
+                return toks
+            finally:
+                await rt.close()
+
+        got = asyncio.run(asyncio.wait_for(client_one(), 300))
+        assert got == expected, \
+            f"multihost spec {got} != single-process spec {expected}"
+        assert follower.poll() is None, "follower died (replay gap?)"
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()

@@ -293,3 +293,22 @@ async def test_embeddings_and_responses_http():
         await service.stop()
         engine.stop()
         await runtime.close()
+
+
+def test_nvext_extension_block():
+    """Reference NvExt parity (nvext.rs role): clients written against
+    the reference's nested nvext block get the same knobs; flat fields
+    win on conflict."""
+    from dynamo_tpu.llm.protocols import (ChatCompletionRequest,
+                                          CompletionRequest)
+    req = ChatCompletionRequest.model_validate({
+        "model": "m", "messages": [{"role": "user", "content": "x"}],
+        "nvext": {"ignore_eos": True, "top_k": 5, "min_tokens": 2}})
+    assert req.ignore_eos is True and req.top_k == 5 and req.min_tokens == 2
+    flat = ChatCompletionRequest.model_validate({
+        "model": "m", "messages": [{"role": "user", "content": "x"}],
+        "top_k": 9, "nvext": {"top_k": 5}})
+    assert flat.top_k == 9, "flat field must win over nvext"
+    comp = CompletionRequest.model_validate({
+        "model": "m", "prompt": "x", "nvext": {"ignore_eos": True}})
+    assert comp.ignore_eos is True
