@@ -45,8 +45,12 @@ async def test_stage_pull_roundtrip(plane):
     out = await client.pull(ticket)
     assert out.dtype == kv.dtype
     np.testing.assert_array_equal(kv.view(np.uint16), out.view(np.uint16))
-    assert server.transfers == 1 and client.transfers == 1
-    assert server.bytes_out == kv.nbytes == client.bytes_in
+    assert client.transfers == 1 and client.bytes_in == kv.nbytes
+    for _ in range(200):  # server thread counts after its last send
+        if server.transfers == 1:
+            break
+        await asyncio.sleep(0.01)
+    assert server.transfers == 1 and server.bytes_out == kv.nbytes
 
 
 @async_test
