@@ -211,7 +211,8 @@ class AudioEncoder:
                     if k.startswith("whisper.layers.")),
                 num_heads=int(raw["whisper.meta"][0]),
                 downsample=2)
-            f32 = {k: v.astype(np.float32) for k, v in raw.items()}
+            f32 = {k: v.astype(np.float32, copy=False)
+                   for k, v in raw.items()}
             params = {k[len("whisper."):]: f32[k] for k in f32
                       if not k.startswith("whisper.layers.")
                       and k != "whisper.meta"}

@@ -36,13 +36,13 @@ _MEAN = np.asarray([0.48145466, 0.4578275, 0.40821073], np.float32)
 _STD = np.asarray([0.26862954, 0.26130258, 0.27577711], np.float32)
 
 
-def decode_image(data: bytes) -> np.ndarray:
-    """Image bytes (PNG/JPEG/...) -> [IMAGE_SIZE, IMAGE_SIZE, 3] float32,
+def decode_image(data: bytes, size: int = IMAGE_SIZE) -> np.ndarray:
+    """Image bytes (PNG/JPEG/...) -> [size, size, 3] float32,
     CLIP-normalized. Bilinear resize; alpha dropped."""
     from PIL import Image
 
     img = Image.open(io.BytesIO(data)).convert("RGB")
-    img = img.resize((IMAGE_SIZE, IMAGE_SIZE), Image.BILINEAR)
+    img = img.resize((size, size), Image.BILINEAR)
     arr = np.asarray(img, np.float32) / 255.0
     return (arr - _MEAN) / _STD
 
@@ -54,6 +54,13 @@ class VisionEncoderSpec:
     num_layers: int = 2
     num_heads: int = 4
     image_size: int = IMAGE_SIZE
+    # "native": the TPU-first bf16 ViT below. "clip": the exact CLIP
+    # vision transformer (CLS token + learned positions, pre_layernorm,
+    # biased q/k/v/out/fc with quick_gelu), run in fp32 — weights
+    # converted from a real CLIP checkpoint by
+    # scripts/convert_clip_vision.py compute the true CLIP patch
+    # features (golden-tested vs the HF implementation offline).
+    arch: str = "native"
 
     @property
     def n_patches(self) -> int:
@@ -108,8 +115,42 @@ class VisionEncoder:
         import ml_dtypes
 
         with safe_open(path, framework="numpy") as fh:
-            flat = {k: fh.get_tensor(k).astype(ml_dtypes.bfloat16)
-                    for k in fh.keys()}
+            raw = {k: fh.get_tensor(k) for k in fh.keys()}
+        if any(k.startswith("clip.") for k in raw):
+            # Converted CLIP checkpoint: fp32, exact architecture.
+            meta = raw["clip.meta"]  # [num_heads, patch, proj_trained]
+            if len(meta) > 2 and not int(meta[2]):
+                self.untrained = True
+            proj = raw["clip.proj"]
+            if proj.shape[1] != self.llm_hidden:
+                raise ValueError(
+                    f"checkpoint projects to {proj.shape[1]}, model "
+                    f"hidden is {self.llm_hidden}: re-run "
+                    f"convert_clip_vision.py with --llm-hidden "
+                    f"{self.llm_hidden}")
+            d = raw["clip.patch"].shape[1]
+            n_layers = max(int(k.split(".")[2]) + 1 for k in raw
+                           if k.startswith("clip.layers."))
+            patch = int(meta[1])
+            # Grid size comes from the learned position table (CLS + g^2).
+            g = int(round((raw["clip.pos"].shape[0] - 1) ** 0.5))
+            self.spec = dataclasses.replace(
+                self.spec, arch="clip", d_model=d, patch=patch,
+                num_layers=n_layers, num_heads=int(meta[0]),
+                image_size=g * patch)
+            f32 = {k: v.astype(np.float32, copy=False)
+                   for k, v in raw.items()}
+            params = {k[len("clip."):]: f32[k] for k in f32
+                      if not k.startswith("clip.layers.")
+                      and k != "clip.meta"}
+            params["layers"] = []
+            for i in range(n_layers):
+                pre = f"clip.layers.{i}."
+                params["layers"].append(
+                    {k[len(pre):]: f32[k] for k in f32
+                     if k.startswith(pre)})
+            return params
+        flat = {k: v.astype(ml_dtypes.bfloat16) for k, v in raw.items()}
         params = {"patch": flat["patch"], "proj": flat["proj"],
                   "layers": []}
         i = 0
@@ -120,9 +161,57 @@ class VisionEncoder:
             i += 1
         return params
 
+    def _forward_clip(self, params, img):
+        """Exact CLIP vision transformer forward (fp32): patchify ->
+        CLS + learned positions -> pre_layernorm -> pre-norm blocks with
+        biased projections and quick_gelu -> patch tokens (CLS dropped,
+        matching HF last_hidden_state[:, 1:]) -> llm projection."""
+        import jax
+        import jax.numpy as jnp
+
+        s = self.spec
+        d = s.d_model
+        p = s.patch
+        g = s.image_size // p
+        nh = s.num_heads
+        hd = d // nh
+
+        def ln(h, w, b):
+            m = h.mean(-1, keepdims=True)
+            v = ((h - m) ** 2).mean(-1, keepdims=True)
+            return (h - m) * jax.lax.rsqrt(v + 1e-5) * w + b
+
+        def quick_gelu(x):
+            return x * jax.nn.sigmoid(1.702 * x)
+
+        patches = img.astype(jnp.float32) \
+            .reshape(g, p, g, p, 3).transpose(0, 2, 1, 3, 4) \
+            .reshape(g * g, p * p * 3) @ params["patch"]
+        x = jnp.concatenate([params["cls"][None], patches], axis=0)
+        t = x.shape[0]
+        x = x + params["pos"][:t]
+        x = ln(x, params["pre_ln.w"], params["pre_ln.b"])
+        for lp in params["layers"]:
+            h = ln(x, lp["ln1.w"], lp["ln1.b"])
+            q = ((h @ lp["wq"] + lp["bq"]) * (hd ** -0.5)) \
+                .reshape(t, nh, hd)
+            k = (h @ lp["wk"] + lp["bk"]).reshape(t, nh, hd)
+            v = (h @ lp["wv"] + lp["bv"]).reshape(t, nh, hd)
+            scores = jnp.einsum("qnd,knd->nqk", q, k)
+            probs = jax.nn.softmax(scores, axis=-1)
+            attn = jnp.einsum("nqk,knd->qnd", probs, v).reshape(t, d)
+            x = x + (attn @ lp["wo"] + lp["bo"])
+            h2 = ln(x, lp["ln2.w"], lp["ln2.b"])
+            x = x + (quick_gelu(h2 @ lp["w1"] + lp["b1"])
+                     @ lp["w2"] + lp["b2"])
+        return (x[1:] @ params["proj"]).astype(jnp.float32)
+
     def _forward(self, params, img):
         import jax
         import jax.numpy as jnp
+
+        if self.spec.arch == "clip":
+            return self._forward_clip(params, img)
 
         s = self.spec
         d = s.d_model
@@ -169,7 +258,8 @@ class VisionEncoder:
 def embed_image(image_bytes: bytes, encoder: VisionEncoder,
                 start: int = 0) -> tuple[dict, int]:
     """Image bytes -> (mm_embeds span dict at ``start``, span length)."""
-    emb = encoder.encode(decode_image(image_bytes))
+    emb = encoder.encode(decode_image(image_bytes,
+                                      size=encoder.spec.image_size))
     return {"start": start, "b": emb.astype(np.float32).tobytes(),
             "dtype": "float32", "shape": list(emb.shape)}, emb.shape[0]
 

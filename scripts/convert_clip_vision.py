@@ -1,0 +1,122 @@
+"""Convert a CLIP vision-transformer checkpoint into the VisionEncoder
+layout.
+
+Role parity with the reference's image-first multimodal examples
+(examples/multimodal: a CLIP-family vision tower feeds the LLM's prompt
+embeddings, llava-style): takes a local HF CLIP model (e.g.
+openai/clip-vit-base-patch32 already on disk — this environment has no
+network egress) and writes a safetensors file that
+``llm/vision.py VisionEncoder(weights_path=...)`` loads as the EXACT
+CLIP vision transformer (arch="clip", fp32). Architecture parity is
+golden-tested offline against the HF implementation with random-init
+weights (tests/test_vision.py::test_clip_conversion_golden), so a real
+checkpoint computes the true CLIP patch features.
+
+Like the Whisper converter, the final LLM projection is identity when
+--llm-hidden equals the tower width, else RANDOM and flagged — mapping
+CLIP features into a text LLM's prompt space needs a jointly-trained
+projector (llava's mm_projector), which no public checkpoint provides
+for arbitrary LLMs.
+
+Usage:
+  python scripts/convert_clip_vision.py /path/to/clip-vit-base-patch32 \
+      --out vision_encoder.safetensors --llm-hidden 896
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def convert_state_dict(sd: dict, num_heads: int, patch: int,
+                       llm_hidden: int | None = None,
+                       seed: int = 0) -> dict:
+    """HF CLIPVisionModel (or CLIPModel) state dict -> flat tensors in
+    the VisionEncoder "clip.*" safetensors layout."""
+    def get(key):
+        for prefix in ("vision_model.", "model.vision_model.",
+                       "clip.vision_model.", ""):
+            k = prefix + key
+            if k in sd:
+                v = sd[k]
+                return v.detach().cpu().numpy() if hasattr(v, "detach") \
+                    else np.asarray(v)
+        raise KeyError(key)
+
+    # Conv2d patch embed [d, 3, p, p] -> window matmul [p*p*3, d] with
+    # row order (i, j, c) matching the encoder's patchify reshape.
+    conv = get("embeddings.patch_embedding.weight")
+    d = conv.shape[0]
+    patch_w = conv.transpose(2, 3, 1, 0).reshape(patch * patch * 3, d)
+    out = {
+        "clip.patch": patch_w.astype(np.float32),
+        "clip.cls": get("embeddings.class_embedding").astype(np.float32)
+        .reshape(d),
+        "clip.pos": get("embeddings.position_embedding.weight")
+        .astype(np.float32),
+        "clip.pre_ln.w": get("pre_layrnorm.weight").astype(np.float32),
+        "clip.pre_ln.b": get("pre_layrnorm.bias").astype(np.float32),
+    }
+    i = 0
+    while any(k.endswith(f"layers.{i}.self_attn.q_proj.weight")
+              for k in sd):
+        pre = f"encoder.layers.{i}."
+        out.update({
+            f"clip.layers.{i}.ln1.w": get(pre + "layer_norm1.weight"),
+            f"clip.layers.{i}.ln1.b": get(pre + "layer_norm1.bias"),
+            f"clip.layers.{i}.wq": get(pre + "self_attn.q_proj.weight").T,
+            f"clip.layers.{i}.bq": get(pre + "self_attn.q_proj.bias"),
+            f"clip.layers.{i}.wk": get(pre + "self_attn.k_proj.weight").T,
+            f"clip.layers.{i}.bk": get(pre + "self_attn.k_proj.bias"),
+            f"clip.layers.{i}.wv": get(pre + "self_attn.v_proj.weight").T,
+            f"clip.layers.{i}.bv": get(pre + "self_attn.v_proj.bias"),
+            f"clip.layers.{i}.wo": get(pre + "self_attn.out_proj.weight").T,
+            f"clip.layers.{i}.bo": get(pre + "self_attn.out_proj.bias"),
+            f"clip.layers.{i}.ln2.w": get(pre + "layer_norm2.weight"),
+            f"clip.layers.{i}.ln2.b": get(pre + "layer_norm2.bias"),
+            f"clip.layers.{i}.w1": get(pre + "mlp.fc1.weight").T,
+            f"clip.layers.{i}.b1": get(pre + "mlp.fc1.bias"),
+            f"clip.layers.{i}.w2": get(pre + "mlp.fc2.weight").T,
+            f"clip.layers.{i}.b2": get(pre + "mlp.fc2.bias"),
+        })
+        i += 1
+    out = {k: np.ascontiguousarray(np.asarray(v, np.float32))
+           for k, v in out.items()}
+    hidden = llm_hidden or d
+    out["clip.meta"] = np.asarray([num_heads, patch, int(hidden == d)],
+                                  np.int32)
+    if hidden == d:
+        out["clip.proj"] = np.eye(d, dtype=np.float32)
+    else:
+        print(f"WARNING: llm projection {d}->{hidden} is RANDOM-INIT "
+              f"(no trained vision->LLM projector in this checkpoint)",
+              file=sys.stderr)
+        rng = np.random.default_rng(seed)
+        out["clip.proj"] = (rng.standard_normal((d, hidden))
+                            / np.sqrt(d)).astype(np.float32)
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("model", help="local HF CLIP model dir or name")
+    ap.add_argument("--out", default="vision_encoder.safetensors")
+    ap.add_argument("--llm-hidden", type=int, default=None)
+    args = ap.parse_args()
+    from transformers import CLIPVisionModel
+    model = CLIPVisionModel.from_pretrained(args.model)
+    cfg = model.config
+    flat = convert_state_dict(model.state_dict(),
+                              cfg.num_attention_heads, cfg.patch_size,
+                              args.llm_hidden)
+    from safetensors.numpy import save_file
+    save_file(flat, args.out)
+    print(f"wrote {args.out}: {cfg.num_hidden_layers} layers, "
+          f"d={cfg.hidden_size}, patch={cfg.patch_size}")
+
+
+if __name__ == "__main__":
+    main()

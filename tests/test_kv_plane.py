@@ -46,8 +46,9 @@ async def test_stage_pull_roundtrip(plane):
     assert out.dtype == kv.dtype
     np.testing.assert_array_equal(kv.view(np.uint16), out.view(np.uint16))
     assert client.transfers == 1 and client.bytes_in == kv.nbytes
-    for _ in range(200):  # server thread counts after its last send
-        if server.transfers == 1:
+    for _ in range(200):  # server thread counts after its last send;
+        # bytes_out is written LAST, so poll on it, not transfers.
+        if server.bytes_out == kv.nbytes:
             break
         await asyncio.sleep(0.01)
     assert server.transfers == 1 and server.bytes_out == kv.nbytes

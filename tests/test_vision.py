@@ -170,3 +170,45 @@ async def test_http_chat_image_e2e():
                 assert "data: URI" in err["error"]["message"]
     finally:
         await stop_stack(*s)
+
+
+def test_clip_conversion_golden(tmp_path):
+    """Architecture-parity golden for the CLIP vision tower: a
+    RANDOM-INIT HF CLIPVisionModel (offline, from a config) converted by
+    scripts/convert_clip_vision.py must produce the SAME patch features
+    through our VisionEncoder (arch="clip", identity projection) as the
+    HF implementation's last_hidden_state patch tokens — so a real
+    clip-vit checkpoint computes the true CLIP features."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    import pathlib
+    import sys as _sys
+    _sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent
+                            / "scripts"))
+    from convert_clip_vision import convert_state_dict
+    from safetensors.numpy import save_file
+
+    cfg = transformers.CLIPVisionConfig(
+        hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=2, image_size=64, patch_size=16,
+        hidden_act="quick_gelu")
+    torch.manual_seed(11)
+    hf = transformers.CLIPVisionModel(cfg).eval()
+    flat = convert_state_dict(hf.state_dict(), cfg.num_attention_heads,
+                              cfg.patch_size)
+    path = tmp_path / "clip.safetensors"
+    save_file(flat, str(path))
+
+    enc = VisionEncoder(64, weights_path=str(path))
+    assert enc.spec.arch == "clip"
+    assert enc.spec.image_size == 64 and enc.spec.patch == 16
+
+    rng = np.random.default_rng(5)
+    img = rng.standard_normal((64, 64, 3)).astype(np.float32)
+    ours = enc.encode(img)
+    with torch.no_grad():
+        theirs = hf(torch.from_numpy(
+            img.transpose(2, 0, 1)[None])).last_hidden_state[0, 1:] \
+            .numpy()
+    assert ours.shape == theirs.shape == (16, 64)
+    np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-3)
