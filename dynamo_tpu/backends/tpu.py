@@ -243,7 +243,9 @@ async def run(args: argparse.Namespace) -> None:
                 from dynamo_tpu.engine.weights import load_hf_weights
                 params = load_hf_weights(engine_cfg.model, ckpt)
             return TPUEngine(engine_cfg, params=params, kv_publisher=kv_pub,
-                             metrics_publisher=metrics_pub)
+                             metrics_publisher=metrics_pub,
+                             metrics_registry=runtime.metrics.namespace(ns)
+                             .component(args.component))
 
         mh_group = (args.mh_group
                     or f"eng-{engine_cfg.model.name}").replace("/", "-")

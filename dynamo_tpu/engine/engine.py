@@ -42,7 +42,8 @@ from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput, Preprocessed
 from dynamo_tpu.llm.tokens import TokenBlockSequence
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.engine import AsyncEngine
-from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.logging import current_trace, get_logger
+from dynamo_tpu.runtime.tracing import get_recorder, phase_metrics
 
 log = get_logger("tpu_engine")
 
@@ -81,6 +82,9 @@ class _Request:
     # admitted-but-first-token-unresolved (prompt minus prefix reuse).
     queued_cold: int = 0
     cold_tokens: int = 0
+    # Queue-wait observed for the current stint (reset on requeue so a
+    # preempted request's second wait records too).
+    wait_noted: bool = False
 
     def push(self, item) -> None:
         self.loop.call_soon_threadsafe(self.out_q.put_nowait, item)
@@ -93,6 +97,7 @@ class _Window:
     frozen: dict  # slot -> (request, epoch, "requeue" | "oom")
     size: int
     serial: int = 0  # dispatch order (pipelined deferred-release fencing)
+    t0: float = 0.0  # dispatch time (decode_step_seconds + decode spans)
     # Speculative windows: toks = (outs [m,B,S], emits [m,B],
     # ndrafts [m,B]); slots snaps carry the ASSUMED advance so
     # processing can correct the host's upper-bound positions.
@@ -101,8 +106,16 @@ class _Window:
 
 class TPUEngine(AsyncEngine):
     def __init__(self, config: EngineConfig, params=None,
-                 devices=None, kv_publisher=None, metrics_publisher=None):
+                 devices=None, kv_publisher=None, metrics_publisher=None,
+                 metrics_registry=None):
         self.config = config
+        # Tracing + phase histograms (runtime/tracing.py). The recorder
+        # is the process-global ring buffer; the histograms need a
+        # MetricsRegistry node and stay None without one (call sites
+        # without a runtime lose metrics, never correctness).
+        self._recorder = get_recorder()
+        self.phase = (phase_metrics(metrics_registry)
+                      if metrics_registry is not None else None)
         self.decode_window = config.resolve_decode_window()
         self.runner = ModelRunner(config, params=params, devices=devices)
         self.allocator = PageAllocator(self.runner.num_pages, config.page_size)
@@ -285,6 +298,22 @@ class TPUEngine(AsyncEngine):
         self._waiting_cold -= r.queued_cold
         r.queued_cold = 0
 
+    def _note_queue_wait(self, r: _Request) -> None:
+        """Admission reached: observe how long the request sat in the
+        waiting queue (requeued requests keep their original enqueue
+        time, so this is total time-to-slot, the operator-facing
+        number). ENGINE THREAD."""
+        if r.wait_noted:
+            return
+        r.wait_noted = True
+        now = time.monotonic()
+        if self.phase is not None:
+            self.phase.queue_wait.observe(now - r.enqueue_t)
+        rec = self._recorder
+        if rec.enabled:
+            rec.add("engine.queue_wait", r.ctx.trace_id, r.ctx.span_id,
+                    r.enqueue_t, now)
+
     def _maybe_reject(self, prompt_tokens: int) -> None:
         """Raise OverloadedError (frontend: HTTP 503, router retries
         elsewhere) when the projected TTFT through the current backlog
@@ -335,16 +364,26 @@ class TPUEngine(AsyncEngine):
                      len_cap=len(req.token_ids)
                      + (req.stop_conditions.max_tokens or 2**30))
         self._maybe_reject(len(req.token_ids))
+        # Request loop logs (admission warnings, preemptions surfaced to
+        # the caller) carry the request's trace context.
+        trace_tok = current_trace.set(
+            {"trace_id": context.trace_id, "span_id": context.span_id})
         self._queue_put(r)
-        while True:
-            item = await r.out_q.get()
-            if item is None:
-                return
-            if isinstance(item, Exception):
-                raise item
-            yield item
-            if item.get("finish_reason"):
-                return
+        try:
+            while True:
+                item = await r.out_q.get()
+                if item is None:
+                    return
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+                if item.get("finish_reason"):
+                    return
+        finally:
+            try:
+                current_trace.reset(trace_tok)
+            except ValueError:  # generator finalized from another context
+                pass
 
     async def generate_injected(self, request, context: Context,
                                 first_token: int, kv) -> AsyncIterator[dict]:
@@ -368,16 +407,24 @@ class TPUEngine(AsyncEngine):
                      no_cache=bool(getattr(req, "mm_embeds", None)))
         # Injected requests carry their KV with them — no cold prefill,
         # so the SLA gate and the cold ledger both skip them.
+        trace_tok = current_trace.set(
+            {"trace_id": context.trace_id, "span_id": context.span_id})
         self._queue_put(r, cold=0)
-        while True:
-            item = await r.out_q.get()
-            if item is None:
-                return
-            if isinstance(item, Exception):
-                raise item
-            yield item
-            if item.get("finish_reason"):
-                return
+        try:
+            while True:
+                item = await r.out_q.get()
+                if item is None:
+                    return
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+                if item.get("finish_reason"):
+                    return
+        finally:
+            try:
+                current_trace.reset(trace_tok)
+            except ValueError:
+                pass
 
     # -- engine-thread jobs (disaggregation control path) ---------------------
     async def run_job(self, fn):
@@ -798,6 +845,21 @@ class TPUEngine(AsyncEngine):
                     r.push(RuntimeError(f"prefill readback failed: {exc}"))
                     self._finish_slot(slot, register=False)
             return
+        t1 = time.monotonic()
+        t0 = entry.get("t0")
+        if t0:
+            # Batched-prefill phase: dispatch -> first-token readback.
+            if self.phase is not None:
+                self.phase.prefill.observe(t1 - t0)
+            rec = self._recorder
+            if rec.enabled:
+                for _, r, slot, epoch in entry["rows"]:
+                    if self.slot_req[slot] is r and r.epoch == epoch:
+                        rec.add("engine.prefill", r.ctx.trace_id,
+                                r.ctx.span_id, t0, t1,
+                                attrs={"prompt_tokens":
+                                       len(r.req.token_ids),
+                                       "reuse_tokens": r.reuse_tokens})
         for row, r, slot, epoch in entry["rows"]:
             if self.slot_req[slot] is not r or r.epoch != epoch:
                 continue  # slot reassigned (failure path already notified)
@@ -851,6 +913,7 @@ class TPUEngine(AsyncEngine):
                     token_ids=[], finish_reason=FinishReason.CANCELLED).to_wire())
                 continue
             if r.injected is not None:
+                self._note_queue_wait(r)
                 slot = free_slots.pop(0)
                 try:
                     if self._admit_injected(r, slot):
@@ -881,6 +944,7 @@ class TPUEngine(AsyncEngine):
                     self._deferred_head = r
                     self.admission_deferred += 1
                     break
+            self._note_queue_wait(r)
             try:
                 plan = self._plan_prefill(r)
             except Exception as exc:  # noqa: BLE001
@@ -902,6 +966,15 @@ class TPUEngine(AsyncEngine):
                     # absurd tok/s and poison the admission projection.
                     self._prefill_rate_sample(cold,
                                               time.monotonic() - t0)
+                    if self.phase is not None:
+                        self.phase.prefill.observe(time.monotonic() - t0)
+                    if self._recorder.enabled:
+                        self._recorder.add(
+                            "engine.prefill", r.ctx.trace_id,
+                            r.ctx.span_id, t0, time.monotonic(),
+                            attrs={"prompt_tokens": len(r.req.token_ids),
+                                   "reuse_tokens": r.reuse_tokens,
+                                   "chunked": True})
                 except Exception as exc:  # noqa: BLE001
                     log.exception("chunked prefill failed")
                     self.allocator.release(r.pages)
@@ -1350,7 +1423,8 @@ class TPUEngine(AsyncEngine):
         self._dispatch_serial += 1
         if not active_rows:
             return _Window(toks=None, slots=[None] * b, frozen=frozen,
-                           size=M, serial=self._dispatch_serial)
+                           size=M, serial=self._dispatch_serial,
+                           t0=time.monotonic())
         bucket = self.runner.bucket_pages_for(needed_max)
         packed = np.zeros((b, PK_PREFIX + bucket), np.int32)
         slots: list = [None] * b
@@ -1394,7 +1468,8 @@ class TPUEngine(AsyncEngine):
                 pass
         return _Window(toks=outs, slots=slots, frozen=frozen, size=M,
                        serial=self._dispatch_serial,
-                       spec=bool(self.config.spec_decode))
+                       spec=bool(self.config.spec_decode),
+                       t0=time.monotonic())
 
     def _process_window(self, w: _Window) -> None:
         if w.spec and w.toks is not None:
@@ -1410,6 +1485,10 @@ class TPUEngine(AsyncEngine):
             lps = np.asarray(w.toks[1]) if want_lp else None
             top_vs = np.asarray(w.toks[2]) if want_lp else None
             top_is = np.asarray(w.toks[3]) if want_lp else None
+            # Decode phase: dispatch -> readback complete (asarray blocks
+            # on the device program).
+            if self.phase is not None and w.t0:
+                self.phase.decode.observe(time.monotonic() - w.t0)
         else:
             toks = None
         self._release_ready_pages()
@@ -1479,6 +1558,11 @@ class TPUEngine(AsyncEngine):
             r.last_token = inp
             if finish is None and r.ctx.is_stopped:
                 finish = FinishReason.CANCELLED
+            if self._recorder.enabled and accepted:
+                self._recorder.add(
+                    "engine.decode", r.ctx.trace_id, r.ctx.span_id,
+                    w.t0, time.monotonic(),
+                    attrs={"tokens": len(accepted), "window": w.size})
             self._emit(r, accepted, finish, lp_out)
             if finish is not None:
                 self._finish_slot(i, register=True)
@@ -1493,6 +1577,8 @@ class TPUEngine(AsyncEngine):
         outs = np.asarray(w.toks[0])     # [m, B, S]
         emits = np.asarray(w.toks[1])    # [m, B]
         ndrafts = np.asarray(w.toks[2])  # [m, B]
+        if self.phase is not None and w.t0:
+            self.phase.decode.observe(time.monotonic() - w.t0)
         self._release_ready_pages()
         if self._pending_first:
             need = {i for i, snap in enumerate(w.slots)
@@ -1564,6 +1650,12 @@ class TPUEngine(AsyncEngine):
                 if delta > 0:
                     self.disp_positions[i] -= delta
                     self.disp_seq_lens[i] -= delta
+            if self._recorder.enabled and accepted:
+                self._recorder.add(
+                    "engine.decode", r.ctx.trace_id, r.ctx.span_id,
+                    w.t0, time.monotonic(),
+                    attrs={"tokens": len(accepted), "window": w.size,
+                           "spec": True})
             self._emit(r, accepted, finish, None)
             if finish is not None:
                 self._finish_slot(i, register=True)
@@ -1623,6 +1715,7 @@ class TPUEngine(AsyncEngine):
             return
         self.preempt_count += 1
         self.preempted_ids.append(r.ctx.id)
+        r.wait_noted = False  # the second queue stint records its own wait
         log.warning("KV pool exhausted: preempting slot %d (request %s, "
                     "%d tokens so far) and requeueing", slot, r.ctx.id,
                     len(r.tokens_all))

@@ -97,7 +97,7 @@ def parse_args(argv=None) -> argparse.Namespace:
     return args
 
 
-def _build_engine(args):
+def _build_engine(args, metrics_registry=None):
     if args.output == "echo":
         from dynamo_tpu.llm.engines import EchoEngine
         return EchoEngine(token_delay_s=0.005), make_test_tokenizer()
@@ -120,14 +120,16 @@ def _build_engine(args):
         tokenizer = Tokenizer.from_file(args.tokenizer)
     else:
         tokenizer = make_test_tokenizer()
-    engine = TPUEngine(cfg, params=params)
+    engine = TPUEngine(cfg, params=params,
+                       metrics_registry=metrics_registry)
     engine.start()
     return engine, tokenizer
 
 
-def build_local_served(args) -> tuple[ServedModel, object]:
+def build_local_served(args, metrics_registry=None
+                       ) -> tuple[ServedModel, object]:
     """Static pipeline: Preprocessor -> Backend -> engine, no network."""
-    engine, tokenizer = _build_engine(args)
+    engine, tokenizer = _build_engine(args, metrics_registry)
     name = args.model_name or os.path.basename(args.model.rstrip("/"))
     card = ModelDeploymentCard(
         name=name, chat_template=DEFAULT_CHAT_TEMPLATE,
@@ -255,7 +257,8 @@ async def run(args) -> None:
     else:
         runtime = await DistributedRuntime.detached(RuntimeConfig())
         manager = ModelManager()
-        served, engine = build_local_served(args)
+        served, engine = build_local_served(
+            args, runtime.metrics.namespace("local").component(args.output))
         manager.models[served.name] = served
         watcher = None
     try:

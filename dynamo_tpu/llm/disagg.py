@@ -25,6 +25,7 @@ updates, mirroring DisaggRouterConf::from_etcd_with_watcher.
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import AsyncIterator
 
 from dynamo_tpu.llm.kv_transfer import collect_prefill_response, kv_to_chunks
@@ -34,6 +35,7 @@ from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.errors import (
     EngineError, NoInstancesError, StreamIncompleteError)
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.tracing import span
 
 log = get_logger("disagg")
 
@@ -111,25 +113,43 @@ def make_prefill_handler(engine, plane=None):
             return
         req = (request if isinstance(request, PreprocessedRequest)
                else PreprocessedRequest.from_wire(request))
+        phase = getattr(engine, "phase", None)  # tracing.PhaseMetrics
         if plane is not None:
-            first_token, ticket, prompt_len = await engine.run_job(
-                lambda: engine.prefill_extract_staged(req, plane))
+            with span("kv.transfer.send", ctx=context, path="plane") as sp:
+                t0 = time.monotonic()
+                first_token, ticket, prompt_len = await engine.run_job(
+                    lambda: engine.prefill_extract_staged(req, plane))
+                sp.set(nbytes=int(ticket.get("nbytes", 0)),
+                       prompt_tokens=prompt_len)
+                if phase is not None:
+                    phase.kv_transfer.observe(time.monotonic() - t0,
+                                              direction="send")
+                    phase.kv_transfer_bytes.observe(
+                        ticket.get("nbytes", 0), direction="send")
             log.info("prefill parcel staged: %d tokens, ticket %d",
                      prompt_len, ticket["id"])
             yield LLMEngineOutput(
                 disagg_params={"ticket": ticket}).to_wire()
             yield LLMEngineOutput(token_ids=[first_token]).to_wire()
             return
-        first_token, kv, prompt_len = await engine.run_job(
-            lambda: engine.prefill_extract(req))
-        meta, chunks = kv_to_chunks(kv)
-        meta["prompt_len"] = prompt_len
-        yield LLMEngineOutput(disagg_params=meta).to_wire()
-        for chunk in chunks:
-            if context.is_killed or context.is_stopped:
-                return
-            yield LLMEngineOutput(
-                disagg_params={"kv_chunk": chunk}).to_wire()
+        with span("kv.transfer.send", ctx=context, path="inline") as sp:
+            t0 = time.monotonic()
+            first_token, kv, prompt_len = await engine.run_job(
+                lambda: engine.prefill_extract(req))
+            meta, chunks = kv_to_chunks(kv)
+            meta["prompt_len"] = prompt_len
+            sp.set(nbytes=int(kv.nbytes), chunks=len(chunks),
+                   prompt_tokens=prompt_len)
+            yield LLMEngineOutput(disagg_params=meta).to_wire()
+            for chunk in chunks:
+                if context.is_killed or context.is_stopped:
+                    return
+                yield LLMEngineOutput(
+                    disagg_params={"kv_chunk": chunk}).to_wire()
+            if phase is not None:
+                phase.kv_transfer.observe(time.monotonic() - t0, direction="send")
+                phase.kv_transfer_bytes.observe(kv.nbytes,
+                                                direction="send")
         yield LLMEngineOutput(token_ids=[first_token]).to_wire()
 
     return handle
@@ -214,11 +234,13 @@ class DisaggDecodeHandler:
         the request)."""
         try:
             if self.queue_dispatcher is not None:
-                return await self.queue_dispatcher.remote_prefill(req)
+                return await self.queue_dispatcher.remote_prefill(
+                    req, context=context)
             stream = await self.prefill_client.round_robin(
                 req.to_wire(), context=context)
             return await collect_prefill_response(
-                stream, plane_client=self.plane_client)
+                stream, plane_client=self.plane_client,
+                metrics=getattr(self.engine, "phase", None))
         except (NoInstancesError, StreamIncompleteError, EngineError,
                 ConnectionError, OSError, RuntimeError) as exc:
             self.remote_failures += 1

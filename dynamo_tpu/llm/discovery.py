@@ -20,6 +20,7 @@ from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.engine import AsyncEngine
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.tracing import span
 
 log = get_logger("discovery")
 
@@ -34,9 +35,10 @@ class RouterEngine(AsyncEngine):
         self.router_mode = router_mode
 
     async def generate(self, request, context: Context) -> AsyncIterator[dict]:
-        stream = await self.client.generate(
-            request if isinstance(request, dict) else request.to_wire(),
-            context=context, mode=self.router_mode)
+        with span("router.decide", mode=self.router_mode):
+            stream = await self.client.generate(
+                request if isinstance(request, dict) else request.to_wire(),
+                context=context, mode=self.router_mode)
         async for item in stream:
             yield item
 

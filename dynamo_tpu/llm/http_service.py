@@ -28,7 +28,9 @@ from dynamo_tpu.llm.protocols import (
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.errors import (InvalidRequestError, NoInstancesError,
                                        OverloadedError)
-from dynamo_tpu.runtime.logging import get_logger, parse_traceparent
+from dynamo_tpu.runtime.logging import (current_trace, get_logger,
+                                        parse_traceparent)
+from dynamo_tpu.runtime.tracing import span
 
 log = get_logger("http")
 
@@ -105,6 +107,11 @@ class HttpService:
         app.router.add_get("/health", self._health)
         app.router.add_get("/live", self._live)
         app.router.add_get("/metrics", self._metrics)
+        # Tracing/profiling debug API (runtime/health.py): in-process
+        # pipelines get /debug/traces + /debug/profile on the frontend
+        # port too, not only on the per-worker status server.
+        from dynamo_tpu.runtime.health import add_debug_routes
+        add_debug_routes(app)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         ssl_ctx = None
@@ -132,6 +139,10 @@ class HttpService:
         trace = parse_traceparent(traceparent) if traceparent else None
         ctx = Context(trace_id=trace["trace_id"] if trace else None,
                       parent_span_id=trace["parent_id"] if trace else None)
+        # Publish the request's trace context so every log line this
+        # handler task emits carries trace_id/span_id (the formatters in
+        # runtime/logging.py read this contextvar).
+        current_trace.set({"trace_id": ctx.trace_id, "span_id": ctx.span_id})
         return ctx
 
     async def _sse_stream(self, request: web.Request, chunks: AsyncIterator[dict],
@@ -187,18 +198,21 @@ class HttpService:
                                    "model_not_found", 404)
             ctx = self._make_context(request)
             try:
-                chunks = served.preprocessor.generate(chat_req, ctx)
-                if chat_req.stream:
-                    resp = await self._sse_stream(request, chunks, ctx,
-                                                  chat_req.model)
+                with span("http.request", ctx=ctx, route=route,
+                          model=chat_req.model):
+                    chunks = served.preprocessor.generate(chat_req, ctx)
+                    if chat_req.stream:
+                        resp = await self._sse_stream(request, chunks, ctx,
+                                                      chat_req.model)
+                        self._m_requests.inc(route=route, status="200")
+                        return resp
+                    # Non-streaming: force the usage chunk through the
+                    # delta stream so the aggregate carries real token
+                    # counts.
+                    chat_req.stream_options = {"include_usage": True}
+                    full = await aggregate_chat_stream(chunks, 0)
                     self._m_requests.inc(route=route, status="200")
-                    return resp
-                # Non-streaming: force the usage chunk through the delta
-                # stream so the aggregate carries real token counts.
-                chat_req.stream_options = {"include_usage": True}
-                full = await aggregate_chat_stream(chunks, 0)
-                self._m_requests.inc(route=route, status="200")
-                return web.json_response(full)
+                    return web.json_response(full)
             except NoInstancesError as exc:
                 self._m_requests.inc(route=route, status="503")
                 return _error_body(str(exc), "service_unavailable", 503)
@@ -237,35 +251,41 @@ class HttpService:
                                    "model_not_found", 404)
             ctx = self._make_context(request)
             try:
-                if not comp_req.stream:
-                    # Force the usage chunk so the folded response has counts.
-                    comp_req.stream_options = {"include_usage": True}
-                chunks = served.preprocessor.generate_completion(comp_req, ctx)
-                if comp_req.stream:
-                    resp = await self._sse_stream(request, chunks, ctx,
-                                                  comp_req.model)
+                with span("http.request", ctx=ctx, route=route,
+                          model=comp_req.model):
+                    if not comp_req.stream:
+                        # Force the usage chunk so the folded response
+                        # has counts.
+                        comp_req.stream_options = {"include_usage": True}
+                    chunks = served.preprocessor.generate_completion(
+                        comp_req, ctx)
+                    if comp_req.stream:
+                        resp = await self._sse_stream(request, chunks, ctx,
+                                                      comp_req.model)
+                        self._m_requests.inc(route=route, status="200")
+                        return resp
+                    texts: list[str] = []
+                    finish = None
+                    meta: dict = {}
+                    usage = None
+                    async for chunk in chunks:
+                        meta = {k: chunk.get(k, meta.get(k))
+                                for k in ("id", "created")}
+                        if chunk.get("usage"):
+                            usage = chunk["usage"]
+                        for choice in chunk.get("choices", []):
+                            texts.append(choice.get("text") or "")
+                            finish = choice.get("finish_reason") or finish
                     self._m_requests.inc(route=route, status="200")
-                    return resp
-                texts: list[str] = []
-                finish = None
-                meta: dict = {}
-                usage = None
-                async for chunk in chunks:
-                    meta = {k: chunk.get(k, meta.get(k))
-                            for k in ("id", "created")}
-                    if chunk.get("usage"):
-                        usage = chunk["usage"]
-                    for choice in chunk.get("choices", []):
-                        texts.append(choice.get("text") or "")
-                        finish = choice.get("finish_reason") or finish
-                self._m_requests.inc(route=route, status="200")
-                return web.json_response({
-                    "id": meta.get("id"), "object": "text_completion",
-                    "created": meta.get("created"), "model": comp_req.model,
-                    "choices": [{"index": 0, "text": "".join(texts),
-                                 "finish_reason": finish, "logprobs": None}],
-                    "usage": usage or usage_block(0, 0),
-                })
+                    return web.json_response({
+                        "id": meta.get("id"), "object": "text_completion",
+                        "created": meta.get("created"),
+                        "model": comp_req.model,
+                        "choices": [{"index": 0, "text": "".join(texts),
+                                     "finish_reason": finish,
+                                     "logprobs": None}],
+                        "usage": usage or usage_block(0, 0),
+                    })
             except ValueError as exc:
                 self._m_requests.inc(route=route, status="400")
                 return _error_body(str(exc))
@@ -503,17 +523,19 @@ class HttpService:
                 self._m_requests.inc(route=route, status="400")
                 return _error_body(str(exc))
             ctx = self._make_context(request)
-            chunks = served.preprocessor.generate(chat_req, ctx)
-            if body.get("stream"):
-                resp = await self._responses_sse(request, chunks, ctx, model)
+            with span("http.request", ctx=ctx, route=route, model=model):
+                chunks = served.preprocessor.generate(chat_req, ctx)
+                if body.get("stream"):
+                    resp = await self._responses_sse(request, chunks, ctx,
+                                                     model)
+                    self._m_requests.inc(route=route, status="200")
+                    return resp
+                full = await aggregate_chat_stream(chunks, 0)
+                msg = full["choices"][0]["message"]
+                usage = full.get("usage") or {}
                 self._m_requests.inc(route=route, status="200")
-                return resp
-            full = await aggregate_chat_stream(chunks, 0)
-            msg = full["choices"][0]["message"]
-            usage = full.get("usage") or {}
-            self._m_requests.inc(route=route, status="200")
-            return web.json_response(_response_object(full, model,
-                                                      msg.get("content")))
+                return web.json_response(_response_object(full, model,
+                                                          msg.get("content")))
         except NoInstancesError as exc:
             self._m_requests.inc(route=route, status="503")
             return _error_body(str(exc), "service_unavailable", 503)

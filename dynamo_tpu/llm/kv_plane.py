@@ -594,8 +594,12 @@ class KvPlaneClient:
 
     # -- async wrappers ------------------------------------------------------
     async def pull(self, ticket: dict) -> np.ndarray:
-        return await asyncio.get_running_loop().run_in_executor(
-            None, self.pull_sync, ticket)
+        from dynamo_tpu.runtime.tracing import span
+
+        with span("kv.plane.pull", ticket=ticket.get("id"),
+                  nbytes=ticket.get("nbytes")):
+            return await asyncio.get_running_loop().run_in_executor(
+                None, self.pull_sync, ticket)
 
     async def fetch_blocks(self, addr: str, hashes: list[int],
                            max_blocks: int = 64):

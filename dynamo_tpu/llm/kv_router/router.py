@@ -29,6 +29,7 @@ from dynamo_tpu.llm.protocols import PreprocessedRequest
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.engine import AsyncEngine
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.tracing import span
 
 log = get_logger("kv_router")
 
@@ -140,21 +141,26 @@ class KvPushRouter(AsyncEngine):
                else PreprocessedRequest.from_wire(request))
         from dynamo_tpu.llm.tokens import compute_block_hashes
 
-        block_hashes = compute_block_hashes(req.token_ids, self.config.block_size)
-        request_blocks = max(1, len(block_hashes))
-        overlaps = self.indexer.tree.find_matches(block_hashes)
-        workers = self.client.instance_ids()
-        worker_id, overlap = self.scheduler.select(
-            workers, request_blocks, overlaps)
-        new_blocks = request_blocks - overlap
-        request_id = context.id
-        prefill_tokens = max(0, len(req.token_ids)
-                             - overlap * self.config.block_size)
-        self.sequences.add_request(worker_id, request_id, new_blocks,
-                                   prefill_tokens)
-        await self._publish_sync({
-            "kind": "add", "worker_id": worker_id, "request_id": request_id,
-            "blocks": new_blocks, "prefill_tokens": prefill_tokens})
+        with span("router.decide", mode="kv") as sp:
+            block_hashes = compute_block_hashes(req.token_ids,
+                                                self.config.block_size)
+            request_blocks = max(1, len(block_hashes))
+            overlaps = self.indexer.tree.find_matches(block_hashes)
+            workers = self.client.instance_ids()
+            worker_id, overlap = self.scheduler.select(
+                workers, request_blocks, overlaps)
+            sp.set(worker_id=f"{worker_id:x}", overlap_blocks=overlap,
+                   request_blocks=request_blocks)
+            new_blocks = request_blocks - overlap
+            request_id = context.id
+            prefill_tokens = max(0, len(req.token_ids)
+                                 - overlap * self.config.block_size)
+            self.sequences.add_request(worker_id, request_id, new_blocks,
+                                       prefill_tokens)
+            await self._publish_sync({
+                "kind": "add", "worker_id": worker_id,
+                "request_id": request_id, "blocks": new_blocks,
+                "prefill_tokens": prefill_tokens})
         req.estimated_prefix_hit_blocks = overlap
         prefill_done = False
         try:

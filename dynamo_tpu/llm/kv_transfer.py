@@ -15,9 +15,12 @@ worker's mesh re-shards on upload (runner.insert_pages), so 1-TP prefill ->
 
 from __future__ import annotations
 
+import time
 from typing import AsyncIterator
 
 import numpy as np
+
+from dynamo_tpu.runtime.tracing import span
 
 CHUNK_BYTES = 8 << 20  # 8 MiB response frames
 
@@ -50,35 +53,48 @@ def kv_from_chunks(meta: dict, chunks: list[bytes]) -> np.ndarray:
 
 
 async def collect_prefill_response(stream: AsyncIterator[dict],
-                                   plane_client=None
-                                   ) -> tuple[int, np.ndarray]:
+                                   plane_client=None,
+                                   metrics=None) -> tuple[int, np.ndarray]:
     """Assemble a prefill worker's response into (first_token, kv parcel).
 
     Two wire forms: a transfer TICKET (the worker staged the parcel on
     the direct KV data plane, llm/kv_plane.py — pull the bulk bytes
     there), or inline chunks (the v0 host-staged path, still emitted by
-    plane-less workers)."""
-    chunks: list[bytes] = []
-    meta = None
-    ticket = None
-    first_token = None
-    async for out in stream:
-        dp = out.get("disagg_params") or {}
-        if "ticket" in dp:
-            ticket = dp["ticket"]
-        if "kv_chunk" in dp:
-            chunks.append(dp["kv_chunk"])
-        if "shape" in dp:
-            meta = dp
-        toks = out.get("token_ids") or []
-        if toks:
-            first_token = toks[0]
-    if first_token is None or (meta is None and ticket is None):
-        raise RuntimeError("incomplete disaggregated prefill response")
-    if ticket is not None:
-        if plane_client is None:
-            raise RuntimeError(
-                "prefill worker sent a KV-plane ticket but this worker "
-                "has no plane client")
-        return first_token, await plane_client.pull(ticket)
-    return first_token, kv_from_chunks(meta, chunks)
+    plane-less workers). ``metrics`` (a tracing.PhaseMetrics) feeds the
+    kv_transfer_seconds/bytes histograms; the recv span records either
+    way."""
+    t0 = time.monotonic()
+    with span("kv.transfer.recv") as sp:
+        chunks: list[bytes] = []
+        meta = None
+        ticket = None
+        first_token = None
+        async for out in stream:
+            dp = out.get("disagg_params") or {}
+            if "ticket" in dp:
+                ticket = dp["ticket"]
+            if "kv_chunk" in dp:
+                chunks.append(dp["kv_chunk"])
+            if "shape" in dp:
+                meta = dp
+            toks = out.get("token_ids") or []
+            if toks:
+                first_token = toks[0]
+        if first_token is None or (meta is None and ticket is None):
+            raise RuntimeError("incomplete disaggregated prefill response")
+        if ticket is not None:
+            if plane_client is None:
+                raise RuntimeError(
+                    "prefill worker sent a KV-plane ticket but this worker "
+                    "has no plane client")
+            kv = await plane_client.pull(ticket)
+            sp.set(path="plane", nbytes=int(kv.nbytes))
+        else:
+            kv = kv_from_chunks(meta, chunks)
+            sp.set(path="inline", nbytes=int(kv.nbytes),
+                   chunks=len(chunks))
+    if metrics is not None:
+        metrics.kv_transfer.observe(time.monotonic() - t0,
+                                    direction="recv")
+        metrics.kv_transfer_bytes.observe(kv.nbytes, direction="recv")
+    return first_token, kv

@@ -24,6 +24,7 @@ from dynamo_tpu.llm.tokens import TokenBlockSequence
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.engine import AsyncEngine
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.tracing import get_recorder
 
 log = get_logger("mocker")
 
@@ -132,6 +133,10 @@ class _Seq:
         self.generated = 0
         self.prefill_done_at: float | None = None
         self.cached_prefix_blocks = 0
+        # Tracing phase boundaries (monotonic).
+        self.enqueue_mono = time.monotonic()
+        self.prefill_mono: float | None = None
+        self.decode_mono: float | None = None
 
 
 class MockerEngine(AsyncEngine):
@@ -197,6 +202,15 @@ class MockerEngine(AsyncEngine):
                 if now >= seq.prefill_done_at:
                     self.prefilling.remove(seq)
                     self.decoding.append(seq)
+                    rec = get_recorder()
+                    if rec.enabled and seq.prefill_mono is not None:
+                        rec.add("engine.prefill", seq.ctx.trace_id,
+                                seq.ctx.span_id, seq.prefill_mono,
+                                time.monotonic(),
+                                attrs={"prompt_tokens": len(seq.req.token_ids),
+                                       "cached_blocks":
+                                       seq.cached_prefix_blocks})
+                    seq.decode_mono = time.monotonic()
                     # First token is produced by the prefill itself.
                     self._emit_token(seq)
             # One decode iteration for the whole batch.
@@ -234,6 +248,11 @@ class MockerEngine(AsyncEngine):
             seq.cached_prefix_blocks = cached
             new_tokens = len(seq.req.token_ids) - cached * cfg.block_size
             self.waiting.pop(0)
+            rec = get_recorder()
+            if rec.enabled:
+                rec.add("engine.queue_wait", seq.ctx.trace_id,
+                        seq.ctx.span_id, seq.enqueue_mono, time.monotonic())
+            seq.prefill_mono = time.monotonic()
             seq.prefill_done_at = now + cfg.prefill_time(max(0, new_tokens))
             self.prefilling.append(seq)
 
@@ -262,6 +281,12 @@ class MockerEngine(AsyncEngine):
     def _finish(self, seq: _Seq, reason: FinishReason | None) -> None:
         if seq in self.decoding:
             self.decoding.remove(seq)
+        rec = get_recorder()
+        if rec.enabled and seq.decode_mono is not None:
+            rec.add("engine.decode", seq.ctx.trace_id, seq.ctx.span_id,
+                    seq.decode_mono, time.monotonic(),
+                    attrs={"tokens": seq.generated})
+            seq.decode_mono = None
         self.kv.release(seq.blocks.block_hashes)
         if reason is not None:
             seq.out_q.put_nowait(LLMEngineOutput(

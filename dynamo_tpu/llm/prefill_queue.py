@@ -21,11 +21,14 @@ disaggregation's load-leveling term).
 from __future__ import annotations
 
 import asyncio
+import time
 import uuid
 
 from dynamo_tpu.llm.model_card import model_slug
 from dynamo_tpu.llm.protocols import PreprocessedRequest
+from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.tracing import get_recorder, span
 
 log = get_logger("prefill_queue")
 
@@ -85,8 +88,25 @@ class QueuePrefillWorker:
         reply = item.get("reply")
         try:
             req = PreprocessedRequest.from_wire(item["req"])
-            first_token, ticket, prompt_len = await self.engine.run_job(
-                lambda: self.engine.prefill_extract_staged(req, self.plane))
+            # The dispatcher's trace context rides the queue item, so the
+            # queue hop shows up in the request's distributed trace. The
+            # dequeue-wait span uses the enqueue wall timestamp (same
+            # clock domain is fine for the in-cluster case this serves).
+            ctx = Context.from_wire(item.get("ctx"))
+            rec = get_recorder()
+            if rec.enabled and item.get("t_enq"):
+                waited = max(0.0, time.time() - item["t_enq"])
+                now = time.monotonic()
+                rec.add("prefill_queue.wait", ctx.trace_id,
+                        ctx.parent_span_id, now - waited, now,
+                        attrs={"queue": self.queue})
+            with span("prefill_queue.serve", ctx=ctx,
+                      queue=self.queue) as sp:
+                first_token, ticket, prompt_len = await self.engine.run_job(
+                    lambda: self.engine.prefill_extract_staged(
+                        req, self.plane))
+                sp.set(prompt_tokens=prompt_len,
+                       nbytes=int(ticket.get("nbytes", 0)))
             self.pulled += 1
             log.info("queue prefill served: %d tokens, ticket %d",
                      prompt_len, ticket["id"])
@@ -116,9 +136,11 @@ class QueuePrefillDispatcher:
         self.enqueued = 0
         self.backpressured = 0
 
-    async def remote_prefill(self, req: PreprocessedRequest):
+    async def remote_prefill(self, req: PreprocessedRequest,
+                             context: Context | None = None):
         """Returns (first_token, kv) or None (backpressure/timeout/error —
-        caller prefills locally)."""
+        caller prefills locally). ``context`` threads the request's trace
+        onto the queue item so the prefill worker's spans join it."""
         depth = await self.client.queue_len(self.queue)
         if depth >= self.max_queue_depth:
             # The queue-depth-driven prefill-load split: deep queue means
@@ -130,22 +152,29 @@ class QueuePrefillDispatcher:
         reply = REPLY_PREFIX + uuid.uuid4().hex
         sub = await self.client.subscribe(reply)
         try:
-            await self.client.queue_push(
-                self.queue, {"req": req.to_wire(), "reply": reply})
-            self.enqueued += 1
-            try:
-                msg = await asyncio.wait_for(sub.__aiter__().__anext__(),
-                                             timeout=self.reply_timeout)
-            except asyncio.TimeoutError:
-                log.warning("prefill queue reply timed out after %.0fs",
-                            self.reply_timeout)
-                return None
-            payload = msg["payload"]
-            if "error" in payload:
-                log.warning("queued prefill failed remotely: %s",
-                            payload["error"])
-                return None
-            kv = await self.plane_client.pull(payload["ticket"])
-            return payload["first_token"], kv
+            with span("prefill_queue.dispatch", ctx=context,
+                      queue=self.queue, depth=depth) as sp:
+                item = {"req": req.to_wire(), "reply": reply,
+                        "t_enq": time.time()}
+                if context is not None:
+                    item["ctx"] = context.to_wire()
+                await self.client.queue_push(self.queue, item)
+                self.enqueued += 1
+                try:
+                    msg = await asyncio.wait_for(
+                        sub.__aiter__().__anext__(),
+                        timeout=self.reply_timeout)
+                except asyncio.TimeoutError:
+                    log.warning("prefill queue reply timed out after %.0fs",
+                                self.reply_timeout)
+                    return None
+                payload = msg["payload"]
+                if "error" in payload:
+                    log.warning("queued prefill failed remotely: %s",
+                                payload["error"])
+                    return None
+                kv = await self.plane_client.pull(payload["ticket"])
+                sp.set(nbytes=int(kv.nbytes))
+                return payload["first_token"], kv
         finally:
             await sub.cancel()

@@ -9,8 +9,15 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import tomllib
 from typing import Any
+
+try:  # tomllib is stdlib from 3.11; fall back to tomli, else TOML-less.
+    import tomllib
+except ModuleNotFoundError:  # pragma: no cover - depends on interpreter
+    try:
+        import tomli as tomllib  # type: ignore[no-redef]
+    except ModuleNotFoundError:
+        tomllib = None  # type: ignore[assignment]
 
 ENV_PREFIX = "DTPU_"
 
@@ -78,6 +85,10 @@ class RuntimeConfig:
         cfg = cls()
         toml_path = path or _env("CONFIG_PATH")
         if toml_path and os.path.exists(toml_path):
+            if tomllib is None:
+                raise RuntimeError(
+                    f"config file {toml_path!r} given but no TOML parser is "
+                    "available (python < 3.11 without tomli)")
             with open(toml_path, "rb") as fh:
                 data: dict[str, Any] = tomllib.load(fh)
             for field in dataclasses.fields(cls):

@@ -12,7 +12,8 @@ import asyncio
 import uuid
 from typing import Any
 
-from dynamo_tpu.runtime.logging import generate_span_id, generate_trace_id
+from dynamo_tpu.runtime.logging import (generate_span_id, generate_trace_id,
+                                        make_traceparent, parse_traceparent)
 
 
 class Context:
@@ -58,9 +59,20 @@ class Context:
         return ctx
 
     def to_wire(self) -> dict:
-        return {"id": self.id, "trace_id": self.trace_id, "span_id": self.span_id}
+        # The W3C traceparent rides every inter-component frame alongside
+        # the explicit ids, so a frontend trace id shows up in worker
+        # spans (distributed tracing, not per-process timing).
+        return {"id": self.id, "trace_id": self.trace_id,
+                "span_id": self.span_id,
+                "traceparent": make_traceparent(self.trace_id, self.span_id)}
 
     @classmethod
     def from_wire(cls, data: dict | None) -> "Context":
         data = data or {}
-        return cls(data.get("id"), data.get("trace_id"), data.get("span_id"))
+        trace_id, parent_id = data.get("trace_id"), data.get("span_id")
+        if trace_id is None and data.get("traceparent"):
+            # Frames from peers that only speak W3C: parse the header.
+            parsed = parse_traceparent(data["traceparent"])
+            if parsed:
+                trace_id, parent_id = parsed["trace_id"], parsed["parent_id"]
+        return cls(data.get("id"), trace_id, parent_id)

@@ -1,21 +1,75 @@
-"""System status server: /health, /live, /metrics.
+"""System status server: /health, /live, /metrics, /debug/*.
 
 Capability parity with reference spawn_system_status_server
 (lib/runtime/src/system_status_server.rs:85-121) and SystemHealth
 (lib.rs:90-120): per-process HTTP server exposing liveness, per-endpoint health,
 and Prometheus metrics, gated by config (DTPU_SYSTEM_ENABLED/PORT ~
-DYN_SYSTEM_*, config.rs:85-123).
+DYN_SYSTEM_*, config.rs:85-123). On top of the reference's surface it also
+serves the tracing debug API (runtime/tracing.py):
+
+- ``GET /debug/traces/recent``            — newest-first trace index
+- ``GET /debug/traces?trace_id=&format=`` — one trace (chrome|otlp|spans)
+- ``POST /debug/profile``                 — on-demand jax.profiler capture
+  (``{"duration_ms": 1000, "out_dir": "/tmp/prof"}``), degrading to a
+  span-recorder dump when JAX profiling is unavailable.
 """
 
 from __future__ import annotations
 
 import json
+import tempfile
 
 from aiohttp import web
 
+from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.logging import get_logger
 
 log = get_logger("health")
+
+
+def add_debug_routes(app: web.Application) -> None:
+    """Attach the tracing/profiling debug routes (shared with the OpenAI
+    frontend so in-process pipelines get them without a status server)."""
+    app.router.add_get("/debug/traces", _debug_traces)
+    app.router.add_get("/debug/traces/recent", _debug_traces_recent)
+    app.router.add_post("/debug/profile", _debug_profile)
+
+
+async def _debug_traces_recent(request: web.Request) -> web.Response:
+    limit = int(request.query.get("limit", "50"))
+    return web.json_response(tracing.traces_index(limit=limit))
+
+
+async def _debug_traces(request: web.Request) -> web.Response:
+    trace_id = request.query.get("trace_id")
+    if not trace_id:
+        return await _debug_traces_recent(request)
+    fmt = request.query.get("format", "chrome")
+    try:
+        payload = tracing.trace_payload(trace_id, fmt)
+    except ValueError as exc:
+        return web.json_response({"error": str(exc)}, status=400)
+    if payload is None:
+        return web.json_response(
+            {"error": f"trace {trace_id!r} not found (evicted or never "
+             "recorded; recorder enabled="
+             f"{tracing.get_recorder().enabled})"}, status=404)
+    return web.json_response(payload)
+
+
+async def _debug_profile(request: web.Request) -> web.Response:
+    try:
+        body = await request.json()
+    except (json.JSONDecodeError, ValueError):
+        body = {}
+    duration_ms = int(body.get("duration_ms", 1000))
+    out_dir = body.get("out_dir") or tempfile.mkdtemp(prefix="dtpu-profile-")
+    try:
+        result = await tracing.capture_profile(duration_ms, out_dir)
+    except RuntimeError as exc:  # capture already running
+        return web.json_response({"error": str(exc)}, status=409)
+    log.info("profile captured: %s", result)
+    return web.json_response(result)
 
 
 class SystemStatusServer:
@@ -33,6 +87,7 @@ class SystemStatusServer:
         app.router.add_get("/health", self._health)
         app.router.add_get("/live", self._live)
         app.router.add_get("/metrics", self._metrics)
+        add_debug_routes(app)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)

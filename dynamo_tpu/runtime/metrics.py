@@ -10,7 +10,7 @@ prometheus_client; exposition text is served by the system status server.
 from __future__ import annotations
 
 import threading
-from typing import Sequence
+from typing import NamedTuple, Sequence
 
 from prometheus_client import (
     CollectorRegistry,
@@ -24,6 +24,13 @@ from prometheus_client import (
 PREFIX = "dynamo_tpu"
 
 HIER_LABELS = ("dynamo_namespace", "dynamo_component", "dynamo_endpoint")
+
+
+class HistogramValue(NamedTuple):
+    """Snapshot of a histogram child: observation count + sum."""
+
+    count: int
+    total: float
 
 
 class MetricsRegistry:
@@ -64,12 +71,27 @@ class MetricsRegistry:
         labelnames = tuple(HIER_LABELS) + tuple(extra_labels)
         root = self._root
         with root._lock:
-            found = root._metrics.get(full)
-            if found is None:
-                found = kind(full, desc, labelnames=labelnames,
-                             registry=self.registry, **kwargs)
-                root._metrics[full] = found
-        return found
+            entry = root._metrics.get(full)
+            if entry is None:
+                metric = kind(full, desc, labelnames=labelnames,
+                              registry=self.registry, **kwargs)
+                root._metrics[full] = (metric, kind, labelnames)
+                return metric
+            metric, known_kind, known_labels = entry
+            # Same name, different shape: without this check the first
+            # registration silently wins and prometheus_client throws a
+            # confusing labels() error at CALL time, far from the bug.
+            if known_kind is not kind:
+                raise ValueError(
+                    f"metric {full!r} already registered as "
+                    f"{known_kind.__name__}, cannot re-register as "
+                    f"{kind.__name__}")
+            if known_labels != labelnames:
+                raise ValueError(
+                    f"metric {full!r} already registered with labels "
+                    f"{list(known_labels)}, cannot re-register with "
+                    f"{list(labelnames)}")
+        return metric
 
     def counter(self, name: str, desc: str, labels: Sequence[str] = ()):
         metric = self._get_or_create(Counter, name, desc, labels)
@@ -117,7 +139,25 @@ class _Bound:
     def observe(self, value: float, **labels):
         self._resolve(**labels).observe(value)
 
-    def get(self, **labels) -> float:
+    def ensure(self, **labels) -> None:
+        """Instantiate the labeled child so the series shows up in
+        exposition before its first update (dashboards see zeros, not
+        absent series)."""
+        self._resolve(**labels)
+
+    def get(self, **labels):
+        """Current value: float for counters/gauges, HistogramValue
+        (count, total) for histograms. Raises TypeError for metric types
+        with neither, instead of poking missing internals."""
         child = self._resolve(**labels)
         # prometheus_client internals: _value for counter/gauge.
-        return child._value.get()  # type: ignore[attr-defined]
+        if hasattr(child, "_value"):
+            return child._value.get()
+        if hasattr(child, "_sum"):  # histogram
+            # _buckets holds per-bucket (non-cumulative) counts; the
+            # observation count is their sum.
+            return HistogramValue(
+                count=int(sum(b.get() for b in child._buckets)),
+                total=child._sum.get())
+        raise TypeError(
+            f"get() unsupported for {type(self._metric).__name__}")

@@ -20,6 +20,7 @@ from dynamo_tpu.runtime.component import Endpoint, Instance
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.frame import read_frame, write_frame
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.tracing import span
 
 log = get_logger("service")
 
@@ -146,10 +147,17 @@ class EndpointServer:
         self._m_inflight.inc()
         started = time.monotonic()
         try:
-            async for response in self._handler(request, ctx):
-                if ctx.is_killed:
-                    break
-                await send({"t": "data", "rid": rid, "p": response})
+            # The ctx ids arrived on the wire frame (Context.to_wire
+            # carries the traceparent), so this span joins the CALLER's
+            # trace: frontend http.request -> this worker.request — and
+            # publishes trace_id/span_id to the log formatters for the
+            # whole handler task.
+            with span("worker.request", ctx=ctx,
+                      endpoint=self._endpoint.path):
+                async for response in self._handler(request, ctx):
+                    if ctx.is_killed:
+                        break
+                    await send({"t": "data", "rid": rid, "p": response})
             if ctx.is_killed:
                 await send({"t": "err", "rid": rid, "e": "killed"})
             else:

@@ -1,0 +1,522 @@
+"""End-to-end request tracing: spans, ring-buffer recorder, exporters.
+
+Capability parity with the reference's W3C trace-context threading
+(lib/runtime/src/logging.rs:111-175) plus what the Rust side delegates to
+the OTEL SDK: actually *recording* spans so "why was this request slow?"
+is answerable without a debugger. Pieces:
+
+- ``span(name, ctx=..., **attrs)`` — a context manager (sync AND async)
+  that records start/end monotonic+wall timestamps, parent/child links
+  (via a contextvar, or an explicit request ``Context``), status
+  (ok/error/cancelled), and attributes.
+- ``SpanRecorder`` — a bounded in-process ring buffer with per-trace
+  assembly and two exporters: Chrome trace-event JSON (loadable in
+  Perfetto / ``chrome://tracing``) and OTLP-JSON-shaped dicts.
+- a module-global recorder (``DTPU_TRACING=0`` disables, default
+  capacity ``DTPU_TRACE_CAPACITY=8192``) with a no-op fast path: when
+  disabled, ``span()`` returns a shared singleton and ``add()`` returns
+  immediately — zero allocations on the per-token path.
+- ``phase_metrics(registry)`` — the per-phase latency histograms
+  (queue wait / prefill / decode / KV transfer) every span-producing
+  site also feeds, so SLO dashboards get phase breakdowns, not just
+  edge TTFT/ITL.
+- ``capture_profile(...)`` — the on-demand ``jax.profiler`` hook behind
+  ``POST /debug/profile``, degrading to a span-recorder dump when JAX
+  profiling is unavailable.
+
+Threading: spans are recorded from the event loop AND the engine thread;
+the recorder takes a lock per record (one append per span, not per
+token). Contextvar parenting is per-thread/per-task by construction;
+engine-thread spans link explicitly via (trace_id, parent_id) instead.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import contextvars
+import json
+import os
+import threading
+import time
+
+from dynamo_tpu.runtime.logging import (current_trace, generate_span_id,
+                                        generate_trace_id, get_logger)
+
+log = get_logger("tracing")
+
+# The active span for the current task/thread (parenting).
+current_span: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "dtpu_span", default=None)
+
+
+class Span:
+    """One recorded operation. ``end_mono`` is None while open."""
+
+    __slots__ = ("trace_id", "span_id", "parent_span_id", "name",
+                 "start_wall", "start_mono", "end_mono", "status", "attrs",
+                 "thread_id")
+
+    def __init__(self, trace_id: str, span_id: str,
+                 parent_span_id: str | None, name: str,
+                 start_wall: float, start_mono: float,
+                 attrs: dict | None = None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_span_id = parent_span_id
+        self.name = name
+        self.start_wall = start_wall
+        self.start_mono = start_mono
+        self.end_mono: float | None = None
+        self.status = "ok"
+        self.attrs = attrs
+        self.thread_id = threading.get_ident()
+
+    @property
+    def duration_s(self) -> float:
+        end = self.end_mono if self.end_mono is not None else self.start_mono
+        return end - self.start_mono
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_span_id": self.parent_span_id,
+            "name": self.name,
+            "start_wall": self.start_wall,
+            "start_mono": self.start_mono,
+            "duration_s": self.duration_s,
+            "status": self.status,
+            "attrs": self.attrs or {},
+        }
+
+
+class SpanRecorder:
+    """Bounded ring buffer of finished spans with per-trace assembly."""
+
+    def __init__(self, capacity: int = 8192, enabled: bool = True):
+        self.capacity = capacity
+        self.enabled = enabled
+        self._spans: collections.deque[Span] = collections.deque(
+            maxlen=capacity)
+        self._lock = threading.Lock()
+        self.dropped = 0  # spans evicted by the ring (observability)
+
+    # -- recording ------------------------------------------------------------
+    def record(self, span: Span) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            if len(self._spans) == self.capacity:
+                self.dropped += 1
+            self._spans.append(span)
+
+    def add(self, name: str, trace_id: str, parent_id: str | None,
+            start_mono: float, end_mono: float, status: str = "ok",
+            attrs: dict | None = None) -> str | None:
+        """Record an already-timed span (engine-thread hot paths measure
+        their own intervals; no contextvar juggling). Returns the span id,
+        or None when disabled (fast path: one attribute read, no
+        allocation)."""
+        if not self.enabled:
+            return None
+        now_mono = time.monotonic()
+        span = Span(trace_id=trace_id, span_id=generate_span_id(),
+                    parent_span_id=parent_id, name=name,
+                    start_wall=time.time() - (now_mono - start_mono),
+                    start_mono=start_mono, attrs=attrs)
+        span.end_mono = end_mono
+        span.status = status
+        self.record(span)
+        return span.span_id
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    # -- per-trace assembly ---------------------------------------------------
+    def trace(self, trace_id: str) -> list[Span]:
+        with self._lock:
+            spans = [s for s in self._spans if s.trace_id == trace_id]
+        spans.sort(key=lambda s: s.start_mono)
+        return spans
+
+    def recent(self, limit: int = 50) -> list[dict]:
+        """Newest-first index of recorded traces (for /debug/traces/recent)."""
+        with self._lock:
+            snapshot = list(self._spans)
+        by_trace: dict[str, list[Span]] = {}
+        for s in snapshot:
+            by_trace.setdefault(s.trace_id, []).append(s)
+        out = []
+        for trace_id, spans in by_trace.items():
+            ids = {s.span_id for s in spans}
+            roots = [s for s in spans
+                     if s.parent_span_id is None
+                     or s.parent_span_id not in ids]
+            root = min(roots or spans, key=lambda s: s.start_mono)
+            t0 = min(s.start_mono for s in spans)
+            t1 = max(s.end_mono or s.start_mono for s in spans)
+            out.append({
+                "trace_id": trace_id,
+                "root": root.name,
+                "start_wall": root.start_wall,
+                "spans": len(spans),
+                "duration_s": t1 - t0,
+                "status": ("error" if any(s.status == "error" for s in spans)
+                           else "ok"),
+            })
+        out.sort(key=lambda e: e["start_wall"], reverse=True)
+        return out[:limit]
+
+    # -- exporters ------------------------------------------------------------
+    def export_chrome(self, trace_id: str | None = None) -> dict:
+        """Chrome trace-event JSON ("X" complete events, microsecond
+        timestamps relative to the earliest span) — drop the payload in
+        Perfetto or chrome://tracing."""
+        spans = (self.trace(trace_id) if trace_id is not None
+                 else sorted(self._snapshot(), key=lambda s: s.start_mono))
+        events = []
+        if spans:
+            base = min(s.start_mono for s in spans)
+            pid = os.getpid()
+            for s in spans:
+                args = dict(s.attrs or {})
+                args["trace_id"] = s.trace_id
+                args["span_id"] = s.span_id
+                if s.parent_span_id:
+                    args["parent_span_id"] = s.parent_span_id
+                if s.status != "ok":
+                    args["status"] = s.status
+                events.append({
+                    "name": s.name,
+                    "ph": "X",
+                    "ts": (s.start_mono - base) * 1e6,
+                    "dur": s.duration_s * 1e6,
+                    "pid": pid,
+                    "tid": s.thread_id,
+                    "cat": "dtpu",
+                    "args": args,
+                })
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def export_otlp(self, trace_id: str | None = None) -> dict:
+        """OTLP/JSON-shaped dict (ExportTraceServiceRequest): importable
+        by any OTLP-JSON consumer without an OTEL SDK dependency."""
+        spans = (self.trace(trace_id) if trace_id is not None
+                 else sorted(self._snapshot(), key=lambda s: s.start_mono))
+        status_code = {"ok": 1, "error": 2, "cancelled": 2}
+        otlp_spans = []
+        for s in spans:
+            start_ns = int(s.start_wall * 1e9)
+            otlp_spans.append({
+                "traceId": s.trace_id,
+                "spanId": s.span_id,
+                "parentSpanId": s.parent_span_id or "",
+                "name": s.name,
+                "kind": 1,  # SPAN_KIND_INTERNAL
+                "startTimeUnixNano": str(start_ns),
+                "endTimeUnixNano": str(start_ns + int(s.duration_s * 1e9)),
+                "status": {"code": status_code.get(s.status, 0)},
+                "attributes": [
+                    {"key": k, "value": _otlp_value(v)}
+                    for k, v in (s.attrs or {}).items()
+                ],
+            })
+        return {"resourceSpans": [{
+            "resource": {"attributes": [{
+                "key": "service.name",
+                "value": {"stringValue": "dynamo-tpu"}}]},
+            "scopeSpans": [{
+                "scope": {"name": "dynamo_tpu.runtime.tracing"},
+                "spans": otlp_spans,
+            }],
+        }]}
+
+    def _snapshot(self) -> list[Span]:
+        with self._lock:
+            return list(self._spans)
+
+
+def _otlp_value(v) -> dict:
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": str(v)}
+
+
+# -- module-global recorder ----------------------------------------------------
+
+def _env_enabled() -> bool:
+    return os.environ.get("DTPU_TRACING", "1").strip().lower() not in (
+        "0", "false", "no", "off")
+
+
+_RECORDER = SpanRecorder(
+    capacity=int(os.environ.get("DTPU_TRACE_CAPACITY", "8192") or 8192),
+    enabled=_env_enabled())
+
+
+def get_recorder() -> SpanRecorder:
+    return _RECORDER
+
+
+def set_enabled(flag: bool) -> None:
+    _RECORDER.enabled = flag
+
+
+class _NullSpan:
+    """Shared no-op span: the disabled-recorder fast path allocates
+    nothing (``span(...)`` returns this singleton)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    async def __aenter__(self):
+        return self
+
+    async def __aexit__(self, *exc):
+        return False
+
+    def set(self, **attrs) -> None:
+        pass
+
+
+NULL_SPAN = _NullSpan()
+
+
+class span:
+    """Record one span around a block. Usable as both ``with span(...)``
+    and ``async with span(...)``.
+
+    Parenting: an explicit request ``Context`` pins the span to that
+    request's identity (span_id = ctx.span_id, parent = ctx.parent_span_id
+    — the ids already propagated on wire frames), otherwise the ambient
+    ``current_span`` contextvar parents it; with neither, a new root
+    trace starts. While open, the span also publishes itself to
+    ``current_trace`` so log lines carry trace_id/span_id.
+    """
+
+    __slots__ = ("_name", "_ctx", "_attrs", "_recorder", "_span",
+                 "_tok_span", "_tok_trace")
+
+    def __new__(cls, name: str, ctx=None, recorder: SpanRecorder | None = None,
+                **attrs):
+        rec = recorder if recorder is not None else _RECORDER
+        if not rec.enabled:
+            return NULL_SPAN
+        self = object.__new__(cls)
+        self._name = name
+        self._ctx = ctx
+        self._attrs = attrs or None
+        self._recorder = rec
+        self._span = None
+        self._tok_span = None
+        self._tok_trace = None
+        return self
+
+    def set(self, **attrs) -> None:
+        """Attach attributes to the open span."""
+        if self._span is not None:
+            if self._span.attrs is None:
+                self._span.attrs = {}
+            self._span.attrs.update(attrs)
+
+    # -- sync protocol --------------------------------------------------------
+    def __enter__(self) -> "span":
+        parent = current_span.get()
+        if self._ctx is not None:
+            trace_id = self._ctx.trace_id
+            span_id = self._ctx.span_id
+            parent_id = self._ctx.parent_span_id
+            if parent is not None and parent.trace_id == trace_id:
+                # Nested under an already-open local span of the same
+                # trace (e.g. the worker.request span already carries
+                # ctx.span_id): parent locally and mint a fresh id so
+                # the child never collides with its parent.
+                parent_id = parent.span_id
+                span_id = generate_span_id()
+        elif parent is not None:
+            trace_id = parent.trace_id
+            span_id = generate_span_id()
+            parent_id = parent.span_id
+        else:
+            trace_id = generate_trace_id()
+            span_id = generate_span_id()
+            parent_id = None
+        s = Span(trace_id=trace_id, span_id=span_id, parent_span_id=parent_id,
+                 name=self._name, start_wall=time.time(),
+                 start_mono=time.monotonic(), attrs=self._attrs)
+        self._span = s
+        self._tok_span = current_span.set(s)
+        self._tok_trace = current_trace.set(
+            {"trace_id": trace_id, "span_id": span_id})
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        s = self._span
+        s.end_mono = time.monotonic()
+        if exc_type is not None:
+            s.status = ("cancelled"
+                        if issubclass(exc_type, asyncio.CancelledError)
+                        else "error")
+            if s.status == "error":
+                if s.attrs is None:
+                    s.attrs = {}
+                s.attrs.setdefault("error", f"{exc_type.__name__}: {exc}")
+        for var, tok in ((current_span, self._tok_span),
+                         (current_trace, self._tok_trace)):
+            try:
+                var.reset(tok)
+            except ValueError:
+                # Token from another context (generator finalized
+                # elsewhere): drop the reset rather than crash cleanup.
+                pass
+        self._recorder.record(s)
+        return False
+
+    # -- async protocol -------------------------------------------------------
+    async def __aenter__(self) -> "span":
+        return self.__enter__()
+
+    async def __aexit__(self, exc_type, exc, tb) -> bool:
+        return self.__exit__(exc_type, exc, tb)
+
+
+# -- per-phase latency histograms ----------------------------------------------
+
+_LATENCY_BUCKETS = (.0005, .001, .0025, .005, .01, .025, .05, .1, .25, .5,
+                    1.0, 2.5, 5.0, 10.0, 30.0)
+_BYTES_BUCKETS = (1 << 12, 1 << 16, 1 << 20, 4 << 20, 16 << 20, 64 << 20,
+                  256 << 20, 1 << 30)
+
+
+class PhaseMetrics:
+    """The four phase histograms (+ transfer bytes) on a MetricsRegistry
+    node. Every constructor touches its hierarchy-labeled child so the
+    series appear in /metrics exposition before first traffic."""
+
+    def __init__(self, registry):
+        self.queue_wait = registry.histogram(
+            "request_queue_wait_seconds",
+            "Time a request waited for engine admission",
+            buckets=_LATENCY_BUCKETS)
+        self.prefill = registry.histogram(
+            "prefill_step_seconds",
+            "Prefill dispatch to first-token readback",
+            buckets=_LATENCY_BUCKETS)
+        self.decode = registry.histogram(
+            "decode_step_seconds",
+            "Decode window dispatch to host processing",
+            buckets=_LATENCY_BUCKETS)
+        self.kv_transfer = registry.histogram(
+            "kv_transfer_seconds",
+            "KV parcel transfer (send or recv) duration",
+            ["direction"], buckets=_LATENCY_BUCKETS)
+        self.kv_transfer_bytes = registry.histogram(
+            "kv_transfer_bytes",
+            "KV parcel transfer size in bytes",
+            ["direction"], buckets=_BYTES_BUCKETS)
+        for bound in (self.queue_wait, self.prefill, self.decode):
+            bound.ensure()
+        for direction in ("send", "recv"):
+            self.kv_transfer.ensure(direction=direction)
+            self.kv_transfer_bytes.ensure(direction=direction)
+
+
+def phase_metrics(registry) -> PhaseMetrics:
+    """Get-or-create the phase histograms for a registry node (cached on
+    the ROOT registry per hierarchy position: node objects are ephemeral
+    — ``namespace()``/``component()`` mint a new one per call — so
+    repeated wiring of the same position stays idempotent)."""
+    root = getattr(registry, "_root", registry)
+    cache = getattr(root, "_dtpu_phase_metrics", None)
+    if cache is None:
+        cache = root._dtpu_phase_metrics = {}
+    key = getattr(registry, "_hierarchy", None)
+    cached = cache.get(key)
+    if cached is None:
+        cached = cache[key] = PhaseMetrics(registry)
+    return cached
+
+
+# -- debug endpoint payloads (shared by health.py and http_service.py) --------
+
+def traces_index(recorder: SpanRecorder | None = None,
+                 limit: int = 50) -> dict:
+    rec = recorder or _RECORDER
+    return {"enabled": rec.enabled, "capacity": rec.capacity,
+            "dropped": rec.dropped, "traces": rec.recent(limit)}
+
+
+def trace_payload(trace_id: str, fmt: str = "chrome",
+                  recorder: SpanRecorder | None = None) -> dict | None:
+    """Export one trace; None when the trace id is unknown."""
+    rec = recorder or _RECORDER
+    if not rec.trace(trace_id):
+        return None
+    if fmt == "chrome":
+        return rec.export_chrome(trace_id)
+    if fmt == "otlp":
+        return rec.export_otlp(trace_id)
+    if fmt == "spans":
+        return {"trace_id": trace_id,
+                "spans": [s.to_dict() for s in rec.trace(trace_id)]}
+    raise ValueError(f"unknown trace format {fmt!r} "
+                     "(expected chrome|otlp|spans)")
+
+
+# -- on-demand profiler capture ------------------------------------------------
+
+_profile_lock = threading.Lock()  # one capture at a time per process
+
+
+async def capture_profile(duration_ms: int, out_dir: str,
+                          recorder: SpanRecorder | None = None) -> dict:
+    """Capture ``duration_ms`` of runtime activity into ``out_dir``.
+
+    Preferred mode: a ``jax.profiler`` trace (TensorBoard/Perfetto
+    loadable) covering device programs — one curl away from a TPU
+    hot-path investigation. When JAX profiling is unavailable (CPU-only
+    builds, profiler already claimed), degrades to dumping the span
+    recorder's current contents as Chrome trace JSON so the capture is
+    never empty-handed.
+    """
+    duration_ms = max(1, min(int(duration_ms), 60_000))
+    os.makedirs(out_dir, exist_ok=True)
+    if not _profile_lock.acquire(blocking=False):
+        raise RuntimeError("a profile capture is already running")
+    try:
+        started = time.monotonic()
+        mode = "jax"
+        try:
+            import jax
+
+            jax.profiler.start_trace(out_dir)
+            try:
+                await asyncio.sleep(duration_ms / 1e3)
+            finally:
+                jax.profiler.stop_trace()
+        except Exception as exc:  # noqa: BLE001 — degrade, never fail
+            log.warning("jax profiler capture unavailable (%s); "
+                        "dumping span recorder instead", exc)
+            mode = "spans"
+            await asyncio.sleep(duration_ms / 1e3)
+        rec = recorder or _RECORDER
+        span_path = os.path.join(out_dir, "spans.chrome.json")
+        with open(span_path, "w") as fh:
+            json.dump(rec.export_chrome(), fh)
+        return {"mode": mode, "out_dir": out_dir,
+                "span_dump": span_path,
+                "duration_ms": duration_ms,
+                "wall_s": round(time.monotonic() - started, 3)}
+    finally:
+        _profile_lock.release()

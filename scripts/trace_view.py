@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Terminal waterfall viewer for dynamo-tpu request traces.
+
+Fetches ``/debug/traces`` from a running frontend/status server (or reads
+a dumped trace file) and prints a per-request waterfall: phase, start
+offset, duration, and an ASCII gantt bar — the "why was this request
+slow?" view without leaving the terminal.
+
+Usage:
+    python scripts/trace_view.py http://127.0.0.1:8000
+    python scripts/trace_view.py http://127.0.0.1:8000 --trace-id <id>
+    python scripts/trace_view.py /tmp/prof/spans.chrome.json
+
+With no --trace-id, the newest recorded trace is shown.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.parse
+import urllib.request
+
+BAR_WIDTH = 32
+
+
+def _fetch_json(url: str) -> dict:
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read().decode())
+
+
+def load_spans_from_url(base: str, trace_id: str | None) -> list[dict]:
+    base = base.rstrip("/")
+    if trace_id is None:
+        index = _fetch_json(f"{base}/debug/traces/recent")
+        traces = index.get("traces") or []
+        if not traces:
+            raise SystemExit("no traces recorded (is DTPU_TRACING on?)")
+        trace_id = traces[0]["trace_id"]
+    qs = urllib.parse.urlencode({"trace_id": trace_id, "format": "spans"})
+    return _fetch_json(f"{base}/debug/traces?{qs}")["spans"]
+
+
+def load_spans_from_file(path: str) -> list[dict]:
+    """Accepts a ``format=spans`` dump or a Chrome trace-event file (what
+    /debug/profile writes)."""
+    with open(path) as fh:
+        data = json.load(fh)
+    if "spans" in data:
+        return data["spans"]
+    if "traceEvents" in data:
+        out = []
+        for e in data["traceEvents"]:
+            args = e.get("args", {})
+            out.append({
+                "name": e["name"],
+                "start_mono": e["ts"] / 1e6,
+                "duration_s": e.get("dur", 0) / 1e6,
+                "span_id": args.get("span_id"),
+                "parent_span_id": args.get("parent_span_id"),
+                "trace_id": args.get("trace_id"),
+                "status": args.get("status", "ok"),
+                "attrs": {k: v for k, v in args.items()
+                          if k not in ("span_id", "parent_span_id",
+                                       "trace_id", "status")},
+            })
+        return out
+    raise SystemExit(f"{path}: neither a spans dump nor a Chrome trace")
+
+
+def _depth_of(span: dict, by_id: dict) -> int:
+    depth = 0
+    seen = set()
+    parent = span.get("parent_span_id")
+    while parent in by_id and parent not in seen:
+        seen.add(parent)
+        parent = by_id[parent].get("parent_span_id")
+        depth += 1
+    return depth
+
+
+def render_waterfall(spans: list[dict]) -> str:
+    """Pure renderer (unit-testable): one line per span, sorted by start,
+    indented by parent depth, with offset/duration columns and a gantt
+    bar scaled to the trace extent."""
+    if not spans:
+        return "(empty trace)\n"
+    spans = sorted(spans, key=lambda s: s["start_mono"])
+    by_id = {s["span_id"]: s for s in spans if s.get("span_id")}
+    t0 = min(s["start_mono"] for s in spans)
+    t1 = max(s["start_mono"] + s.get("duration_s", 0) for s in spans)
+    extent = max(t1 - t0, 1e-9)
+    trace_id = spans[0].get("trace_id") or "?"
+    lines = [f"trace {trace_id}  ({len(spans)} spans, "
+             f"{extent * 1e3:.2f} ms)",
+             f"{'offset':>10}  {'dur':>10}  {'span':<40} waterfall"]
+    for s in spans:
+        off = s["start_mono"] - t0
+        dur = s.get("duration_s", 0)
+        lo = int(off / extent * BAR_WIDTH)
+        hi = max(lo + 1, int((off + dur) / extent * BAR_WIDTH))
+        bar = " " * lo + "#" * (hi - lo)
+        name = "  " * _depth_of(s, by_id) + s["name"]
+        status = "" if s.get("status", "ok") == "ok" else \
+            f" [{s['status'].upper()}]"
+        attrs = s.get("attrs") or {}
+        attr_txt = (" " + ",".join(f"{k}={v}" for k, v in attrs.items())
+                    if attrs else "")
+        lines.append(f"{off * 1e3:>8.2f}ms  {dur * 1e3:>8.2f}ms  "
+                     f"{name:<40} |{bar:<{BAR_WIDTH}}|{status}{attr_txt}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("source",
+                        help="base URL (http://host:port) or trace file")
+    parser.add_argument("--trace-id", default=None,
+                        help="trace to show (default: newest)")
+    args = parser.parse_args(argv)
+    if args.source.startswith(("http://", "https://")):
+        spans = load_spans_from_url(args.source, args.trace_id)
+    else:
+        spans = load_spans_from_file(args.source)
+        if args.trace_id:
+            spans = [s for s in spans
+                     if s.get("trace_id") == args.trace_id]
+    sys.stdout.write(render_waterfall(spans))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

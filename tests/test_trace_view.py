@@ -1,0 +1,87 @@
+"""Unit tests for scripts/trace_view.py over a canned span set."""
+
+import importlib.util
+import json
+import pathlib
+
+_spec = importlib.util.spec_from_file_location(
+    "trace_view",
+    pathlib.Path(__file__).resolve().parent.parent / "scripts"
+    / "trace_view.py")
+trace_view = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_spec and trace_view)
+
+CANNED = [
+    {"name": "http.request", "span_id": "a1", "parent_span_id": None,
+     "trace_id": "t" * 32, "start_mono": 100.000, "duration_s": 0.050,
+     "status": "ok", "attrs": {"route": "chat_completions"}},
+    {"name": "router.decide", "span_id": "b2", "parent_span_id": "a1",
+     "trace_id": "t" * 32, "start_mono": 100.001, "duration_s": 0.002,
+     "status": "ok", "attrs": {}},
+    {"name": "engine.prefill", "span_id": "c3", "parent_span_id": "a1",
+     "trace_id": "t" * 32, "start_mono": 100.005, "duration_s": 0.020,
+     "status": "ok", "attrs": {"prompt_tokens": 128}},
+    {"name": "engine.decode", "span_id": "d4", "parent_span_id": "a1",
+     "trace_id": "t" * 32, "start_mono": 100.027, "duration_s": 0.021,
+     "status": "error", "attrs": {}},
+]
+
+
+def test_waterfall_layout():
+    out = trace_view.render_waterfall(CANNED)
+    lines = out.strip().splitlines()
+    # Header carries the trace id and total extent (50 ms).
+    assert ("t" * 32) in lines[0]
+    assert "50.00 ms" in lines[0]
+    body = lines[2:]
+    # Sorted by start offset, phases in request order.
+    assert [line.split()[2].rstrip("ms") or line for line in body]
+    names_in_order = [
+        next(w for w in line.split() if not w[0].isdigit() and w[0] != "|")
+        for line in body]
+    assert names_in_order == ["http.request", "router.decide",
+                              "engine.prefill", "engine.decode"]
+    # Offsets: first span at 0, decode at 27 ms.
+    assert body[0].lstrip().startswith("0.00ms")
+    assert body[3].lstrip().startswith("27.00ms")
+    # Children are indented under the root.
+    assert "  router.decide" in body[1]
+    # Error status surfaces.
+    assert "[ERROR]" in body[3]
+    # Attrs print.
+    assert "prompt_tokens=128" in body[2]
+    # Gantt bars exist and the root bar spans the whole width.
+    assert body[0].count("#") == trace_view.BAR_WIDTH
+
+
+def test_waterfall_empty_and_depth_cycle_safe():
+    assert "empty" in trace_view.render_waterfall([])
+    # A (corrupt) parent cycle must not hang the depth walk.
+    cyc = [
+        {"name": "a", "span_id": "x", "parent_span_id": "y",
+         "trace_id": "t", "start_mono": 0.0, "duration_s": 0.001},
+        {"name": "b", "span_id": "y", "parent_span_id": "x",
+         "trace_id": "t", "start_mono": 0.0005, "duration_s": 0.001},
+    ]
+    out = trace_view.render_waterfall(cyc)
+    assert "a" in out and "b" in out
+
+
+def test_load_spans_from_chrome_file(tmp_path):
+    chrome = {"traceEvents": [
+        {"name": "root", "ph": "X", "ts": 0.0, "dur": 1000.0, "pid": 1,
+         "tid": 1, "args": {"span_id": "a", "trace_id": "t" * 32}},
+        {"name": "leaf", "ph": "X", "ts": 100.0, "dur": 200.0, "pid": 1,
+         "tid": 1, "args": {"span_id": "b", "parent_span_id": "a",
+                            "trace_id": "t" * 32, "tokens": 4}},
+    ], "displayTimeUnit": "ms"}
+    path = tmp_path / "dump.json"
+    path.write_text(json.dumps(chrome))
+    spans = trace_view.load_spans_from_file(str(path))
+    assert len(spans) == 2
+    leaf = [s for s in spans if s["name"] == "leaf"][0]
+    assert leaf["parent_span_id"] == "a"
+    assert leaf["attrs"] == {"tokens": 4}
+    assert abs(leaf["duration_s"] - 0.0002) < 1e-12
+    out = trace_view.render_waterfall(spans)
+    assert "  leaf" in out
