@@ -1,0 +1,58 @@
+"""dtpu-lint: repo-native static analysis for async/JAX/wire hazards.
+
+Usage (CLI): ``python -m dynamo_tpu.analysis [paths] [--json]``
+Usage (API)::
+
+    from dynamo_tpu.analysis import analyze_paths
+    findings = analyze_paths(["dynamo_tpu"])
+
+Rule catalog and suppression syntax: docs/ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from dynamo_tpu.analysis.core import (
+    Finding, Module, ProjectRule, Rule, analyze, load_paths)
+from dynamo_tpu.analysis.rules_async import (
+    BlockingCallInAsync, FireAndForgetTask, LockAcrossAwait,
+    SwallowedCancellation)
+from dynamo_tpu.analysis.rules_jax import JitRecompileHazard
+from dynamo_tpu.analysis.rules_wire import WireErrorTaxonomy
+
+__all__ = [
+    "Finding", "Module", "Rule", "ProjectRule", "analyze", "load_paths",
+    "DEFAULT_RULES", "default_rules", "analyze_paths",
+]
+
+DEFAULT_RULES: tuple[type[Rule], ...] = (
+    BlockingCallInAsync,
+    FireAndForgetTask,
+    LockAcrossAwait,
+    SwallowedCancellation,
+    JitRecompileHazard,
+    WireErrorTaxonomy,
+)
+
+
+def default_rules(select: Iterable[str] | None = None) -> list[Rule]:
+    """Instantiate the rule set, optionally narrowed to specific ids."""
+    wanted = None if select is None else set(select)
+    rules = [cls() for cls in DEFAULT_RULES]
+    if wanted is not None:
+        unknown = wanted - {r.rule_id for r in rules}
+        if unknown:
+            raise ValueError(f"unknown rule id(s): {', '.join(sorted(unknown))}")
+        rules = [r for r in rules if r.rule_id in wanted]
+    return rules
+
+
+def analyze_paths(paths: Iterable[str],
+                  select: Iterable[str] | None = None) -> list[Finding]:
+    modules, failed = load_paths(paths)
+    findings = analyze(modules, default_rules(select))
+    findings.extend(
+        Finding(path, 1, 0, "parse-error", "file could not be parsed")
+        for path in failed)
+    return findings

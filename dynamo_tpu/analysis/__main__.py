@@ -1,0 +1,60 @@
+"""CLI: ``python -m dynamo_tpu.analysis [paths] [--json] [--select ids]``.
+
+Exit codes: 0 clean, 1 findings (or unparseable files), 2 usage error.
+With no paths, analyzes the installed dynamo_tpu package — so the bare
+module invocation is the repo gate scripts/check.sh runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from dynamo_tpu.analysis import analyze_paths, default_rules
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m dynamo_tpu.analysis",
+        description="dtpu-lint: async/JAX/wire hazard analyzer")
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories (default: the "
+                             "dynamo_tpu package)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit findings as a JSON array")
+    parser.add_argument("--select", metavar="IDS",
+                        help="comma-separated rule ids to run")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in default_rules():
+            print(f"{rule.rule_id}\n    {rule.description}")
+        return 0
+
+    select = ([s.strip() for s in args.select.split(",") if s.strip()]
+              if args.select else None)
+    paths = args.paths or [str(Path(__file__).resolve().parent.parent)]
+    try:
+        findings = analyze_paths(paths, select)
+    except ValueError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        print(json.dumps([f.to_json() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        if findings:
+            print(f"\n{len(findings)} finding(s). Fix, or suppress with "
+                  "`# dtpu: ignore[rule-id]  -- rationale` "
+                  "(see docs/ANALYSIS.md).", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,222 @@
+"""dtpu-lint core: module loading, rule registry, suppressions, reporting.
+
+The runtime gave up Rust's type/borrow discipline when it ported Dynamo's
+request plane to Python — this framework is the replacement: repo-native
+AST rules that turn one-off advisor findings (blocked event loops, leaked
+tasks, wire-prefix drift) into machine-checked invariants enforced by the
+tier-1 gate (tests/test_analysis_clean.py).
+
+Anatomy:
+  - ``Module``: one parsed source file (AST with parent links + per-line
+    suppressions).
+  - ``Rule``: per-file check — ``check(module) -> Iterable[Finding]``.
+  - ``ProjectRule``: cross-module check — sees every module at once
+    (e.g. wire-error-taxonomy needs errors.py + service.py + client.py).
+  - ``analyze(modules, rules)``: run everything, drop suppressed findings.
+
+Suppressions: ``# dtpu: ignore[rule-id]`` (comma-separate several ids, or
+omit the bracket to silence every rule) on the flagged line or on a
+comment line directly above it. Suppression comments should carry a
+rationale after the directive — the analyzer doesn't parse it, reviewers
+read it.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Iterable, Iterator
+
+__all__ = [
+    "Finding", "Module", "Rule", "ProjectRule", "analyze",
+    "load_module", "load_paths", "qualified_name", "iter_scope",
+]
+
+_SUPPRESS_RE = re.compile(r"#\s*dtpu:\s*ignore(?:\[([A-Za-z0-9_,\- ]*)\])?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation, pointing at a file:line with a fix hint."""
+
+    path: str
+    line: int
+    col: int
+    rule_id: str
+    message: str
+    hint: str = ""
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}:{self.col}"
+        out = f"{loc}: [{self.rule_id}] {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+
+class Module:
+    """A parsed source file plus the lookup structures rules need."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        # Parent links let rules walk outward (enclosing function/loop)
+        # without threading visitor state.
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                child._dtpu_parent = node  # type: ignore[attr-defined]
+        self.suppressions = self._parse_suppressions()
+
+    def _parse_suppressions(self) -> dict[int, set[str] | None]:
+        """line -> suppressed rule ids (None = all rules)."""
+        out: dict[int, set[str] | None] = {}
+        for i, line in enumerate(self.lines, 1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            ids = m.group(1)
+            if ids is None or not ids.strip():
+                out[i] = None
+            else:
+                out[i] = {s.strip() for s in ids.split(",") if s.strip()}
+        return out
+
+    def is_suppressed(self, line: int, rule_id: str) -> bool:
+        """True when the flagged line — or a standalone comment directly
+        above it — carries a matching ``# dtpu: ignore`` directive."""
+        for ln in (line, line - 1):
+            ids = self.suppressions.get(ln, "missing")
+            if ids == "missing":
+                continue
+            if ln == line - 1:
+                # The line above only counts when it is a pure comment —
+                # a directive trailing unrelated code governs that code.
+                text = self.lines[ln - 1].strip() if ln - 1 < len(self.lines) else ""
+                if not text.startswith("#"):
+                    continue
+            if ids is None or rule_id in ids:
+                return True
+        return False
+
+    def parent(self, node: ast.AST) -> ast.AST | None:
+        return getattr(node, "_dtpu_parent", None)
+
+    def enclosing_function(self, node: ast.AST):
+        """Nearest enclosing (Async)FunctionDef/Lambda, or None."""
+        n = self.parent(node)
+        while n is not None:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                return n
+            n = self.parent(n)
+        return None
+
+    def in_async_scope(self, node: ast.AST) -> bool:
+        """True when the node executes inside an ``async def`` body (the
+        nearest function scope is async; nested sync defs break it)."""
+        fn = self.enclosing_function(node)
+        return isinstance(fn, ast.AsyncFunctionDef)
+
+
+class Rule:
+    """Per-file rule. Subclass and implement ``check``."""
+
+    rule_id: str = ""
+    description: str = ""
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, module: Module, node: ast.AST, message: str,
+                hint: str = "") -> Finding:
+        return Finding(module.path, getattr(node, "lineno", 1),
+                       getattr(node, "col_offset", 0), self.rule_id,
+                       message, hint)
+
+
+class ProjectRule(Rule):
+    """Cross-module rule: sees the whole module set at once."""
+
+    def check_project(self, modules: list[Module]) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def check(self, module: Module) -> Iterable[Finding]:  # pragma: no cover
+        return ()
+
+
+# -- AST helpers shared by rules ---------------------------------------------
+
+def qualified_name(node: ast.AST) -> str:
+    """Dotted name for Name/Attribute chains ('', when not a plain chain)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = qualified_name(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    return ""
+
+
+def iter_scope(body: list[ast.stmt]) -> Iterator[ast.AST]:
+    """Walk statements without descending into nested function/class
+    definitions — 'does THIS function body contain an await' questions."""
+    stack: list[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# -- loading + running --------------------------------------------------------
+
+def load_module(path: str | Path) -> Module | None:
+    """Parse one file; returns None for unparseable sources (reported by
+    the CLI as its own diagnostic, not a crash)."""
+    p = Path(path)
+    try:
+        source = p.read_text(encoding="utf-8")
+        tree = ast.parse(source, filename=str(p))
+    except (OSError, SyntaxError, ValueError):
+        return None
+    return Module(str(p), source, tree)
+
+
+def load_paths(paths: Iterable[str | Path]) -> tuple[list[Module], list[str]]:
+    """Expand files/directories to parsed Modules (+ unparseable paths)."""
+    modules: list[Module] = []
+    failed: list[str] = []
+    for raw in paths:
+        p = Path(raw)
+        files = sorted(p.rglob("*.py")) if p.is_dir() else [p]
+        for f in files:
+            mod = load_module(f)
+            if mod is None:
+                failed.append(str(f))
+            else:
+                modules.append(mod)
+    return modules, failed
+
+
+def analyze(modules: list[Module], rules: list[Rule]) -> list[Finding]:
+    findings: list[Finding] = []
+    by_path = {m.path: m for m in modules}
+    for rule in rules:
+        if isinstance(rule, ProjectRule):
+            raw = rule.check_project(modules)
+        else:
+            raw = (f for m in modules for f in rule.check(m))
+        for f in raw:
+            mod = by_path.get(f.path)
+            if mod is not None and mod.is_suppressed(f.line, f.rule_id):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule_id))
+    return findings

@@ -1,0 +1,283 @@
+"""Async-correctness rules: the event loop and task-lifetime hazards that
+review keeps missing in a 245-coroutine codebase.
+
+All four rules only consider code whose *nearest* enclosing function is an
+``async def`` — a sync helper thread defined inside an async module (the
+KV plane's socket loops, the engine thread) is free to block.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from dynamo_tpu.analysis.core import (
+    Finding, Module, Rule, iter_scope, qualified_name)
+
+# Calls that park the event loop. Exact dotted names (module-level
+# functions); method names are handled separately because receivers
+# need type inference we approximate with assignment tracking.
+_BLOCKING_CALLS = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "os.system": "use `asyncio.create_subprocess_shell` or run in a thread",
+    "subprocess.run": "use `asyncio.create_subprocess_exec` or `asyncio.to_thread`",
+    "subprocess.call": "use `asyncio.create_subprocess_exec`",
+    "subprocess.check_call": "use `asyncio.create_subprocess_exec`",
+    "subprocess.check_output": "use `asyncio.create_subprocess_exec`",
+    "socket.create_connection": "use `asyncio.open_connection`",
+    "socket.getaddrinfo": "use `loop.getaddrinfo`",
+    "socket.gethostbyname": "use `loop.getaddrinfo`",
+    "urllib.request.urlopen": "use an async HTTP client or `asyncio.to_thread`",
+    "requests.get": "use an async HTTP client or `asyncio.to_thread`",
+    "requests.post": "use an async HTTP client or `asyncio.to_thread`",
+    "requests.request": "use an async HTTP client or `asyncio.to_thread`",
+}
+
+_QUEUE_CTORS = {"queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+                "queue.SimpleQueue", "Queue", "LifoQueue", "PriorityQueue",
+                "SimpleQueue"}
+
+
+def _kw(call: ast.Call, name: str) -> ast.expr | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _is_false(node: ast.expr | None) -> bool:
+    return isinstance(node, ast.Constant) and node.value is False
+
+
+class BlockingCallInAsync(Rule):
+    rule_id = "blocking-call-in-async"
+    description = ("Synchronous blocking call (sleep, subprocess, socket, "
+                   "file or thread-queue I/O, Future.result, "
+                   "block_until_ready) inside `async def` parks the event "
+                   "loop for every request on it")
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        thread_queues = self._thread_queues(module)
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            task_names = self._async_future_names(fn)
+            for node in iter_scope(fn.body):
+                if not isinstance(node, ast.Call):
+                    continue
+                qual = qualified_name(node.func)
+                if qual in _BLOCKING_CALLS:
+                    yield self.finding(
+                        module, node,
+                        f"blocking call `{qual}(...)` inside async "
+                        f"function `{fn.name}`",
+                        _BLOCKING_CALLS[qual])
+                    continue
+                if qual == "open":
+                    yield self.finding(
+                        module, node,
+                        f"synchronous file I/O `open(...)` inside async "
+                        f"function `{fn.name}`",
+                        "move the I/O into `asyncio.to_thread`/"
+                        "`run_in_executor`, or suppress with a rationale "
+                        "if it is one-shot startup I/O")
+                    continue
+                if not isinstance(node.func, ast.Attribute):
+                    continue
+                leaf = node.func.attr
+                recv = qualified_name(node.func.value)
+                if leaf == "block_until_ready":
+                    yield self.finding(
+                        module, node,
+                        f"`{recv}.block_until_ready()` blocks the event "
+                        f"loop on device completion in `{fn.name}`",
+                        "dispatch, then await the result via "
+                        "`asyncio.to_thread` or poll with async sleeps")
+                elif leaf == "result" and not node.args and not node.keywords:
+                    # .result() with a timeout is concurrent.futures-style
+                    # blocking wait; argless on an asyncio task/future it
+                    # is a non-blocking fetch — skip receivers we saw
+                    # created via create_task/ensure_future.
+                    if recv not in task_names:
+                        yield self.finding(
+                            module, node,
+                            f"`{recv}.result()` may block the event loop "
+                            f"in `{fn.name}` (concurrent.futures wait)",
+                            "await the future (`await asyncio.wrap_future"
+                            "(...)`) or confirm it is an already-completed "
+                            "asyncio task and suppress")
+                elif leaf == "result" and (node.args or node.keywords):
+                    yield self.finding(
+                        module, node,
+                        f"`{recv}.result(timeout)` blocks the event loop "
+                        f"in `{fn.name}`",
+                        "await the future instead")
+                elif leaf == "get" and recv in thread_queues:
+                    if not _is_false(_kw(node, "block")):
+                        yield self.finding(
+                            module, node,
+                            f"thread-queue `{recv}.get()` blocks the event "
+                            f"loop in `{fn.name}`",
+                            "use get_nowait()+retry, asyncio.Queue, or "
+                            "`asyncio.to_thread`")
+                elif leaf == "put" and recv in thread_queues:
+                    if thread_queues[recv] and not _is_false(_kw(node, "block")):
+                        yield self.finding(
+                            module, node,
+                            f"bounded thread-queue `{recv}.put()` can block "
+                            f"the event loop in `{fn.name}`",
+                            "use put_nowait() with a drop/backpressure "
+                            "policy, or `asyncio.to_thread`")
+
+    @staticmethod
+    def _thread_queues(module: Module) -> dict[str, bool]:
+        """Receiver qual -> bounded? for every `x = queue.Queue(...)` /
+        `self.x = queue.Queue(maxsize=...)` assignment in the module."""
+        queues: dict[str, bool] = {}
+        for node in ast.walk(module.tree):
+            targets: list[ast.expr] = []
+            value: ast.expr | None = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            if not isinstance(value, ast.Call):
+                continue
+            if qualified_name(value.func) not in _QUEUE_CTORS:
+                continue
+            size = value.args[0] if value.args else _kw(value, "maxsize")
+            bounded = size is not None and not (
+                isinstance(size, ast.Constant) and not size.value)
+            for t in targets:
+                name = qualified_name(t)
+                if name:
+                    queues[name] = bounded
+        return queues
+
+    @staticmethod
+    def _async_future_names(fn: ast.AsyncFunctionDef) -> set[str]:
+        """Local names bound to asyncio tasks/futures (create_task /
+        ensure_future) — their argless .result() is non-blocking."""
+        names: set[str] = set()
+        for node in iter_scope(fn.body):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                qual = qualified_name(node.value.func)
+                if qual.rsplit(".", 1)[-1] in ("create_task", "ensure_future"):
+                    for t in node.targets:
+                        name = qualified_name(t)
+                        if name:
+                            names.add(name)
+        return names
+
+
+class FireAndForgetTask(Rule):
+    rule_id = "fire-and-forget-task"
+    description = ("`asyncio.create_task`/`ensure_future` whose result is "
+                   "discarded — the event loop keeps only a weak reference, "
+                   "so the task can be garbage-collected mid-flight")
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Expr)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            qual = qualified_name(node.value.func)
+            if qual.rsplit(".", 1)[-1] in ("create_task", "ensure_future"):
+                yield self.finding(
+                    module, node,
+                    f"`{qual}(...)` result discarded: the task holds only "
+                    "a weak loop reference and may be GC-cancelled",
+                    "store it (self._task = ..., or a task set with "
+                    "add_done_callback(set.discard)) or await it")
+
+
+_LOCKISH = ("lock", "mutex", "sem")
+
+
+def _looks_like_lock(expr: ast.expr) -> str | None:
+    target = expr.func if isinstance(expr, ast.Call) else expr
+    qual = qualified_name(target)
+    leaf = qual.rsplit(".", 1)[-1].lower()
+    if any(k in leaf for k in _LOCKISH):
+        return qual
+    return None
+
+
+class LockAcrossAwait(Rule):
+    rule_id = "lock-across-await"
+    description = ("`await` inside a synchronous `with <lock>` block: the "
+                   "coroutine suspends while holding a thread lock, "
+                   "deadlocking every thread (and coroutine) that needs it")
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.With) or not module.in_async_scope(node):
+                continue
+            lock = next((q for item in node.items
+                         if (q := _looks_like_lock(item.context_expr))), None)
+            if lock is None:
+                continue
+            for sub in iter_scope(node.body):
+                if isinstance(sub, (ast.Await, ast.AsyncFor, ast.AsyncWith)):
+                    yield self.finding(
+                        module, sub,
+                        f"await while holding `{lock}` (acquired line "
+                        f"{node.lineno}): the lock stays held across the "
+                        "suspension",
+                        "release before awaiting, or use asyncio.Lock with "
+                        "`async with`")
+                    break
+
+
+_CANCELLED = {"asyncio.CancelledError", "CancelledError"}
+
+
+def _catches_cancellation(type_node: ast.expr | None) -> bool:
+    """Bare except / BaseException / explicit CancelledError inside a
+    tuple. A lone `except Exception` does NOT catch CancelledError on
+    py>=3.8 and a lone explicit CancelledError handler is intentional."""
+    if type_node is None:
+        return True
+    if isinstance(type_node, ast.Tuple):
+        return any(qualified_name(e) in _CANCELLED | {"BaseException"}
+                   for e in type_node.elts)
+    return qualified_name(type_node) == "BaseException"
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    for node in iter_scope(handler.body):
+        if isinstance(node, ast.Raise):
+            if node.exc is None:
+                return True
+            exc = node.exc.func if isinstance(node.exc, ast.Call) else node.exc
+            name = qualified_name(exc)
+            if name == handler.name or name in _CANCELLED:
+                return True
+    return False
+
+
+class SwallowedCancellation(Rule):
+    rule_id = "swallowed-cancellation"
+    description = ("except clause in async code that catches "
+                   "`asyncio.CancelledError` (bare / BaseException / tuple "
+                   "membership) without re-raising — cancellation never "
+                   "terminates the task")
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Try) or not module.in_async_scope(node):
+                continue
+            if not any(isinstance(s, ast.Await) for s in iter_scope(node.body)):
+                continue  # nothing cancellable inside the try
+            for handler in node.handlers:
+                if (_catches_cancellation(handler.type)
+                        and not _reraises(handler)):
+                    what = ("bare `except:`" if handler.type is None else
+                            f"`except {ast.unparse(handler.type)}`")
+                    yield self.finding(
+                        module, handler,
+                        f"{what} swallows asyncio.CancelledError around an "
+                        "await: task cancellation (shutdown, kill) is "
+                        "silently absorbed",
+                        "re-raise CancelledError (bare `raise`) or narrow "
+                        "the clause to `except Exception`")

@@ -1,0 +1,162 @@
+"""JAX compilation-hygiene rules.
+
+`jax.jit` returns a *new* compiled-callable cache every time it is
+called: constructing it per request / per step / per loop iteration
+recompiles (seconds of XLA time) on the serving hot path. The repo
+idiom is to build jitted programs once — at module scope, in
+``__init__``, or memoized into a cache dict keyed by shape bucket
+(engine/runner.py `_window_cache`) — and this rule enforces exactly
+that shape.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from dynamo_tpu.analysis.core import Finding, Module, Rule, qualified_name
+
+_JIT_QUALS = {"jax.jit", "jit"}
+_PARTIAL_QUALS = {"functools.partial", "partial"}
+_INIT_METHODS = {"__init__", "__post_init__", "__new__", "__init_subclass__"}
+
+
+def _is_jit_ctor(call: ast.Call) -> bool:
+    qual = qualified_name(call.func)
+    if qual in _JIT_QUALS:
+        return True
+    return (qual in _PARTIAL_QUALS and call.args
+            and qualified_name(call.args[0]) in _JIT_QUALS)
+
+
+class JitRecompileHazard(Rule):
+    rule_id = "jit-recompile-hazard"
+    description = ("`jax.jit` constructed inside a function or loop without "
+                   "being cached: every call recompiles; also flags "
+                   "unhashable static_argnums/static_argnames specs")
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call) and _is_jit_ctor(node):
+                yield from self._check_static_spec(module, node)
+                yield from self._check_scope(module, node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # bare `@jax.jit` decorator (a Name/Attribute, not a Call)
+                # on a def nested inside a function re-decorates — and
+                # recompiles — on every outer call.
+                for dec in node.decorator_list:
+                    if not isinstance(dec, ast.Call) \
+                            and qualified_name(dec) in _JIT_QUALS:
+                        outer = module.enclosing_function(node)
+                        oname = getattr(outer, "name", "<lambda>") \
+                            if outer is not None else None
+                        if oname is not None and oname not in _INIT_METHODS:
+                            yield self.finding(
+                                module, dec,
+                                f"`@jax.jit` on nested function "
+                                f"`{node.name}` inside `{oname}`: "
+                                "recompiles on every outer call",
+                                "hoist the jitted function to module "
+                                "scope or cache the compiled callable")
+
+    # -- unhashable static specs ---------------------------------------------
+    def _check_static_spec(self, module: Module,
+                           call: ast.Call) -> Iterable[Finding]:
+        for kw in call.keywords:
+            if kw.arg not in ("static_argnums", "static_argnames"):
+                continue
+            if isinstance(kw.value, (ast.List, ast.Set, ast.Dict)):
+                yield self.finding(
+                    module, kw.value,
+                    f"`{kw.arg}` given a mutable "
+                    f"{type(kw.value).__name__.lower()} display: jit cache "
+                    "keys must be hashable and the spec should be a "
+                    "tuple/int/str constant",
+                    "use a tuple of int/str constants")
+
+    # -- construction scope ---------------------------------------------------
+    def _check_scope(self, module: Module,
+                     call: ast.Call) -> Iterable[Finding]:
+        parent = module.parent(call)
+        # partial(jax.jit, ...) used purely as a decorator piece: judge
+        # the partial call (our caller walks every Call, so the inner
+        # jax.jit Name isn't a Call and only the partial arrives here).
+        if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and call in parent.decorator_list:
+            # decorator on a def: hazardous only when that def is itself
+            # nested inside a function (re-decorated per outer call).
+            outer = module.enclosing_function(parent)
+            if outer is not None:
+                name = getattr(outer, "name", "<lambda>")
+                if name not in _INIT_METHODS:
+                    yield self.finding(
+                        module, call,
+                        f"`@jit` decorator on nested function "
+                        f"`{parent.name}` inside `{name}`: recompiles on "
+                        "every outer call",
+                        "hoist the jitted function to module scope or "
+                        "cache the compiled callable")
+            return
+        fn = module.enclosing_function(call)
+        if fn is None:
+            return  # module / class scope: compiled once at import
+        name = getattr(fn, "name", "<lambda>")
+        if name in _INIT_METHODS:
+            return  # compiled once per instance, the repo idiom
+        loop = self._enclosing_loop(module, call, fn)
+        if loop is not None:
+            yield self.finding(
+                module, call,
+                f"`jax.jit` constructed inside a {type(loop).__name__} "
+                f"loop in `{name}`: recompiles every iteration",
+                "hoist construction out of the loop (memoize by shape "
+                "bucket if specialization is needed)")
+            return
+        if not self._is_cached(module, call, fn):
+            yield self.finding(
+                module, call,
+                f"`jax.jit` constructed in `{name}` without caching the "
+                "compiled callable: every call to the function recompiles",
+                "assign the result to an attribute / cache dict "
+                "(cf. runner.py _window_cache), or build it in __init__")
+
+    @staticmethod
+    def _enclosing_loop(module: Module, node: ast.AST, fn: ast.AST):
+        n = module.parent(node)
+        while n is not None and n is not fn:
+            if isinstance(n, (ast.For, ast.While, ast.AsyncFor)):
+                return n
+            n = module.parent(n)
+        return None
+
+    @staticmethod
+    def _is_cached(module: Module, call: ast.Call, fn) -> bool:
+        """The jit result escapes into instance/cache storage: directly
+        assigned to an Attribute/Subscript target, or assigned to a local
+        that is itself stored into an Attribute/Subscript somewhere in
+        the same function (`fn = jax.jit(...); self._cache[key] = fn`)."""
+        node: ast.AST = call
+        parent = module.parent(node)
+        # unwrap trivial wrappers between the jit call and the statement
+        while isinstance(parent, (ast.IfExp,)):
+            node, parent = parent, module.parent(parent)
+        if isinstance(parent, ast.Call) and parent.func is node:
+            return False  # jax.jit(f)(...): compiles per invocation
+        if isinstance(parent, (ast.Assign, ast.AnnAssign)):
+            targets = (parent.targets if isinstance(parent, ast.Assign)
+                       else [parent.target])
+            local_names = set()
+            for t in targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)):
+                    return True
+                if isinstance(t, ast.Name):
+                    local_names.add(t.id)
+            if local_names:
+                for sub in ast.walk(fn):
+                    if isinstance(sub, ast.Assign) \
+                            and isinstance(sub.value, ast.Name) \
+                            and sub.value.id in local_names \
+                            and any(isinstance(t, (ast.Attribute, ast.Subscript))
+                                    for t in sub.targets):
+                        return True
+        return False

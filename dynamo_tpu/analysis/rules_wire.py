@@ -1,0 +1,149 @@
+"""Wire-error-taxonomy rule: typed errors must survive the request plane.
+
+The request plane serializes handler exceptions to a string frame
+(`{"t": "err", "e": ...}`). A typed error class keeps its identity across
+that hop only if THREE places agree:
+
+  1. the class (runtime/errors.py) declares a ``WIRE_PREFIX``,
+  2. the server error handler (runtime/service.py) encodes it —
+     references ``Cls.WIRE_PREFIX`` when building the err frame,
+  3. the client decoder (runtime/client.py) decodes it — references
+     ``Cls.WIRE_PREFIX`` and re-raises the class.
+
+Round-5 ADVICE is the motivating failure: engine-raised OverloadedError
+had no prefix, arrived remotely as generic EngineError, and the frontend
+answered 500 instead of 503 — silently breaking router retry in exactly
+(and only) distributed deployments. This rule makes that drift a lint
+failure: any EngineError subclass raised from engine-side code
+(dynamo_tpu/engine/, dynamo_tpu/llm/) must carry a WIRE_PREFIX that both
+service.py and client.py reference.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from dynamo_tpu.analysis.core import (
+    Finding, Module, ProjectRule, qualified_name)
+
+_ERRORS_SUFFIX = "runtime/errors.py"
+_SERVICE_SUFFIX = "runtime/service.py"
+_CLIENT_SUFFIX = "runtime/client.py"
+_ROOT_CLASS = "EngineError"
+# Modules on the handler side of the plane: errors raised here cross the
+# wire back to the client decoder.
+_ENGINE_SIDE = ("/engine/", "/llm/")
+
+
+def _norm(path: str) -> str:
+    return path.replace("\\", "/")
+
+
+class WireErrorTaxonomy(ProjectRule):
+    rule_id = "wire-error-taxonomy"
+    description = ("every EngineError subclass raised by engine-side code "
+                   "needs a WIRE_PREFIX encoded in runtime/service.py and "
+                   "decoded in runtime/client.py, so HTTP status and retry "
+                   "semantics survive remote deployment")
+
+    def check_project(self, modules: list[Module]) -> Iterable[Finding]:
+        errors_mod = self._find(modules, _ERRORS_SUFFIX)
+        service_mod = self._find(modules, _SERVICE_SUFFIX)
+        client_mod = self._find(modules, _CLIENT_SUFFIX)
+        if errors_mod is None:
+            return  # partial run without the taxonomy: nothing to check
+        classes, prefixed = self._error_classes(errors_mod)
+        raised = self._engine_side_raises(modules, classes)
+        service_refs = (self._wire_prefix_refs(service_mod)
+                        if service_mod else None)
+        client_refs = (self._wire_prefix_refs(client_mod)
+                       if client_mod else None)
+
+        for cls, (mod, node) in sorted(raised.items()):
+            if cls not in prefixed:
+                yield Finding(
+                    mod.path, node.lineno, node.col_offset, self.rule_id,
+                    f"`{cls}` is raised by engine-side code but declares no "
+                    "WIRE_PREFIX: remotely it degrades to generic "
+                    "EngineError (HTTP 500, no retry)",
+                    f"add `WIRE_PREFIX = \"...\"` to {cls} and wire it "
+                    "through service.py encode + client.py decode")
+        for cls in sorted(prefixed):
+            line = classes[cls]
+            for refs, mod, role in ((service_refs, service_mod, "encoded"),
+                                    (client_refs, client_mod, "decoded")):
+                if refs is not None and cls not in refs:
+                    yield Finding(
+                        errors_mod.path, line, 0, self.rule_id,
+                        f"`{cls}.WIRE_PREFIX` is declared but never "
+                        f"{role} in {_norm(mod.path)}: the typed error "
+                        "cannot survive the request plane",
+                        f"reference `{cls}.WIRE_PREFIX` in the "
+                        f"{'error handler' if role == 'encoded' else 'stream decoder'}")
+
+    @staticmethod
+    def _find(modules: list[Module], suffix: str) -> Module | None:
+        for m in modules:
+            if _norm(m.path).endswith(suffix):
+                return m
+        return None
+
+    @staticmethod
+    def _error_classes(errors_mod: Module) -> tuple[dict[str, int], set[str]]:
+        """EngineError subclasses (name -> def line) and which of them
+        declare a string WIRE_PREFIX."""
+        bases: dict[str, list[str]] = {}
+        lines: dict[str, int] = {}
+        has_prefix: set[str] = set()
+        for node in errors_mod.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            bases[node.name] = [qualified_name(b) for b in node.bases]
+            lines[node.name] = node.lineno
+            for stmt in node.body:
+                if isinstance(stmt, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == "WIRE_PREFIX"
+                        for t in stmt.targets):
+                    has_prefix.add(node.name)
+        # transitive closure down from the root class
+        family = {_ROOT_CLASS}
+        changed = True
+        while changed:
+            changed = False
+            for name, bs in bases.items():
+                if name not in family and any(b in family for b in bs):
+                    family.add(name)
+                    changed = True
+        classes = {n: lines[n] for n in family if n in lines}
+        return classes, has_prefix & set(classes)
+
+    @staticmethod
+    def _engine_side_raises(modules: list[Module], classes: dict[str, int]
+                            ) -> dict[str, tuple[Module, ast.AST]]:
+        """class name -> first engine-side raise site."""
+        raised: dict[str, tuple[Module, ast.AST]] = {}
+        for mod in modules:
+            path = _norm(mod.path)
+            if not any(seg in path for seg in _ENGINE_SIDE):
+                continue
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Raise) or node.exc is None:
+                    continue
+                exc = (node.exc.func if isinstance(node.exc, ast.Call)
+                       else node.exc)
+                name = qualified_name(exc).rsplit(".", 1)[-1]
+                if name in classes and name not in raised:
+                    raised[name] = (mod, node)
+        return raised
+
+    @staticmethod
+    def _wire_prefix_refs(mod: Module) -> set[str]:
+        """Class names X for every `X.WIRE_PREFIX` attribute reference."""
+        refs: set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Attribute) and node.attr == "WIRE_PREFIX":
+                base = qualified_name(node.value).rsplit(".", 1)[-1]
+                if base:
+                    refs.add(base)
+        return refs

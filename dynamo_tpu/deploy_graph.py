@@ -386,8 +386,13 @@ async def watch_graph(path: str, api, interval: float = 2.0,
     while iterations is None or n < iterations:
         n += 1
         try:
-            with open(path, "r", encoding="utf-8") as fh:
-                spec = yaml.safe_load(fh)
+            # Read off the event loop: the spec may live on NFS/configmap
+            # mounts where a stalled read would freeze the whole frontend.
+            def _read(p=path) -> str:
+                with open(p, "r", encoding="utf-8") as fh:
+                    return fh.read()
+
+            spec = yaml.safe_load(await asyncio.to_thread(_read))
             if not isinstance(spec, dict):
                 # Truncate-then-write editors let the watcher read an
                 # empty/partial file mid-save; keep last applied state.

@@ -1645,9 +1645,16 @@ class TPUEngine(AsyncEngine):
                 finish = FinishReason.CANCELLED
             if finish is None:
                 # Undo the dispatch-time worst-case advance assumption.
+                # delta can be NEGATIVE when the device chain advanced
+                # past the dispatch-time clamp (an earlier pipelined
+                # window over-assumed near the page-capacity/len_cap
+                # clamp): dropping that correction undercounts
+                # disp_positions vs the device and can leave a
+                # cap-frozen slot (e==0, host pos < cap) never emitting
+                # LENGTH — apply it in both directions.
                 assumed = min(w.size, max(0, cap - start))
                 delta = assumed - (pos - start)
-                if delta > 0:
+                if delta != 0:
                     self.disp_positions[i] -= delta
                     self.disp_seq_lens[i] -= delta
             if self._recorder.enabled and accepted:

@@ -129,6 +129,8 @@ def _mh_zeros(shape, dtype, sharding):
     places each shard directly on its device, which is both multi-host-legal
     and HBM-friendly for multi-GB KV pools."""
     if jax.process_count() > 1:
+        # jit is the only multi-host-legal way to get out_shardings placement.
+        # dtpu: ignore[jit-recompile-hazard] -- one-shot at pool creation
         return jax.jit(lambda: jnp.zeros(shape, dtype),
                        out_shardings=sharding)()
     return jax.device_put(jnp.zeros(shape, dtype), sharding)

@@ -172,7 +172,8 @@ def _get_jax_server():
 
 
 class _Staged:
-    __slots__ = ("meta", "payload", "resolve", "t", "jax_uuid", "groups")
+    __slots__ = ("meta", "payload", "resolve", "t", "jax_uuid", "groups",
+                 "in_progress")
 
     def __init__(self, meta: dict, payload, resolve, jax_uuid,
                  groups=None):
@@ -181,6 +182,12 @@ class _Staged:
         self.resolve = resolve      # () -> np.ndarray, or None
         self.t = time.monotonic()
         self.jax_uuid = jax_uuid
+        # Claimed by a pull connection (under the server lock): a second
+        # concurrent pull of the same ticket must not also transmit —
+        # double-serving runs grouped resolvers twice concurrently and
+        # double-counts transfer metrics. Cleared if the send fails, so
+        # the sink's retry still finds the parcel staged.
+        self.in_progress = False
         # Pipelined socket path: [(n_pages, () -> np.ndarray), ...] —
         # page-group resolvers whose D2H copies were dispatched together
         # at extract time, so sending group i overlaps group i+1's copy
@@ -362,16 +369,48 @@ class KvPlaneServer:
 
     def _handle_pull(self, conn: socket.socket, req: dict) -> None:
         tid = int(req["id"])
+        busy = False
         with self._lock:
             staged = self._staged.get(tid)
+            if staged is not None and staged.in_progress:
+                # Another connection is already transmitting this ticket:
+                # serving it twice would run grouped resolvers
+                # concurrently and double-count transfer metrics.
+                staged, busy = None, True
+            elif staged is not None:
+                staged.in_progress = True
         if staged is None:
-            _send_ctrl(conn, {"err": "unknown or expired transfer id"})
+            _send_ctrl(conn, {"err": "transfer already in progress" if busy
+                              else "unknown or expired transfer id"})
             return
         # The entry stays staged until the bulk send COMPLETES: a
         # transient network failure mid-send would otherwise drop the
         # parcel permanently and force the sink to re-prefill locally
-        # (its retry would see "expired transfer id"). The TTL GC
-        # remains the backstop for sinks that never come back.
+        # (its retry would see "expired transfer id"). The in_progress
+        # claim is released on failure so that retry can win the ticket;
+        # the TTL GC remains the backstop for sinks that never come back.
+        served = False
+        resolve_err: str | None = None
+        try:
+            served, resolve_err = self._transmit_staged(conn, staged)
+        finally:
+            # Release the claim BEFORE any error frame goes out: the sink
+            # retries the moment it reads the error, and must not find
+            # the ticket still claimed by this failed attempt.
+            with self._lock:
+                if served:
+                    self._staged.pop(tid, None)
+                else:
+                    staged.in_progress = False
+        if resolve_err is not None:
+            _send_ctrl(conn, {"err": resolve_err})
+
+    def _transmit_staged(self, conn: socket.socket,
+                         staged: _Staged) -> tuple[bool, str | None]:
+        """Resolve and send one staged parcel. Returns (served, err):
+        served True only once every bulk byte is on the wire; err is a
+        resolve-failure message for the caller to report AFTER releasing
+        the in-progress claim."""
         if staged.groups is not None:
             # Pipelined page groups: group i rides the wire while group
             # i+1's D2H copy (dispatched at extract time) completes.
@@ -379,8 +418,7 @@ class KvPlaneServer:
                 first = np.ascontiguousarray(staged.groups[0][1]())
             except Exception as exc:  # noqa: BLE001
                 log.exception("staged KV group resolve failed")
-                _send_ctrl(conn, {"err": f"resolve failed: {exc}"})
-                return
+                return False, f"resolve failed: {exc}"
             _send_ctrl(conn, {"ok": True, **staged.meta,
                               "groups": [n for n, _ in staged.groups]})
             sent = first.nbytes
@@ -389,23 +427,19 @@ class KvPlaneServer:
                 arr = np.ascontiguousarray(resolver())
                 _send_bulk(conn, arr)
                 sent += arr.nbytes
-            with self._lock:
-                self._staged.pop(tid, None)
             self.transfers += 1
             self.bytes_out += sent
-            return
+            return True, None
         try:
             arr = np.ascontiguousarray(staged.array())
         except Exception as exc:  # noqa: BLE001 — resolve() device fault
             log.exception("staged KV resolve failed")
-            _send_ctrl(conn, {"err": f"resolve failed: {exc}"})
-            return
+            return False, f"resolve failed: {exc}"
         _send_ctrl(conn, {"ok": True, **staged.meta})
         _send_bulk(conn, arr)
-        with self._lock:
-            self._staged.pop(tid, None)
         self.transfers += 1
         self.bytes_out += arr.nbytes
+        return True, None
 
     def _handle_blocks(self, conn: socket.socket, req: dict) -> None:
         """G4 remote-tier serve: return which of the requested block hashes

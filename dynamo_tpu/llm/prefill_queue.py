@@ -63,7 +63,13 @@ class QueuePrefillWorker:
             self._task.cancel()
             try:
                 await self._task
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+            except asyncio.CancelledError:
+                # The awaited task finishing as cancelled is the expected
+                # outcome of our own .cancel() above. If stop() itself was
+                # cancelled, the current task is still marked, so the next
+                # await re-raises — swallowing here does not absorb it.
+                pass
+            except Exception:  # noqa: BLE001 — already torn down
                 pass
 
     async def _loop(self) -> None:

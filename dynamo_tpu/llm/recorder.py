@@ -153,7 +153,10 @@ class Recorder:
 
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
-        with open(self.path, "a") as fh:
+        # open() shares the disk-I/O exile with the writes: on a hung NFS
+        # mount even the open can stall the loop for seconds.
+        fh = await loop.run_in_executor(None, open, self.path, "a")
+        try:
             while True:
                 event = await self._q.get()
                 stop = event is None
@@ -175,6 +178,8 @@ class Recorder:
                     self.written += len(batch)
                 if stop:
                     return
+        finally:
+            await loop.run_in_executor(None, fh.close)
 
     async def close(self) -> None:
         if self._closed:

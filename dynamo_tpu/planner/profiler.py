@@ -88,8 +88,11 @@ async def profile_sweep(engine_factory, grid: list[tuple[int, int, int]],
         points.append(point)
     table = {"points": points}
     if output_path:
-        with open(output_path, "w") as fh:
-            json.dump(table, fh, indent=2)
+        def _dump() -> None:
+            with open(output_path, "w") as fh:
+                json.dump(table, fh, indent=2)
+
+        await asyncio.to_thread(_dump)
     return table
 
 

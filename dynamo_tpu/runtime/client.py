@@ -279,11 +279,20 @@ class EndpointClient:
                 elif kind == "err":
                     if payload == "incomplete":
                         raise StreamIncompleteError()
-                    from dynamo_tpu.runtime.errors import InvalidRequestError
-                    if isinstance(payload, str) and payload.startswith(
-                            InvalidRequestError.WIRE_PREFIX):
-                        raise InvalidRequestError(
-                            payload[len(InvalidRequestError.WIRE_PREFIX):])
+                    from dynamo_tpu.runtime.errors import (
+                        InvalidRequestError, OverloadedError)
+                    # Wire-typed errors: decode every class that carries
+                    # a WIRE_PREFIX so HTTP status / retry semantics
+                    # survive remote deployment. One explicit branch per
+                    # class — the wire-error-taxonomy lint checks these
+                    # references stay in sync with runtime/errors.py.
+                    if isinstance(payload, str):
+                        if payload.startswith(InvalidRequestError.WIRE_PREFIX):
+                            raise InvalidRequestError(
+                                payload[len(InvalidRequestError.WIRE_PREFIX):])
+                        if payload.startswith(OverloadedError.WIRE_PREFIX):
+                            raise OverloadedError(
+                                payload[len(OverloadedError.WIRE_PREFIX):])
                     raise EngineError(payload)
                 else:  # lost
                     raise StreamIncompleteError(

@@ -74,7 +74,9 @@ class _Conn:
             while True:
                 obj = await self._outbox.get()
                 await write_frame(self.writer, obj)
-        except (ConnectionError, OSError, asyncio.CancelledError):
+        except asyncio.CancelledError:
+            raise  # close() cancelled us; finally still runs the cleanup
+        except (ConnectionError, OSError):
             pass
         finally:
             self.closed = True

@@ -20,7 +20,13 @@ class NoInstancesError(EngineError):
 
 
 class OverloadedError(EngineError):
-    """All workers busy (reference: router 503 busy_threshold path)."""
+    """All workers busy (reference: router 503 busy_threshold path).
+    Maps to HTTP 503 at the frontend so the router can retry elsewhere;
+    workers mark it on the wire with an 'overloaded: ' prefix so the
+    class — and therefore the 503/retry semantics — survive the request
+    plane in distributed deployments."""
+
+    WIRE_PREFIX = "overloaded: "
 
 
 class InvalidRequestError(EngineError):

@@ -18,6 +18,7 @@ from typing import Any, AsyncIterator, Callable
 
 from dynamo_tpu.runtime.component import Endpoint, Instance
 from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.errors import InvalidRequestError, OverloadedError
 from dynamo_tpu.runtime.frame import read_frame, write_frame
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.runtime.tracing import span
@@ -164,14 +165,24 @@ class EndpointServer:
                 await send({"t": "final", "rid": rid})
         except asyncio.CancelledError:
             raise
-        except ValueError as exc:
-            # Engine request validation: type it on the wire so the
-            # frontend can answer 400, not 500.
+        except (ValueError, InvalidRequestError) as exc:
+            # Engine request validation (raised as ValueError by the
+            # engine, or already typed by llm-layer code): type it on the
+            # wire so the frontend can answer 400, not 500.
             self._m_errors.inc()
-            from dynamo_tpu.runtime.errors import InvalidRequestError
             try:
                 await send({"t": "err", "rid": rid,
                             "e": f"{InvalidRequestError.WIRE_PREFIX}{exc}"})
+            except (ConnectionError, OSError):
+                pass
+        except OverloadedError as exc:
+            # SLA admission rejection: type it on the wire so a REMOTE
+            # frontend answers 503 (router retries elsewhere), not 500 —
+            # in-process deployments already see the class directly.
+            self._m_errors.inc()
+            try:
+                await send({"t": "err", "rid": rid,
+                            "e": f"{OverloadedError.WIRE_PREFIX}{exc}"})
             except (ConnectionError, OSError):
                 pass
         except GeneratorExit:

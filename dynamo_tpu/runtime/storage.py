@@ -298,21 +298,29 @@ class FileStore:
         return watch
 
     async def object_put(self, key: str, data: bytes) -> None:
-        obj_dir = os.path.join(self.root, "objects")
-        os.makedirs(obj_dir, exist_ok=True)
-        path = os.path.join(obj_dir, _encode_key(key))
-        tmp = path + f".tmp{os.getpid()}"
-        with open(tmp, "wb") as fh:
-            fh.write(data)
-        os.replace(tmp, path)
+        # Blobs can be large (KV snapshots, model cards) and the root may
+        # sit on NFS: keep the write off the event loop.
+        def _write() -> None:
+            obj_dir = os.path.join(self.root, "objects")
+            os.makedirs(obj_dir, exist_ok=True)
+            path = os.path.join(obj_dir, _encode_key(key))
+            tmp = path + f".tmp{os.getpid()}"
+            with open(tmp, "wb") as fh:
+                fh.write(data)
+            os.replace(tmp, path)
+
+        await asyncio.to_thread(_write)
 
     async def object_get(self, key: str) -> bytes | None:
-        try:
-            with open(os.path.join(self.root, "objects", _encode_key(key)),
-                      "rb") as fh:
-                return fh.read()
-        except FileNotFoundError:
-            return None
+        def _read() -> bytes | None:
+            try:
+                with open(os.path.join(self.root, "objects",
+                                       _encode_key(key)), "rb") as fh:
+                    return fh.read()
+            except FileNotFoundError:
+                return None
+
+        return await asyncio.to_thread(_read)
 
     def _drop_watch(self, watch: LocalWatch) -> None:
         self._watches.remove(watch)

@@ -512,8 +512,14 @@ async def capture_profile(duration_ms: int, out_dir: str,
             await asyncio.sleep(duration_ms / 1e3)
         rec = recorder or _RECORDER
         span_path = os.path.join(out_dir, "spans.chrome.json")
-        with open(span_path, "w") as fh:
-            json.dump(rec.export_chrome(), fh)
+
+        # The ring buffer can hold tens of thousands of spans; serialize
+        # and write off the loop — this endpoint runs DURING live serving.
+        def _dump() -> None:
+            with open(span_path, "w") as fh:
+                json.dump(rec.export_chrome(), fh)
+
+        await asyncio.to_thread(_dump)
         return {"mode": mode, "out_dir": out_dir,
                 "span_dump": span_path,
                 "duration_ms": duration_ms,

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Repo gate: static analysis first (fast, catches async/JAX/wire hazards
+# before any test runs), then the tier-1 pytest command from ROADMAP.md.
+# Exits nonzero on lint findings or test failures.
+set -uo pipefail
+
+cd "$(dirname "$0")/.."
+
+echo "== dtpu-lint (python -m dynamo_tpu.analysis dynamo_tpu) =="
+python -m dynamo_tpu.analysis dynamo_tpu || exit 1
+echo "clean."
+
+echo "== tier-1 tests =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+    -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc

@@ -1,0 +1,433 @@
+"""Per-rule fixture tests for dtpu-lint (dynamo_tpu.analysis).
+
+Each rule gets one known-bad snippet (must fire) and one known-good
+snippet (must stay quiet), plus suppression-comment behavior and the
+wire-error-taxonomy revert scenario from the acceptance criteria.
+"""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from dynamo_tpu.analysis import analyze_paths, default_rules
+from dynamo_tpu.analysis.core import Module, analyze, load_module
+
+
+def run_rule(tmp_path, rule_id: str, source: str, name: str = "snippet.py"):
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(source)
+    return [f for f in analyze_paths([str(p)], select=[rule_id])]
+
+
+# -- blocking-call-in-async ---------------------------------------------------
+
+BLOCKING_BAD = """\
+import time, queue, subprocess
+
+q = queue.Queue()
+
+async def handler():
+    time.sleep(1)
+    subprocess.run(["ls"])
+    with open("/tmp/x") as fh:
+        fh.read()
+    q.get()
+    fut.result(5)
+"""
+
+BLOCKING_GOOD = """\
+import asyncio, time, queue
+
+q = queue.Queue()
+
+async def handler():
+    await asyncio.sleep(1)
+    q.get_nowait()
+    q.put("x")                    # unbounded put never blocks
+    q.get(block=False)
+    t = asyncio.create_task(work())
+    t.result()                    # asyncio task: non-blocking fetch
+    await asyncio.to_thread(blocking_bit)
+
+def engine_thread():
+    time.sleep(1)                 # sync helper threads may block
+    q.get()
+"""
+
+
+def test_blocking_call_fires(tmp_path):
+    found = run_rule(tmp_path, "blocking-call-in-async", BLOCKING_BAD)
+    messages = "\n".join(f.message for f in found)
+    assert len(found) == 5
+    assert "time.sleep" in messages
+    assert "subprocess.run" in messages
+    assert "open" in messages
+    assert "q.get()" in messages
+    assert "fut.result(timeout)" in messages
+
+
+def test_blocking_call_quiet_on_good(tmp_path):
+    assert run_rule(tmp_path, "blocking-call-in-async", BLOCKING_GOOD) == []
+
+
+def test_blocking_bounded_queue_put_fires(tmp_path):
+    src = ("import queue\nq = queue.Queue(maxsize=8)\n"
+           "async def f():\n    q.put(1)\n")
+    found = run_rule(tmp_path, "blocking-call-in-async", src)
+    assert len(found) == 1 and "bounded" in found[0].message
+
+
+# -- fire-and-forget-task -----------------------------------------------------
+
+FIREFORGET_BAD = """\
+import asyncio
+
+async def serve():
+    asyncio.create_task(background())
+"""
+
+FIREFORGET_GOOD = """\
+import asyncio
+
+async def serve():
+    self._task = asyncio.create_task(background())
+    t = asyncio.ensure_future(other())
+    tasks.add(asyncio.create_task(third()))
+    await asyncio.create_task(fourth())
+"""
+
+
+def test_fire_and_forget_fires(tmp_path):
+    found = run_rule(tmp_path, "fire-and-forget-task", FIREFORGET_BAD)
+    assert len(found) == 1
+    assert found[0].line == 4
+
+
+def test_fire_and_forget_quiet_on_good(tmp_path):
+    assert run_rule(tmp_path, "fire-and-forget-task", FIREFORGET_GOOD) == []
+
+
+# -- lock-across-await --------------------------------------------------------
+
+LOCK_BAD = """\
+import asyncio
+
+async def update(self):
+    with self._lock:
+        await self.flush()
+"""
+
+LOCK_GOOD = """\
+import asyncio
+
+async def update(self):
+    with self._lock:
+        self.counter += 1
+    await self.flush()
+    async with self._alock:
+        await self.flush()
+
+def sync_update(self):
+    with self._lock:
+        self.counter += 1
+"""
+
+
+def test_lock_across_await_fires(tmp_path):
+    found = run_rule(tmp_path, "lock-across-await", LOCK_BAD)
+    assert len(found) == 1
+    assert "self._lock" in found[0].message
+
+
+def test_lock_across_await_quiet_on_good(tmp_path):
+    assert run_rule(tmp_path, "lock-across-await", LOCK_GOOD) == []
+
+
+def test_lock_nested_def_does_not_count(tmp_path):
+    src = ("async def f(self):\n"
+           "    with self._lock:\n"
+           "        async def inner():\n"
+           "            await thing()\n"
+           "        register(inner)\n")
+    assert run_rule(tmp_path, "lock-across-await", src) == []
+
+
+# -- swallowed-cancellation ---------------------------------------------------
+
+SWALLOW_BAD = """\
+import asyncio
+
+async def loop(self):
+    while True:
+        try:
+            await self.pull()
+        except (asyncio.CancelledError, Exception):
+            continue
+"""
+
+SWALLOW_GOOD = """\
+import asyncio
+
+async def loop(self):
+    while True:
+        try:
+            await self.pull()
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            continue
+        try:
+            await self.push()
+        except BaseException:
+            self.cleanup()
+            raise
+"""
+
+
+def test_swallowed_cancellation_fires(tmp_path):
+    found = run_rule(tmp_path, "swallowed-cancellation", SWALLOW_BAD)
+    assert len(found) == 1
+
+
+def test_swallowed_cancellation_quiet_on_good(tmp_path):
+    assert run_rule(tmp_path, "swallowed-cancellation", SWALLOW_GOOD) == []
+
+
+def test_bare_except_without_await_is_quiet(tmp_path):
+    src = ("async def f():\n"
+           "    try:\n"
+           "        parse()\n"
+           "    except:\n"
+           "        pass\n")
+    assert run_rule(tmp_path, "swallowed-cancellation", src) == []
+
+
+# -- jit-recompile-hazard -----------------------------------------------------
+
+JIT_BAD = """\
+import jax
+
+def step(params, x):
+    fn = jax.jit(forward)
+    return fn(params, x)
+
+def hot_loop(batches):
+    for b in batches:
+        out = jax.jit(forward)(b)
+    return out
+"""
+
+JIT_GOOD = """\
+import functools
+import jax
+
+compiled = jax.jit(forward)
+
+@functools.partial(jax.jit, static_argnames=("bucket",))
+def kernel(x, bucket):
+    return x
+
+class Runner:
+    def __init__(self):
+        self._fn = jax.jit(forward)
+        self._cache = {}
+
+    def _get_step(self, key):
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = jax.jit(forward)
+            self._cache[key] = fn
+        return fn
+"""
+
+
+def test_jit_recompile_fires(tmp_path):
+    found = run_rule(tmp_path, "jit-recompile-hazard", JIT_BAD)
+    assert len(found) == 2
+    assert any("loop" in f.message for f in found)
+
+
+def test_jit_recompile_quiet_on_good(tmp_path):
+    assert run_rule(tmp_path, "jit-recompile-hazard", JIT_GOOD) == []
+
+
+def test_jit_unhashable_static_spec_fires(tmp_path):
+    src = ("import jax\n"
+           "fn = jax.jit(forward, static_argnums=[1, 2])\n")
+    found = run_rule(tmp_path, "jit-recompile-hazard", src)
+    assert len(found) == 1 and "static_argnums" in found[0].message
+
+
+# -- wire-error-taxonomy ------------------------------------------------------
+
+ERRORS_SRC = """\
+class EngineError(RuntimeError):
+    pass
+
+class OverloadedError(EngineError):
+    WIRE_PREFIX = "overloaded: "
+
+class QuotaError(EngineError):
+    pass
+"""
+
+SERVICE_SRC = """\
+from myapp.runtime.errors import OverloadedError
+
+async def handle(exc, send):
+    await send({"e": f"{OverloadedError.WIRE_PREFIX}{exc}"})
+"""
+
+CLIENT_SRC = """\
+from myapp.runtime.errors import OverloadedError
+
+def decode(payload):
+    if payload.startswith(OverloadedError.WIRE_PREFIX):
+        raise OverloadedError(payload[len(OverloadedError.WIRE_PREFIX):])
+"""
+
+ENGINE_SRC = """\
+from myapp.runtime.errors import OverloadedError, QuotaError
+
+def admit(load):
+    if load > 2:
+        raise QuotaError("over quota")
+    if load > 1:
+        raise OverloadedError("busy")
+"""
+
+
+def wire_tree(tmp_path, *, engine_src=ENGINE_SRC, errors_src=ERRORS_SRC,
+              service_src=SERVICE_SRC, client_src=CLIENT_SRC):
+    root = tmp_path / "myapp"
+    (root / "runtime").mkdir(parents=True)
+    (root / "engine").mkdir()
+    (root / "runtime" / "errors.py").write_text(errors_src)
+    (root / "runtime" / "service.py").write_text(service_src)
+    (root / "runtime" / "client.py").write_text(client_src)
+    (root / "engine" / "admission.py").write_text(engine_src)
+    return str(root)
+
+
+def test_wire_taxonomy_flags_unprefixed_engine_raise(tmp_path):
+    found = analyze_paths([wire_tree(tmp_path)],
+                          select=["wire-error-taxonomy"])
+    assert len(found) == 1
+    assert "QuotaError" in found[0].message
+    assert found[0].path.endswith("admission.py")
+
+
+def test_wire_taxonomy_quiet_when_fully_wired(tmp_path):
+    engine = ENGINE_SRC.replace("        raise QuotaError(\"over quota\")\n",
+                                "        pass\n")
+    found = analyze_paths([wire_tree(tmp_path, engine_src=engine)],
+                          select=["wire-error-taxonomy"])
+    assert found == []
+
+
+def test_wire_taxonomy_flags_missing_decode(tmp_path):
+    """Reverting only the client-side decode (the OverloadedError fix
+    scenario) must fail the rule."""
+    engine = ENGINE_SRC.replace("        raise QuotaError(\"over quota\")\n",
+                                "        pass\n")
+    client = "def decode(payload):\n    raise RuntimeError(payload)\n"
+    found = analyze_paths(
+        [wire_tree(tmp_path, engine_src=engine, client_src=client)],
+        select=["wire-error-taxonomy"])
+    assert len(found) == 1
+    assert "never decoded" in found[0].message
+
+
+def test_wire_taxonomy_on_real_repo_guards_overloaded_fix():
+    """The repo itself must be wired; deleting OverloadedError's
+    WIRE_PREFIX (reverting the fix) must re-introduce a finding."""
+    import dynamo_tpu
+    from pathlib import Path
+
+    pkg = Path(dynamo_tpu.__file__).parent
+    assert analyze_paths([str(pkg)], select=["wire-error-taxonomy"]) == []
+
+    from dynamo_tpu.analysis import default_rules
+    from dynamo_tpu.analysis.core import analyze, load_paths
+
+    modules, _ = load_paths([str(pkg)])
+    errors_mod = next(m for m in modules
+                      if m.path.replace("\\", "/").endswith("runtime/errors.py"))
+    reverted = errors_mod.source.replace('WIRE_PREFIX = "overloaded: "', "pass")
+    assert reverted != errors_mod.source
+    import ast as ast_mod
+    modules[modules.index(errors_mod)] = Module(
+        errors_mod.path, reverted, ast_mod.parse(reverted))
+    findings = analyze(modules, default_rules(["wire-error-taxonomy"]))
+    assert any("OverloadedError" in f.message for f in findings)
+
+
+# -- suppressions -------------------------------------------------------------
+
+def test_suppression_same_line(tmp_path):
+    src = ("import time\n"
+           "async def f():\n"
+           "    time.sleep(1)  # dtpu: ignore[blocking-call-in-async] -- why\n")
+    assert run_rule(tmp_path, "blocking-call-in-async", src) == []
+
+
+def test_suppression_line_above(tmp_path):
+    src = ("import time\n"
+           "async def f():\n"
+           "    # dtpu: ignore[blocking-call-in-async] -- rationale here\n"
+           "    time.sleep(1)\n")
+    assert run_rule(tmp_path, "blocking-call-in-async", src) == []
+
+
+def test_suppression_all_rules_form(tmp_path):
+    src = ("import time\n"
+           "async def f():\n"
+           "    time.sleep(1)  # dtpu: ignore\n")
+    assert run_rule(tmp_path, "blocking-call-in-async", src) == []
+
+
+def test_suppression_wrong_rule_id_does_not_apply(tmp_path):
+    src = ("import time\n"
+           "async def f():\n"
+           "    time.sleep(1)  # dtpu: ignore[jit-recompile-hazard]\n")
+    found = run_rule(tmp_path, "blocking-call-in-async", src)
+    assert len(found) == 1
+
+
+# -- CLI ----------------------------------------------------------------------
+
+def test_cli_json_output_and_exit_code(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\nasync def f():\n    time.sleep(1)\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "dynamo_tpu.analysis", str(bad), "--json"],
+        capture_output=True, text=True)
+    assert proc.returncode == 1
+    findings = json.loads(proc.stdout)
+    assert findings[0]["rule_id"] == "blocking-call-in-async"
+    assert findings[0]["line"] == 3
+
+
+def test_cli_unknown_rule_id_is_usage_error(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, "-m", "dynamo_tpu.analysis", str(tmp_path),
+         "--select", "no-such-rule"],
+        capture_output=True, text=True)
+    assert proc.returncode == 2
+
+
+def test_default_rules_catalog():
+    ids = {r.rule_id for r in default_rules()}
+    assert ids == {"blocking-call-in-async", "fire-and-forget-task",
+                   "lock-across-await", "swallowed-cancellation",
+                   "jit-recompile-hazard", "wire-error-taxonomy"}
+
+
+def test_unparseable_file_reports_parse_error(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    found = analyze_paths([str(bad)])
+    assert len(found) == 1 and found[0].rule_id == "parse-error"

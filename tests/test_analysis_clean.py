@@ -1,0 +1,24 @@
+"""Tier-1 gate: the dynamo_tpu package itself must be dtpu-lint clean.
+
+Every finding must be fixed or carry an explicit
+`# dtpu: ignore[rule-id] -- rationale` suppression. This is the
+machine-checked replacement for the type/borrow discipline the Python
+port gave up (ROADMAP correctness-tooling leg): future PRs that park the
+event loop, leak a task, hold a lock across an await, build jits on the
+hot path, or raise a typed error that can't survive the request plane
+fail here — before review.
+"""
+
+from pathlib import Path
+
+import dynamo_tpu
+from dynamo_tpu.analysis import analyze_paths
+
+
+def test_package_is_lint_clean():
+    pkg = Path(dynamo_tpu.__file__).parent
+    findings = analyze_paths([str(pkg)])
+    rendered = "\n\n".join(f.render() for f in findings)
+    assert findings == [], (
+        f"dtpu-lint found {len(findings)} violation(s) — fix them or add "
+        f"a justified `# dtpu: ignore[rule-id]` suppression:\n\n{rendered}")

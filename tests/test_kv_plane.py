@@ -87,6 +87,76 @@ async def test_pull_twice_and_unknown_id_fail(plane):
 
 
 @async_test
+async def test_concurrent_pulls_serve_exactly_once(plane):
+    """Two racing pulls of the same ticket: only one may transmit (the
+    other gets 'transfer already in progress'), so transfers/bytes_out
+    count the parcel once and grouped resolvers never run concurrently
+    (round-5 ADVICE low: _handle_pull double-serve)."""
+    import threading
+
+    server, client = plane
+    kv = _rand_kv(seed=7)
+    release = threading.Event()
+    calls = []
+
+    def resolve():
+        calls.append(1)
+        release.wait(timeout=10)  # hold the first pull mid-serve
+        return kv
+
+    ticket = server.stage(meta={"shape": list(kv.shape),
+                                "dtype": "bfloat16"}, resolve=resolve)
+    first = asyncio.create_task(client.pull(ticket))
+    for _ in range(200):  # wait until pull #1 has claimed the ticket
+        if calls:
+            break
+        await asyncio.sleep(0.01)
+    assert calls == [1]
+    # Second puller on its own connection while #1 is mid-serve.
+    rival = KvPlaneClient()
+    try:
+        with pytest.raises((ConnectionError, OSError)):
+            await rival.pull(ticket)
+        release.set()
+        out = await first
+        np.testing.assert_array_equal(kv.view(np.uint16), out.view(np.uint16))
+    finally:
+        release.set()
+        rival.close()
+    for _ in range(200):
+        if server.bytes_out:
+            break
+        await asyncio.sleep(0.01)
+    assert server.transfers == 1 and server.bytes_out == kv.nbytes
+    assert calls == [1]
+
+
+@async_test
+async def test_failed_send_restages_ticket(plane):
+    """A pull whose resolve fails must release the in-progress claim so
+    the sink's retry still finds the parcel staged."""
+    server, client = plane
+    kv = _rand_kv(seed=8)
+    boom = [True]
+
+    def resolve():
+        if boom.pop() if boom else False:
+            raise RuntimeError("device fault")
+        return kv
+
+    ticket = server.stage(meta={"shape": list(kv.shape),
+                                "dtype": "bfloat16"}, resolve=resolve)
+    with pytest.raises((ConnectionError, OSError)):
+        await client.pull(ticket)
+    retry = KvPlaneClient()
+    try:
+        out = await retry.pull(ticket)
+        np.testing.assert_array_equal(kv.view(np.uint16), out.view(np.uint16))
+    finally:
+        retry.close()
+
+
+@async_test
 async def test_large_parcel_multi_chunk(plane):
     """Parcels far larger than the send chunk stream intact."""
     server, client = plane

@@ -13,7 +13,9 @@ from dynamo_tpu.runtime.config import RuntimeConfig
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.coordinator import Coordinator
 from dynamo_tpu.runtime.distributed import DistributedRuntime
-from dynamo_tpu.runtime.errors import EngineError, NoInstancesError, StreamIncompleteError
+from dynamo_tpu.runtime.errors import (
+    EngineError, InvalidRequestError, NoInstancesError, OverloadedError,
+    StreamIncompleteError)
 
 
 async def make_runtime(coord, **kwargs):
@@ -163,6 +165,50 @@ async def test_handler_error_propagates():
         except EngineError as exc:
             assert "engine exploded" in str(exc)
         assert got == [{"ok": True}]
+    finally:
+        await frontend.close()
+        await worker.close()
+        await coord.stop()
+
+
+@async_test
+async def test_typed_errors_survive_the_wire():
+    """OverloadedError raised by a REMOTE worker must arrive typed so the
+    frontend answers 503 and the router retries — not a generic
+    EngineError/500 (round-5 ADVICE medium; wire-error-taxonomy lint)."""
+    coord = Coordinator()
+    await coord.start()
+    worker = await make_runtime(coord)
+    frontend = await make_runtime(coord)
+    try:
+        async def overloaded_handler(request, context):
+            raise OverloadedError("projected TTFT 900 ms exceeds 300 ms")
+            yield  # pragma: no cover — make it an async generator
+
+        async def invalid_handler(request, context):
+            raise InvalidRequestError("top_k must be positive")
+            yield  # pragma: no cover
+
+        ns = worker.namespace("t")
+        await ns.component("busy").endpoint("g").serve_endpoint(
+            overloaded_handler)
+        await ns.component("picky").endpoint("g").serve_endpoint(
+            invalid_handler)
+        fns = frontend.namespace("t")
+        for comp, exc_type, msg in (
+                ("busy", OverloadedError, "projected TTFT"),
+                ("picky", InvalidRequestError, "top_k must be positive")):
+            client = await fns.component(comp).endpoint("g").client()
+            await client.wait_for_instances(timeout=5)
+            stream = await client.generate({})
+            try:
+                async for _ in stream:
+                    pass
+                raise AssertionError(f"expected {exc_type.__name__}")
+            except exc_type as exc:
+                # typed, and the wire prefix is stripped from the message
+                assert msg in str(exc)
+                assert not str(exc).startswith(exc_type.WIRE_PREFIX)
     finally:
         await frontend.close()
         await worker.close()
