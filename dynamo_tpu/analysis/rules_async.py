@@ -229,6 +229,68 @@ class LockAcrossAwait(Rule):
                     break
 
 
+class UnboundedWait(Rule):
+    rule_id = "unbounded-wait"
+    description = ("`await` on an event/reply with no deadline — "
+                   "`await x.wait()` or awaiting a `create_future()` "
+                   "future directly. A lost wakeup or reply frame parks "
+                   "the caller forever; wrap in `asyncio.wait_for(...)` "
+                   "or suppress serve-forever waits with a rationale")
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            future_names = self._created_future_names(fn)
+            for node in iter_scope(fn.body):
+                if not isinstance(node, ast.Await):
+                    continue
+                value = node.value
+                # `await x.wait()` — an argless event-style wait not
+                # wrapped in wait_for (the wrapper makes the await's
+                # value the wait_for call itself, so it never matches).
+                if (isinstance(value, ast.Call)
+                        and isinstance(value.func, ast.Attribute)
+                        and value.func.attr == "wait"
+                        and not value.args and not value.keywords):
+                    recv = qualified_name(value.func.value)
+                    yield self.finding(
+                        module, node,
+                        f"unbounded `await {recv}.wait()` in `{fn.name}`: "
+                        "a lost wakeup parks this caller forever",
+                        "wrap in `asyncio.wait_for(..., timeout)` (or "
+                        "suppress if waiting forever is the contract, "
+                        "e.g. serve-forever loops)")
+                # `await fut` where fut came from create_future() in
+                # this function — a reply future nobody is obligated to
+                # resolve (the resolver may die with the connection).
+                elif (isinstance(value, ast.Name)
+                      and value.id in future_names):
+                    yield self.finding(
+                        module, node,
+                        f"unbounded `await {value.id}` on a "
+                        f"create_future() reply future in `{fn.name}`",
+                        "wrap in `asyncio.wait_for(..., timeout)` so a "
+                        "lost reply becomes a typed error, not a hang")
+
+    @staticmethod
+    def _created_future_names(fn: ast.AsyncFunctionDef) -> set[str]:
+        names: set[str] = set()
+        for node in iter_scope(fn.body):
+            targets: list[ast.expr] = []
+            value: ast.expr | None = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            if (isinstance(value, ast.Call)
+                    and qualified_name(value.func).endswith("create_future")):
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+        return names
+
+
 _CANCELLED = {"asyncio.CancelledError", "CancelledError"}
 
 

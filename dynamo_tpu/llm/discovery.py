@@ -162,7 +162,8 @@ class ModelWatcher:
             router = await self._kv_router_factory(self._runtime, entry, client)
         else:
             router = RouterEngine(client, self.router_mode)
-        chain = Migration(entry.card.migration_limit, inner=router)
+        chain = Migration(entry.card.migration_limit, inner=router,
+                          metrics=self._runtime.metrics)
         backend = Backend(tokenizer, inner=chain)
         preprocessor = OpenAIPreprocessor(entry.card, tokenizer, inner=backend)
         return ServedModel(entry, preprocessor, client, router)

@@ -44,7 +44,9 @@ from typing import Callable
 import msgpack
 import numpy as np
 
+from dynamo_tpu.runtime import chaos
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.retry import Backoff, policies
 
 log = get_logger("kv_plane")
 
@@ -369,6 +371,13 @@ class KvPlaneServer:
 
     def _handle_pull(self, conn: socket.socket, req: dict) -> None:
         tid = int(req["id"])
+        if chaos.ACTIVE:
+            stall = chaos.value("kv.stall_ms", "kv")
+            if stall is not None:
+                time.sleep(stall / 1000.0)
+            if chaos.fire("kv.pull_error", "kv"):
+                _send_ctrl(conn, {"err": "chaos: injected pull error"})
+                return
         busy = False
         with self._lock:
             staged = self._staged.get(tid)
@@ -436,6 +445,17 @@ class KvPlaneServer:
             log.exception("staged KV resolve failed")
             return False, f"resolve failed: {exc}"
         _send_ctrl(conn, {"ok": True, **staged.meta})
+        if chaos.ACTIVE and chaos.fire("kv.partial", "kv"):
+            # Send half the parcel, then sever: the sink's short read
+            # must surface as a connection error and the parcel must
+            # stay staged for its retry.
+            data = memoryview(arr.view(np.uint8).reshape(-1))
+            conn.sendall(data[:max(1, arr.nbytes // 2)])
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            return False, None
         _send_bulk(conn, arr)
         self.transfers += 1
         self.bytes_out += arr.nbytes
@@ -554,6 +574,21 @@ class KvPlaneClient:
             except (ConnectionError, OSError):
                 pass  # TTL GC covers it
             return out
+        # Transient failures (reset mid-transfer, a racing pull holding
+        # the in-progress claim) retry through the unified policy — the
+        # parcel stays staged on the source until every byte lands, so a
+        # retry finds it. An expired/unknown ticket can never succeed:
+        # fail fast and let the caller prefill locally.
+        backoff = Backoff(policies.KV_PULL)
+        while True:
+            try:
+                return self._pull_socket_once(ticket)
+            except (ConnectionError, OSError) as exc:
+                if "expired transfer" in str(exc) or not backoff.sleep_sync():
+                    raise
+                log.warning("KV pull failed (%s); retrying", exc)
+
+    def _pull_socket_once(self, ticket: dict) -> np.ndarray:
         addr = ticket["addr"]
         sock, conn_lock = self._conn_for(addr)
         try:

@@ -6,6 +6,14 @@ request plane), re-issue the request to another instance with the
 already-generated tokens appended to the prompt, up to ``migration_limit``
 retries. Workers signal incompleteness via connection loss or an explicit
 incomplete-stream error (docs/guides/backend.md §Migrate).
+
+Observability: each retry bumps the ``migrations_total`` counter (when a
+metrics registry is supplied) and records a ``migration.retry`` span on
+the request's trace, so migrated requests show up in /debug/traces and
+/metrics instead of only a log line. Retries pace themselves through
+``policies.MIGRATION`` with a shared per-operator retry budget: when a
+worker death strands many streams at once, their redials jitter and
+spread instead of storming the survivors in lockstep.
 """
 
 from __future__ import annotations
@@ -17,14 +25,26 @@ from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.engine import AsyncEngine, Operator
 from dynamo_tpu.runtime.errors import StreamIncompleteError
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.retry import Backoff, RetryBudget, policies
+from dynamo_tpu.runtime.tracing import span
 
 log = get_logger("migration")
 
 
 class Migration(Operator):
-    def __init__(self, migration_limit: int = 0, inner: AsyncEngine | None = None):
+    def __init__(self, migration_limit: int = 0,
+                 inner: AsyncEngine | None = None, metrics=None):
         super().__init__(inner)
         self.migration_limit = migration_limit
+        # Shared across every stream this operator serves: a mass
+        # disconnect (one worker death strands its whole batch) drains
+        # the bucket and later migrations back off at the policy max.
+        self._budget = RetryBudget(rate=20.0, burst=50.0)
+        self._m_migrations = None
+        if metrics is not None:
+            self._m_migrations = metrics.counter(
+                "migrations_total",
+                "Mid-stream migrations (retries after disconnect)")
 
     async def generate(self, request: PreprocessedRequest | dict,
                        context: Context) -> AsyncIterator[LLMEngineOutput]:
@@ -34,6 +54,8 @@ class Migration(Operator):
         retries_left = self.migration_limit
         accumulated: list[int] = []
         req = original
+        attempt = 0
+        backoff = Backoff(policies.MIGRATION, budget=self._budget)
         while True:
             try:
                 async for raw in self.inner.generate(req.to_wire(), context):
@@ -43,13 +65,31 @@ class Migration(Operator):
                     yield out
                 return
             except StreamIncompleteError as exc:
+                budget = original.stop_conditions.max_tokens
+                if budget is not None and len(accumulated) >= budget:
+                    # The stream died on the final boundary: everything
+                    # the caller asked for was already delivered. A
+                    # retry with the max(1, ...) floor would overshoot
+                    # the budget by a token — treat as complete instead.
+                    return
                 if retries_left <= 0 or context.is_stopped:
                     raise
                 retries_left -= 1
+                attempt += 1
+                if self._m_migrations is not None:
+                    self._m_migrations.inc()
                 log.warning(
                     "Stream disconnected (%s)... recreating stream "
                     "(%d retries left, carrying %d generated tokens)",
                     exc, retries_left, len(accumulated))
+                # The span covers the backoff pause and joins the
+                # request's trace (frontend http.request -> ... ->
+                # migration.retry), making migrated requests visible in
+                # /debug/traces.
+                with span("migration.retry", ctx=context, attempt=attempt,
+                          carried_tokens=len(accumulated),
+                          retries_left=retries_left, reason=str(exc)):
+                    await backoff.sleep()
                 # Continue generation on another worker: the ORIGINAL prompt
                 # plus everything generated so far becomes the new prompt; the
                 # budget shrinks by total emitted. Rebuilding from `original`

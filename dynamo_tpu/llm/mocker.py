@@ -194,6 +194,9 @@ class MockerEngine(AsyncEngine):
         while True:
             if not (self.waiting or self.prefilling or self.decoding):
                 self._wake.clear()
+                # Idle engine parks until generate() sets the wake event;
+                # idling forever with no requests is the contract.
+                # dtpu: ignore[unbounded-wait] -- see above
                 await self._wake.wait()
             now = time.monotonic()
             self._admit(now)

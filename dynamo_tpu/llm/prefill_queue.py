@@ -28,6 +28,7 @@ from dynamo_tpu.llm.model_card import model_slug
 from dynamo_tpu.llm.protocols import PreprocessedRequest
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.retry import Backoff, policies
 from dynamo_tpu.runtime.tracing import get_recorder, span
 
 log = get_logger("prefill_queue")
@@ -73,6 +74,7 @@ class QueuePrefillWorker:
                 pass
 
     async def _loop(self) -> None:
+        backoff = Backoff(policies.QUEUE_POP)
         while True:
             try:
                 item = await self.client.queue_pop(
@@ -84,8 +86,9 @@ class QueuePrefillWorker:
                 # queue while still serving the direct endpoint — queue-
                 # mode decode workers would degrade to local-only forever.
                 log.exception("prefill queue pop failed; retrying")
-                await asyncio.sleep(0.5)
+                await backoff.sleep()
                 continue
+            backoff.reset()
             if item is None:
                 continue
             await self._serve_one(item)

@@ -1,0 +1,316 @@
+"""Deterministic seeded fault injection (the chaos plane).
+
+The reference treats fault tolerance as a *tested* capability
+(tests/fault_tolerance/ kills workers mid-stream and asserts streams
+complete through the RetryManager). This module makes the failure space
+systematically explorable in-process: a ``FaultPlan`` parsed from a
+compact spec is threaded through the real I/O choke points — frame
+read/write (drop, truncate, delay, duplicate, connection reset), the
+coordinator lease keepalive (starvation → forced expiry), the request
+plane (mid-stream disconnect), KV-plane pulls (error frames, partial
+parcels, stalls) and coordinator queue pops — and every decision is
+drawn from per-rule seeded RNG streams, so a scenario reproduces the
+same fault sequence for the same seed.
+
+Spec grammar (directives joined by ``;``)::
+
+    DTPU_CHAOS="seed=7;frame.drop=0.02;frame.delay_ms=5..40:0.1;
+                conn.reset=0.01;lease.starve@t=3;kv.pull_error=0.05"
+
+    seed=N                 RNG seed for every rule stream (default 0)
+    key=P                  fire with probability P per opportunity
+    key=LO..HI[:P]         ranged magnitude (uniform in [LO,HI]) with
+                           probability P (default 1.0) — e.g. delay ms
+    key=xK                 deterministic: fire on the first K
+                           opportunities, then never again
+    key@t=T                one-shot: fire once at the first opportunity
+                           at or after T seconds from arm()
+    key@t=LO..HI           window: fire on EVERY opportunity while
+                           LO <= t < HI seconds from arm()
+    key@SITE=...           scope to one injection site (``service``,
+                           ``client``, ``coord``, ``coord_client``,
+                           ``kv``); unscoped rules match every site
+
+Known keys (each hook site names the key it consults):
+
+    frame.drop       write_frame: silently discard the frame
+    frame.delay_ms   read/write_frame: sleep the drawn magnitude (ms)
+    frame.dup        write_frame: send the frame twice
+    frame.trunc      write_frame: send a byte-truncated frame, then
+                     abort the connection (framing is unrecoverable)
+    conn.reset       write_frame: abort the transport mid-operation
+    stream.disconnect  request-plane client: sever the instance
+                     connection upon receiving a data frame
+    lease.starve     keepalive loop: skip keepalives long enough for
+                     server-side lease expiry
+    kv.pull_error    KV-plane server: answer a pull with an error frame
+    kv.stall_ms      KV-plane server: sleep before sending the parcel
+    kv.partial       KV-plane server: send a partial parcel, then drop
+                     the connection
+    queue.pop_error  coordinator client: fail queue_pop with
+                     ConnectionError
+
+Disabled (``DTPU_CHAOS`` unset / ``uninstall()``), every hook site is
+guarded by the module-level ``ACTIVE`` bool — a single attribute read
+and branch, no allocation, no behavior change.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import os
+import random
+import re
+import threading
+import time
+
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("chaos")
+
+ENV_VAR = "DTPU_CHAOS"
+
+# Fast gate consulted by every hook site: `if chaos.ACTIVE: ...`.
+ACTIVE = False
+_plan: "FaultPlan | None" = None
+
+_RANGE_RE = re.compile(r"^(-?[\d.]+)\.\.(-?[\d.]+)(?::([\d.]+))?$")
+
+# Injection-site names (for spec validation error messages only).
+KNOWN_SITES = ("service", "client", "coord", "coord_client", "kv")
+
+
+class FaultRule:
+    """One parsed directive. Decisions consume this rule's own seeded
+    RNG stream, so per-rule fault sequences are reproducible regardless
+    of what other rules are doing."""
+
+    __slots__ = ("key", "site", "prob", "lo", "hi", "times", "at_lo",
+                 "at_hi", "_fired_once", "_fired_count", "_rng")
+
+    def __init__(self, key: str, site: str | None, spec: str):
+        self.key = key
+        self.site = site
+        self.prob: float | None = None
+        self.lo: float | None = None
+        self.hi: float | None = None
+        self.times: int | None = None
+        self.at_lo: float | None = None
+        self.at_hi: float | None = None
+        self._fired_once = False
+        self._fired_count = 0
+        self._rng: random.Random | None = None
+        self._parse_value(spec)
+
+    def _parse_value(self, text: str) -> None:
+        text = text.strip()
+        if self.site == "t":
+            # key@t=T (one-shot) or key@t=LO..HI (window): the "site"
+            # slot carried the time form; the rule itself is unscoped.
+            self.site = None
+            if ".." in text:
+                lo, hi = text.split("..", 1)
+                self.at_lo, self.at_hi = float(lo), float(hi)
+            else:
+                self.at_lo = float(text)
+            return
+        if text.startswith("x"):
+            self.times = int(text[1:])
+            return
+        m = _RANGE_RE.match(text)
+        if m:
+            self.lo, self.hi = float(m.group(1)), float(m.group(2))
+            self.prob = float(m.group(3)) if m.group(3) else 1.0
+            return
+        self.prob = float(text)
+        if not 0.0 <= self.prob <= 1.0:
+            raise ValueError(
+                f"chaos probability out of range for {self.key}: {text}")
+
+    def arm(self, seed: int) -> None:
+        # Seed with a STRING: random.Random hashes str via SHA-512,
+        # deterministic across processes (tuples would go through
+        # PYTHONHASHSEED-randomized hash()).
+        self._rng = random.Random(f"{seed}:{self.key}@{self.site or '*'}")
+        self._fired_once = False
+        self._fired_count = 0
+
+    def draw(self, elapsed: float) -> float | None:
+        """None = no fault this opportunity; a float = fire, with the
+        drawn magnitude (1.0 for rules without a range)."""
+        if self.at_lo is not None:
+            if self.at_hi is None:
+                if elapsed < self.at_lo or self._fired_once:
+                    return None
+                self._fired_once = True
+                return 1.0
+            if not (self.at_lo <= elapsed < self.at_hi):
+                return None
+            return 1.0
+        if self.times is not None:
+            if self._fired_count >= self.times:
+                return None
+            self._fired_count += 1
+            return 1.0
+        assert self._rng is not None, "rule not armed"
+        if self._rng.random() >= (self.prob if self.prob is not None else 0):
+            return None
+        if self.lo is not None and self.hi is not None:
+            return self._rng.uniform(self.lo, self.hi)
+        return 1.0
+
+
+class FaultPlan:
+    """A parsed, armable set of fault rules."""
+
+    def __init__(self, spec: str):
+        self.spec = spec
+        self.seed = 0
+        self.rules: list[FaultRule] = []
+        self._t0: float | None = None
+        self._lock = threading.Lock()  # hooks fire from loop AND threads
+        # Bounded decision log: (key, site, magnitude) per FIRED fault —
+        # lets tests assert same-seed runs produce identical sequences.
+        self.log: list[tuple[str, str, float]] = []
+        for directive in spec.split(";"):
+            directive = directive.strip()
+            if not directive:
+                continue
+            if "=" not in directive:
+                raise ValueError(f"chaos directive missing '=': {directive!r}")
+            head, _, value = directive.partition("=")
+            head = head.strip()
+            if head == "seed":
+                self.seed = int(value)
+                continue
+            if "@" in head:
+                key, _, site = head.partition("@")
+            else:
+                key, site = head, None
+            self.rules.append(FaultRule(key.strip(), site, value))
+
+    def arm(self) -> None:
+        self._t0 = time.monotonic()
+        for rule in self.rules:
+            rule.arm(self.seed)
+
+    def draw(self, key: str, site: str | None = None) -> float | None:
+        """Consult every rule matching (key, site); first fire wins."""
+        if self._t0 is None:
+            self.arm()
+        elapsed = time.monotonic() - self._t0
+        with self._lock:
+            for rule in self.rules:
+                if rule.key != key:
+                    continue
+                if rule.site is not None and rule.site != site:
+                    continue
+                magnitude = rule.draw(elapsed)
+                if magnitude is not None:
+                    if len(self.log) < 4096:
+                        self.log.append((key, site or "", magnitude))
+                    return magnitude
+        return None
+
+
+# -- module-level install/uninstall -------------------------------------------
+
+def install(plan: FaultPlan) -> FaultPlan:
+    global _plan, ACTIVE
+    plan.arm()
+    _plan = plan
+    ACTIVE = True
+    log.warning("chaos plan armed (seed=%d): %s", plan.seed, plan.spec)
+    return plan
+
+
+def uninstall() -> None:
+    global _plan, ACTIVE
+    ACTIVE = False
+    _plan = None
+
+
+def plan() -> FaultPlan | None:
+    return _plan
+
+
+@contextlib.contextmanager
+def active(spec: str):
+    """Test helper: arm a plan for the duration of a block."""
+    p = install(FaultPlan(spec))
+    try:
+        yield p
+    finally:
+        uninstall()
+
+
+def install_from_env() -> None:
+    spec = os.environ.get(ENV_VAR)
+    if spec:
+        install(FaultPlan(spec))
+
+
+# -- hook helpers (call sites guard with `if chaos.ACTIVE:`) -------------------
+
+def fire(key: str, site: str | None = None) -> bool:
+    p = _plan
+    return p is not None and p.draw(key, site) is not None
+
+
+def value(key: str, site: str | None = None) -> float | None:
+    p = _plan
+    return None if p is None else p.draw(key, site)
+
+
+async def on_frame_write(writer: asyncio.StreamWriter, data: bytes,
+                         site: str | None) -> bytes | None:
+    """Mutate one outgoing frame. Returns the bytes to write (possibly
+    duplicated), or None to drop the frame entirely. Raises
+    ConnectionResetError after aborting the transport for reset/truncate
+    faults — the caller experiences exactly what a mid-write network
+    failure looks like."""
+    p = _plan
+    if p is None:
+        return data
+    delay = p.draw("frame.delay_ms", site)
+    if delay is not None:
+        await asyncio.sleep(delay / 1000.0)
+    if p.draw("conn.reset", site) is not None:
+        _abort(writer)
+        raise ConnectionResetError(f"chaos: injected connection reset ({site})")
+    if p.draw("frame.trunc", site) is not None:
+        # A truncated frame poisons the length-prefixed stream; the only
+        # honest simulation is partial bytes followed by connection death.
+        writer.write(data[:max(1, len(data) // 2)])
+        _abort(writer)
+        raise ConnectionResetError(f"chaos: injected truncated frame ({site})")
+    if p.draw("frame.drop", site) is not None:
+        return None
+    if p.draw("frame.dup", site) is not None:
+        return data + data
+    return data
+
+
+async def on_frame_read(site: str | None) -> None:
+    """Inject receive-side latency before blocking on the next frame."""
+    p = _plan
+    if p is None:
+        return
+    delay = p.draw("frame.delay_ms", site)
+    if delay is not None:
+        await asyncio.sleep(delay / 1000.0)
+
+
+def _abort(writer: asyncio.StreamWriter) -> None:
+    transport = getattr(writer, "transport", None)
+    if transport is not None:
+        transport.abort()
+    else:  # pragma: no cover - StreamWriter always has a transport
+        writer.close()
+
+
+# Arm directly from the environment at import: the hooks below this
+# gate are compiled into the I/O paths of every process, so exporting
+# DTPU_CHAOS is all a scenario needs — no code changes, no flags.
+install_from_env()

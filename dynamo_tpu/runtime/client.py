@@ -17,6 +17,7 @@ import random
 import uuid
 from typing import Any, AsyncIterator
 
+from dynamo_tpu.runtime import chaos
 from dynamo_tpu.runtime.component import Endpoint, Instance, instance_prefix
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.errors import EngineError, NoInstancesError, StreamIncompleteError
@@ -52,29 +53,29 @@ class _InstanceConn:
     async def _read_loop(self) -> None:
         try:
             while True:
-                msg = await read_frame(self._reader)
+                msg = await read_frame(self._reader, chaos_site="client")
                 q = self._streams.get(msg.get("rid"))
                 if q is None:
                     continue
                 t = msg.get("t")
                 if t == "data":
-                    q.put_nowait(("data", msg.get("p")))
+                    q.put_nowait(("data", msg.get("p"), msg.get("s")))
                 elif t == "final":
-                    q.put_nowait(("final", None))
+                    q.put_nowait(("final", None, msg.get("s")))
                 elif t == "err":
-                    q.put_nowait(("err", msg.get("e")))
+                    q.put_nowait(("err", msg.get("e"), None))
         except (asyncio.IncompleteReadError, ConnectionError, ValueError, OSError):
             pass
         finally:
             self.alive = False
             for q in self._streams.values():
-                q.put_nowait(("lost", None))
+                q.put_nowait(("lost", None, None))
 
     async def send(self, obj: dict) -> None:
         if not self.alive:
             raise ConnectionError("instance connection lost")
         async with self._send_lock:
-            await write_frame(self._writer, obj)
+            await write_frame(self._writer, obj, chaos_site="client")
 
     def open_stream(self, rid: str) -> asyncio.Queue:
         q: asyncio.Queue = asyncio.Queue()
@@ -126,14 +127,6 @@ class EndpointClient:
         self._instances[instance.instance_id] = instance
         self._instances_event.set()
 
-    # How long a deregistered instance's in-flight streams may keep
-    # draining before the connection is force-closed. Crashed workers
-    # close the TCP connection themselves (kernel FIN/RST -> immediate
-    # ("lost") wakeup); this deadline covers the silent cases — network
-    # partition, host power loss — where no packet ever arrives and the
-    # lease expiry is the only death signal.
-    RETIRE_DRAIN_S = 30.0
-
     def _remove_instance(self, instance_id: int) -> None:
         self._instances.pop(instance_id, None)
         conn = self._conns.pop(instance_id, None)
@@ -142,12 +135,17 @@ class EndpointClient:
             # In-flight streams on a healthy TCP connection drain to
             # completion: a lease blip (keepalive starved under load)
             # must not kill a stream that the worker is still serving —
-            # but only within RETIRE_DRAIN_S, so a partitioned worker
-            # can't hang its streams forever.
+            # but only within retire_drain_s (RuntimeConfig /
+            # DTPU_RETIRE_DRAIN_S), so a partitioned worker can't hang
+            # its streams forever. Crashed workers close the TCP
+            # connection themselves (kernel FIN/RST -> immediate "lost"
+            # wakeup); the drain deadline covers the silent cases —
+            # network partition, host power loss — where no packet ever
+            # arrives and lease expiry is the only death signal.
             if conn._streams:
                 conn.retire_when_idle = True
                 asyncio.get_running_loop().call_later(
-                    self.RETIRE_DRAIN_S, conn.close)
+                    self._runtime.config.retire_drain_s, conn.close)
             else:
                 conn.close()
         if not self._instances:
@@ -253,7 +251,13 @@ class EndpointClient:
         # watcher pushes a wakeup sentinel into the stream queue when the
         # context cancels — zero per-frame overhead on the token hot path.
         stop_t = asyncio.ensure_future(ctx.wait_stopped())
-        stop_t.add_done_callback(lambda _: q.put_nowait(("wake", None)))
+        stop_t.add_done_callback(lambda _: q.put_nowait(("wake", None, None)))
+        # Data frames carry per-stream sequence numbers; track them so a
+        # lost frame (worker bug, chaos) fails TYPED instead of silently
+        # shortening the stream, and a duplicated frame is dropped
+        # instead of double-delivering tokens.
+        expected_seq = 0
+        idle_s = self._runtime.config.stream_idle_timeout_s
         try:
             while True:
                 if ctx.is_killed and not stop_sent:
@@ -269,12 +273,47 @@ class EndpointClient:
                         await conn.send({"t": "stop", "rid": rid})
                     except (ConnectionError, OSError):
                         pass
-                kind, payload = await q.get()
+                try:
+                    if idle_s and idle_s > 0:
+                        # An idle deadline between frames: a zombie
+                        # connection (worker wedged, final frame lost)
+                        # must become a typed migration trigger, not an
+                        # indefinite hang.
+                        kind, payload, seq = await asyncio.wait_for(
+                            q.get(), idle_s)
+                    else:
+                        kind, payload, seq = await q.get()
+                except asyncio.TimeoutError:
+                    try:
+                        await conn.send({"t": "kill", "rid": rid})
+                    except (ConnectionError, OSError):
+                        pass
+                    raise StreamIncompleteError(
+                        f"Stream ended before generation completed (no "
+                        f"frames from {instance.instance_id:x} for "
+                        f"{idle_s:g}s)") from None
                 if kind == "wake":
                     continue  # cancellation wakeup; loop top sends stop/kill
                 if kind == "data":
+                    if chaos.ACTIVE and chaos.fire("stream.disconnect",
+                                                   "client"):
+                        conn.close()  # read loop broadcasts ("lost")
+                        continue
+                    if seq is not None:
+                        if seq < expected_seq:
+                            continue  # duplicate frame: already delivered
+                        if seq > expected_seq:
+                            raise StreamIncompleteError(
+                                "Stream ended before generation completed "
+                                f"(frame gap: expected #{expected_seq}, "
+                                f"got #{seq})")
+                        expected_seq += 1
                     yield payload
                 elif kind == "final":
+                    if seq is not None and seq != expected_seq:
+                        raise StreamIncompleteError(
+                            "Stream ended before generation completed "
+                            f"(final after #{expected_seq} of {seq} frames)")
                     return
                 elif kind == "err":
                     if payload == "incomplete":

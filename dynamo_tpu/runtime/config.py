@@ -79,6 +79,17 @@ class RuntimeConfig:
     # Graceful-shutdown drain timeout.
     shutdown_timeout_s: float = 10.0
 
+    # How long a deregistered instance's in-flight streams may keep
+    # draining before the request-plane connection is force-closed
+    # (runtime/client.py retire-on-delete path).
+    retire_drain_s: float = 30.0
+
+    # Per-stream inter-frame deadline on the request plane: a stream
+    # with no frames for this long fails typed (StreamIncompleteError
+    # -> migration) instead of hanging on a zombie connection. 0
+    # disables.
+    stream_idle_timeout_s: float = 300.0
+
     @classmethod
     def from_settings(cls, path: str | None = None) -> "RuntimeConfig":
         """defaults <- TOML (DTPU_CONFIG_PATH or ``path``) <- DTPU_* env."""
@@ -104,6 +115,9 @@ class RuntimeConfig:
         cfg.system_port = _env_int("SYSTEM_PORT", cfg.system_port)
         cfg.num_worker_threads = _env_int("NUM_WORKER_THREADS", cfg.num_worker_threads)
         cfg.shutdown_timeout_s = _env_float("SHUTDOWN_TIMEOUT_S", cfg.shutdown_timeout_s)
+        cfg.retire_drain_s = _env_float("RETIRE_DRAIN_S", cfg.retire_drain_s)
+        cfg.stream_idle_timeout_s = _env_float(
+            "STREAM_IDLE_TIMEOUT_S", cfg.stream_idle_timeout_s)
         return cfg
 
     @property

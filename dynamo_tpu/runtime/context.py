@@ -48,6 +48,9 @@ class Context:
         return self._killed.is_set()
 
     async def wait_stopped(self) -> None:
+        # Cancellation watcher by design: callers hold this as a task
+        # and cancel it when the stream ends.
+        # dtpu: ignore[unbounded-wait] -- see above
         await self._stopped.wait()
 
     def child(self) -> "Context":

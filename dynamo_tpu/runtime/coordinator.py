@@ -73,7 +73,7 @@ class _Conn:
         try:
             while True:
                 obj = await self._outbox.get()
-                await write_frame(self.writer, obj)
+                await write_frame(self.writer, obj, chaos_site="coord")
         except asyncio.CancelledError:
             raise  # close() cancelled us; finally still runs the cleanup
         except (ConnectionError, OSError):
@@ -188,7 +188,7 @@ class Coordinator:
         pending: set[asyncio.Task] = set()
         try:
             while True:
-                msg = await read_frame(reader)
+                msg = await read_frame(reader, chaos_site="coord")
                 if msg.get("m") == "queue_pop":
                     # The only op that can block (timed wait for an item):
                     # run it off the read loop, holding a strong reference so
@@ -335,6 +335,7 @@ async def run_coordinator(host: str = "0.0.0.0", port: int = 4222) -> None:
     coord = Coordinator(host, port)
     await coord.start()
     try:
+        # dtpu: ignore[unbounded-wait] -- serve-forever until killed
         await asyncio.Event().wait()
     finally:
         await coord.stop()

@@ -12,8 +12,10 @@ import asyncio
 import itertools
 from typing import Any, AsyncIterator
 
+from dynamo_tpu.runtime import chaos
 from dynamo_tpu.runtime.frame import read_frame, write_frame
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.retry import Backoff, RetryPolicy, policies
 
 log = get_logger("coordinator_client")
 
@@ -131,15 +133,22 @@ class CoordinatorClient:
                       ) -> "CoordinatorClient":
         client = cls(host, port)
         last: Exception | None = None
-        for _ in range(retries):
+        policy = policies.COORD_CONNECT
+        if (retries, retry_delay) != (40, 0.25):  # caller override
+            policy = RetryPolicy(initial_delay_s=retry_delay,
+                                 max_delay_s=policy.max_delay_s,
+                                 multiplier=policy.multiplier,
+                                 jitter=policy.jitter, max_attempts=retries)
+        backoff = Backoff(policy)
+        while True:
             try:
                 client._reader, client._writer = await asyncio.open_connection(host, port)
                 break
             except OSError as exc:
                 last = exc
-                await asyncio.sleep(retry_delay)
-        else:
-            raise ConnectionError(f"coordinator unreachable at {host}:{port}: {last}")
+                if not await backoff.sleep():
+                    raise ConnectionError(
+                        f"coordinator unreachable at {host}:{port}: {last}")
         client._reader_task = asyncio.create_task(client._read_loop())
         # Primary lease: liveness anchor for everything this process registers
         # (reference: etcd primary lease, transports/etcd/lease.rs).
@@ -171,7 +180,8 @@ class CoordinatorClient:
         assert self._reader is not None
         try:
             while True:
-                msg = await read_frame(self._reader)
+                msg = await read_frame(self._reader,
+                                       chaos_site="coord_client")
                 if "i" in msg and msg["i"] is not None and ("ok" in msg):
                     fut = self._pending.pop(msg["i"], None)
                     if fut and not fut.done():
@@ -209,28 +219,27 @@ class CoordinatorClient:
                 self._reconnect_task = asyncio.ensure_future(
                     self._reconnect())
 
-    async def _reconnect(self, retry_delay: float = 0.25,
-                         max_delay: float = 5.0) -> None:
+    async def _reconnect(self) -> None:
         """Survive a coordinator restart: redial (forever, with capped
-        backoff, until closed), re-grant the primary lease, replay
-        registrations (lease-recreated callbacks), and re-establish every
-        live watch and subscription — synthesizing DELETE events for keys
-        that vanished with the old coordinator. Server-side queue contents
-        do not survive (stated posture: the coordinator is a restartable
-        but non-persistent control plane)."""
+        jittered backoff from policies.COORD_RECONNECT, until closed),
+        re-grant the primary lease, replay registrations (lease-recreated
+        callbacks), and re-establish every live watch and subscription —
+        synthesizing DELETE events for keys that vanished with the old
+        coordinator. Server-side queue contents do not survive (stated
+        posture: the coordinator is a restartable but non-persistent
+        control plane)."""
         if self._keepalive_task:
             self._keepalive_task.cancel()
         log.warning("coordinator connection lost; reconnecting to %s:%d",
                     self.host, self.port)
-        delay = retry_delay
+        backoff = Backoff(policies.COORD_RECONNECT)
         while not self._closed:
             try:
                 self._reader, self._writer = await asyncio.open_connection(
                     self.host, self.port)
                 break
             except OSError:
-                await asyncio.sleep(delay)
-                delay = min(max_delay, delay * 1.5)
+                await backoff.sleep()
         if self._closed:
             return
         # Fail anything that slipped into the pending map while the old
@@ -296,6 +305,13 @@ class CoordinatorClient:
     async def _keepalive_loop(self, lease_id: int, interval: float) -> None:
         while True:
             await asyncio.sleep(interval)
+            if chaos.ACTIVE and chaos.fire("lease.starve"):
+                # Injected keepalive starvation: sleep past the TTL so
+                # the server expires the lease, then resume — the next
+                # keepalive's "not found" exercises the regrant path.
+                log.warning("chaos: starving lease %d keepalives", lease_id)
+                await asyncio.sleep(self._lease_ttl_s * 1.5)
+                continue
             try:
                 await self._request({"m": "lease_keepalive", "lease": lease_id})
             except ConnectionError:
@@ -336,7 +352,14 @@ class CoordinatorClient:
                 except Exception:  # noqa: BLE001
                     log.exception("lease-recreated callback failed")
 
-    async def _request(self, msg: dict) -> Any:
+    # Hard ceiling on any single control-plane round trip. Ops complete
+    # in milliseconds when the coordinator is healthy; one that can't
+    # answer within this deadline is indistinguishable from a
+    # partitioned one, so the reply wait must not be unbounded (a lost
+    # reply frame would otherwise park the caller forever).
+    REQUEST_TIMEOUT_S = 30.0
+
+    async def _request(self, msg: dict, timeout: float | None = None) -> Any:
         if (self._writer is None or self._writer.is_closing()
                 or not self._connected):
             raise ConnectionError("not connected")
@@ -345,8 +368,19 @@ class CoordinatorClient:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
         async with self._send_lock:
-            await write_frame(self._writer, msg)
-        return await fut
+            await write_frame(self._writer, msg, chaos_site="coord_client")
+        try:
+            return await asyncio.wait_for(
+                fut, self.REQUEST_TIMEOUT_S if timeout is None else timeout)
+        except asyncio.TimeoutError:
+            self._pending.pop(rid, None)
+            # Force the connection down so the read loop schedules a
+            # reconnect — a silently unresponsive control plane must be
+            # treated exactly like a dead one.
+            if self._writer is not None and not self._closed:
+                self._writer.close()
+            raise ConnectionError(
+                f"coordinator request {msg.get('m')!r} timed out") from None
 
     # -- etcd-shaped API ------------------------------------------------------
     async def lease_grant(self, ttl: float) -> int:
@@ -436,8 +470,13 @@ class CoordinatorClient:
         await self._request({"m": "queue_push", "queue": queue, "item": item})
 
     async def queue_pop(self, queue: str, timeout: float = 0.0) -> Any | None:
+        if chaos.ACTIVE and chaos.fire("queue.pop_error"):
+            raise ConnectionError("chaos: injected queue_pop failure")
+        # The server blocks up to ``timeout`` before answering None, so
+        # the wire deadline must sit beyond it.
         result = await self._request(
-            {"m": "queue_pop", "queue": queue, "timeout": timeout})
+            {"m": "queue_pop", "queue": queue, "timeout": timeout},
+            timeout=timeout + self.REQUEST_TIMEOUT_S)
         return None if result is None else result["item"]
 
     async def queue_len(self, queue: str) -> int:

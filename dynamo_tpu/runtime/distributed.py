@@ -87,6 +87,9 @@ class DistributedRuntime:
         self.shutdown_event.set()
 
     async def wait_for_shutdown(self) -> None:
+        # Workers block here until a signal handler or admin call sets
+        # shutdown.
+        # dtpu: ignore[unbounded-wait] -- serve-forever by contract
         await self.shutdown_event.wait()
 
     async def close(self) -> None:

@@ -16,6 +16,8 @@ from typing import Any
 
 import msgpack
 
+from dynamo_tpu.runtime import chaos
+
 MAX_FRAME = 256 * 1024 * 1024  # 256 MiB hard cap
 _LEN = struct.Struct(">I")
 
@@ -27,8 +29,15 @@ def encode_frame(obj: Any) -> bytes:
     return _LEN.pack(len(body)) + body
 
 
-async def read_frame(reader: asyncio.StreamReader) -> Any:
-    """Read one frame; raises asyncio.IncompleteReadError on clean EOF."""
+async def read_frame(reader: asyncio.StreamReader,
+                     chaos_site: str | None = None) -> Any:
+    """Read one frame; raises asyncio.IncompleteReadError on clean EOF.
+
+    ``chaos_site`` labels this choke point for fault injection
+    (runtime/chaos.py); with no plan armed the guard is a single bool
+    check."""
+    if chaos.ACTIVE:
+        await chaos.on_frame_read(chaos_site)
     header = await reader.readexactly(4)
     (length,) = _LEN.unpack(header)
     if length > MAX_FRAME:
@@ -37,6 +46,12 @@ async def read_frame(reader: asyncio.StreamReader) -> Any:
     return msgpack.unpackb(body, raw=False)
 
 
-async def write_frame(writer: asyncio.StreamWriter, obj: Any) -> None:
-    writer.write(encode_frame(obj))
+async def write_frame(writer: asyncio.StreamWriter, obj: Any,
+                      chaos_site: str | None = None) -> None:
+    data = encode_frame(obj)
+    if chaos.ACTIVE:
+        data = await chaos.on_frame_write(writer, data, chaos_site)
+        if data is None:  # frame dropped by the plan
+            return
+    writer.write(data)
     await writer.drain()

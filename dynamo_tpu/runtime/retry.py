@@ -1,0 +1,143 @@
+"""Unified retry policy: jittered exponential backoff + retry budgets.
+
+Every recovery path in the runtime used to carry its own ad-hoc sleep
+constants (coordinator reconnect: 0.25*1.5^n capped at 5; prefill-queue
+pop: flat 0.5; KV pulls: no retry at all). This module is the single
+source of those decisions, reference-style (the Rust side leans on
+tokio-retry semantics): a ``RetryPolicy`` describes the curve, a
+``Backoff`` walks it for one operation, and a shared ``RetryBudget``
+(token bucket) keeps a fleet of callers from synchronizing into a
+retry storm when a dependency dies — once the budget drains, retries
+still happen but only at the policy's max delay.
+
+Usage::
+
+    backoff = Backoff(policies.QUEUE_POP)
+    while True:
+        try:
+            return await op()
+        except ConnectionError:
+            if not await backoff.sleep():
+                raise   # attempts exhausted
+
+``Backoff.reset()`` after a success re-arms the curve for long-lived
+loops (the prefill-queue pop loop, the coordinator redial loop).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import random
+import time
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """An exponential-backoff curve with full-range jitter."""
+
+    initial_delay_s: float = 0.25
+    max_delay_s: float = 5.0
+    multiplier: float = 2.0
+    jitter: float = 0.1  # +/- fraction applied to each delay
+    max_attempts: int | None = None  # None = retry forever
+
+    def delay(self, attempt: int, rng: random.Random | None = None) -> float:
+        base = min(self.max_delay_s,
+                   self.initial_delay_s * self.multiplier ** attempt)
+        if self.jitter:
+            r = (rng or random).random()
+            base *= 1.0 + self.jitter * (2.0 * r - 1.0)
+        return max(0.0, base)
+
+
+class RetryBudget:
+    """Token bucket bounding how fast a caller may retry. Each retry
+    spends one token; tokens refill at ``rate`` per second up to
+    ``burst``. An empty budget doesn't forbid the retry — it forces it
+    to the policy's max delay, which is what breaks a synchronized
+    retry storm without killing liveness."""
+
+    def __init__(self, rate: float = 2.0, burst: float = 10.0):
+        self.rate = rate
+        self.burst = burst
+        self._tokens = burst
+        self._t = time.monotonic()
+
+    def try_spend(self, cost: float = 1.0) -> bool:
+        now = time.monotonic()
+        self._tokens = min(self.burst,
+                           self._tokens + (now - self._t) * self.rate)
+        self._t = now
+        if self._tokens >= cost:
+            self._tokens -= cost
+            return True
+        return False
+
+
+class Backoff:
+    """Stateful per-operation walk of a RetryPolicy."""
+
+    def __init__(self, policy: RetryPolicy,
+                 budget: RetryBudget | None = None,
+                 rng: random.Random | None = None):
+        self.policy = policy
+        self.budget = budget
+        self.attempt = 0
+        self._rng = rng
+
+    def next_delay(self) -> float | None:
+        """The next sleep, or None when attempts are exhausted. An empty
+        retry budget escalates the delay to the policy max instead of
+        giving up (budget = pacing, max_attempts = termination)."""
+        p = self.policy
+        if p.max_attempts is not None and self.attempt >= p.max_attempts:
+            return None
+        d = p.delay(self.attempt, self._rng)
+        self.attempt += 1
+        if self.budget is not None and not self.budget.try_spend():
+            d = max(d, p.max_delay_s)
+        return d
+
+    async def sleep(self) -> bool:
+        """Async: back off once. False when attempts are exhausted."""
+        d = self.next_delay()
+        if d is None:
+            return False
+        await asyncio.sleep(d)
+        return True
+
+    def sleep_sync(self) -> bool:
+        """Sync flavor for executor/engine threads (KV-plane pulls)."""
+        d = self.next_delay()
+        if d is None:
+            return False
+        time.sleep(d)
+        return True
+
+    def reset(self) -> None:
+        self.attempt = 0
+
+
+class policies:
+    """The repo's named retry policies — the one place delay constants
+    live. Callers reference these instead of inlining numbers."""
+
+    # First dial to a coordinator that may still be starting up.
+    COORD_CONNECT = RetryPolicy(initial_delay_s=0.25, max_delay_s=2.0,
+                                multiplier=1.5, jitter=0.1, max_attempts=40)
+    # Redial after a coordinator crash/restart: forever, capped.
+    COORD_RECONNECT = RetryPolicy(initial_delay_s=0.25, max_delay_s=5.0,
+                                  multiplier=1.5, jitter=0.2)
+    # Prefill-queue pop loop survival (worker must keep draining).
+    QUEUE_POP = RetryPolicy(initial_delay_s=0.25, max_delay_s=5.0,
+                            multiplier=2.0, jitter=0.2)
+    # KV-plane parcel pulls: bounded — past a few attempts the caller
+    # prefills locally, which is always the cheap safe fallback.
+    KV_PULL = RetryPolicy(initial_delay_s=0.05, max_delay_s=1.0,
+                          multiplier=2.0, jitter=0.2, max_attempts=3)
+    # Request-plane migration retries: near-immediate (the stream is
+    # user-visible latency) but jittered so a worker death doesn't make
+    # every migrated stream redial in lockstep.
+    MIGRATION = RetryPolicy(initial_delay_s=0.05, max_delay_s=1.0,
+                            multiplier=2.0, jitter=0.5)

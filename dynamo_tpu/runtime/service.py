@@ -103,7 +103,7 @@ class EndpointServer:
 
         async def send(obj: dict) -> None:
             async with send_lock:
-                await write_frame(writer, obj)
+                await write_frame(writer, obj, chaos_site="service")
 
         conn_tasks: set[asyncio.Task] = set()
         self._conn_writers.add(writer)
@@ -147,6 +147,12 @@ class EndpointServer:
         self._m_requests.inc()
         self._m_inflight.inc()
         started = time.monotonic()
+        # Per-stream sequence numbers: data frames carry "s"=0,1,2,... and
+        # the final frame carries the total, so the client can DETECT a
+        # lost or duplicated frame (a worker bug, or injected chaos) and
+        # fail typed (StreamIncompleteError -> migration) instead of
+        # silently delivering a short stream.
+        seq = 0
         try:
             # The ctx ids arrived on the wire frame (Context.to_wire
             # carries the traceparent), so this span joins the CALLER's
@@ -158,11 +164,13 @@ class EndpointServer:
                 async for response in self._handler(request, ctx):
                     if ctx.is_killed:
                         break
-                    await send({"t": "data", "rid": rid, "p": response})
+                    await send({"t": "data", "rid": rid, "p": response,
+                                "s": seq})
+                    seq += 1
             if ctx.is_killed:
                 await send({"t": "err", "rid": rid, "e": "killed"})
             else:
-                await send({"t": "final", "rid": rid})
+                await send({"t": "final", "rid": rid, "s": seq})
         except asyncio.CancelledError:
             raise
         except (ValueError, InvalidRequestError) as exc:

@@ -10,6 +10,11 @@ echo "== dtpu-lint (python -m dynamo_tpu.analysis dynamo_tpu) =="
 python -m dynamo_tpu.analysis dynamo_tpu || exit 1
 echo "clean."
 
+echo "== chaos smoke (seeded fault injection, docs/RESILIENCE.md) =="
+# The fast scenario subset; the combined high-fault matrix is -m slow.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py \
+    -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

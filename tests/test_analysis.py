@@ -205,6 +205,57 @@ def test_bare_except_without_await_is_quiet(tmp_path):
     assert run_rule(tmp_path, "swallowed-cancellation", src) == []
 
 
+# -- unbounded-wait -----------------------------------------------------------
+
+UNBOUNDED_BAD = """\
+import asyncio
+
+async def request(self, msg):
+    fut = asyncio.get_running_loop().create_future()
+    self._pending[msg["i"]] = fut
+    await self.send(msg)
+    return await fut
+
+async def drain(self):
+    await self._idle.wait()
+"""
+
+UNBOUNDED_GOOD = """\
+import asyncio
+
+async def request(self, msg):
+    fut = asyncio.get_running_loop().create_future()
+    self._pending[msg["i"]] = fut
+    await self.send(msg)
+    return await asyncio.wait_for(fut, 30.0)
+
+async def drain(self):
+    await asyncio.wait_for(self._idle.wait(), timeout=5)
+    done, pending = await asyncio.wait(self._tasks)
+
+def sync_helper(self):
+    self._thread_event.wait()
+"""
+
+
+def test_unbounded_wait_fires(tmp_path):
+    found = run_rule(tmp_path, "unbounded-wait", UNBOUNDED_BAD)
+    assert len(found) == 2
+    assert any("create_future" in f.message for f in found)
+    assert any(".wait()" in f.message for f in found)
+
+
+def test_unbounded_wait_quiet_on_good(tmp_path):
+    assert run_rule(tmp_path, "unbounded-wait", UNBOUNDED_GOOD) == []
+
+
+def test_unbounded_wait_suppression(tmp_path):
+    src = ("async def serve_forever(self):\n"
+           "    # dtpu: ignore[unbounded-wait] -- serve-forever loop\n"
+           "    await self._shutdown.wait()\n")
+    assert run_rule(tmp_path, "unbounded-wait", src) == []
+
+
 # -- jit-recompile-hazard -----------------------------------------------------
 
 JIT_BAD = """\
@@ -423,7 +474,8 @@ def test_default_rules_catalog():
     ids = {r.rule_id for r in default_rules()}
     assert ids == {"blocking-call-in-async", "fire-and-forget-task",
                    "lock-across-await", "swallowed-cancellation",
-                   "jit-recompile-hazard", "wire-error-taxonomy"}
+                   "unbounded-wait", "jit-recompile-hazard",
+                   "wire-error-taxonomy"}
 
 
 def test_unparseable_file_reports_parse_error(tmp_path):

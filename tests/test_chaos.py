@@ -1,0 +1,517 @@
+"""Chaos plane: seeded fault injection + the scenario-matrix resilience suite.
+
+Every scenario arms a FaultPlan (runtime/chaos.py) over the mocker-backed
+full stack (coordinator + workers + request plane + Migration) and asserts
+the core resilience invariant:
+
+    every request either completes with EXACTLY the requested number of
+    tokens, or fails with a TYPED error, within a deadline — no hangs,
+    no lost or duplicated tokens, no generic untyped failures.
+
+The fast scenarios here are the tier-1 smoke subset (scripts/check.sh runs
+them as their own stage); the combined high-fault matrix is marked slow.
+Reproduce any scenario outside pytest by exporting its spec, e.g.::
+
+    DTPU_CHAOS="seed=11;frame.drop@service=0.04" python -m ...
+
+See docs/RESILIENCE.md for the failure model and the spec grammar.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+from conftest import async_test
+
+from dynamo_tpu.llm.migration import Migration
+from dynamo_tpu.llm.mocker import MockerConfig, MockerEngine
+from dynamo_tpu.llm.discovery import RouterEngine
+from dynamo_tpu.llm.protocols import PreprocessedRequest
+from dynamo_tpu.runtime import chaos
+from dynamo_tpu.runtime.chaos import FaultPlan
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.coordinator import Coordinator
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.errors import (
+    InvalidRequestError, NoInstancesError, OverloadedError,
+    StreamIncompleteError)
+
+NS = "chaos"
+FAST = dict(prefill_tokens_per_s=1e7, decode_step_s=0.0005)
+
+# The typed failure vocabulary: anything else (generic EngineError, bare
+# Exception) is an invariant violation.
+TYPED = (StreamIncompleteError, NoInstancesError, OverloadedError,
+         InvalidRequestError)
+
+
+# -- FaultPlan unit behavior ---------------------------------------------------
+
+def test_spec_parsing_issue_example():
+    plan = FaultPlan("seed=7;frame.drop=0.02;frame.delay_ms=5..40:0.1;"
+                     "conn.reset=0.01;lease.starve@t=3;kv.pull_error=0.05")
+    assert plan.seed == 7
+    by_key = {r.key: r for r in plan.rules}
+    assert by_key["frame.drop"].prob == 0.02
+    assert (by_key["frame.delay_ms"].lo, by_key["frame.delay_ms"].hi,
+            by_key["frame.delay_ms"].prob) == (5.0, 40.0, 0.1)
+    assert by_key["lease.starve"].at_lo == 3.0
+    assert by_key["lease.starve"].site is None  # @t is time, not a site
+    assert by_key["kv.pull_error"].prob == 0.05
+
+
+def test_spec_parsing_site_count_and_window_forms():
+    plan = FaultPlan("seed=1;frame.drop@service=0.5;stream.disconnect=x3;"
+                     "lease.starve@t=1..2.5;kv.stall_ms=10..20")
+    by_key = {r.key: r for r in plan.rules}
+    assert by_key["frame.drop"].site == "service"
+    assert by_key["stream.disconnect"].times == 3
+    assert (by_key["lease.starve"].at_lo, by_key["lease.starve"].at_hi) == (1.0, 2.5)
+    assert by_key["kv.stall_ms"].prob == 1.0  # range without :P fires always
+    with pytest.raises(ValueError):
+        FaultPlan("frame.drop")  # missing '='
+    with pytest.raises(ValueError):
+        FaultPlan("frame.drop=1.5")  # probability out of range
+
+
+def test_same_seed_reproduces_fault_sequence():
+    spec = "seed=42;frame.drop=0.3;frame.delay_ms=1..9:0.5;kv.pull_error=0.2"
+    queries = [("frame.drop", "service"), ("frame.delay_ms", "client"),
+               ("kv.pull_error", "kv")] * 200
+
+    def run(s):
+        plan = FaultPlan(s)
+        plan.arm()
+        return [plan.draw(k, site) for k, site in queries], plan.log
+
+    decisions_a, log_a = run(spec)
+    decisions_b, log_b = run(spec)
+    assert decisions_a == decisions_b
+    assert log_a == log_b
+    assert any(d is not None for d in decisions_a)
+    decisions_c, _ = run("seed=43;frame.drop=0.3;frame.delay_ms=1..9:0.5;"
+                         "kv.pull_error=0.2")
+    assert decisions_a != decisions_c
+
+
+def test_count_rule_is_deterministic():
+    plan = FaultPlan("seed=0;kv.pull_error=x2")
+    plan.arm()
+    hits = [plan.draw("kv.pull_error", "kv") for _ in range(5)]
+    assert [h is not None for h in hits] == [True, True, False, False, False]
+
+
+def test_site_scoping():
+    plan = FaultPlan("seed=0;frame.drop@service=1.0")
+    plan.arm()
+    assert plan.draw("frame.drop", "service") is not None
+    assert plan.draw("frame.drop", "client") is None
+    assert plan.draw("frame.drop", None) is None
+
+
+def test_disabled_hooks_are_noops():
+    assert chaos.ACTIVE is False
+    assert chaos.plan() is None
+    assert chaos.fire("frame.drop", "service") is False
+    assert chaos.value("kv.stall_ms", "kv") is None
+
+
+def test_resilience_config_env_overrides(monkeypatch):
+    monkeypatch.setenv("DTPU_RETIRE_DRAIN_S", "7.5")
+    monkeypatch.setenv("DTPU_STREAM_IDLE_TIMEOUT_S", "42")
+    cfg = RuntimeConfig.from_settings()
+    assert cfg.retire_drain_s == 7.5
+    assert cfg.stream_idle_timeout_s == 42.0
+    assert RuntimeConfig().retire_drain_s == 30.0
+
+
+@async_test
+async def test_frames_unchanged_when_chaos_disabled():
+    """With no plan armed the wire path is byte-identical to before."""
+    from dynamo_tpu.runtime.frame import read_frame, write_frame
+    server_got = []
+
+    async def on_conn(reader, writer):
+        server_got.append(await read_frame(reader, chaos_site="service"))
+        await write_frame(writer, {"pong": 1}, chaos_site="service")
+
+    server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    await write_frame(writer, {"ping": 1}, chaos_site="client")
+    reply = await read_frame(reader, chaos_site="client")
+    assert server_got == [{"ping": 1}] and reply == {"pong": 1}
+    writer.close()
+    server.close()
+    await server.wait_closed()
+
+
+# -- matrix harness ------------------------------------------------------------
+
+async def _start_worker(coord, **mocker_kwargs):
+    rt = await DistributedRuntime.from_settings(RuntimeConfig(
+        coordinator_url=coord.url, lease_ttl_s=1.0, namespace=NS))
+    engine = MockerEngine(MockerConfig(**{**FAST, **mocker_kwargs}))
+    endpoint = rt.namespace(NS).component("mocker").endpoint("generate")
+    server = await endpoint.serve_endpoint(engine.handler(),
+                                           graceful_shutdown=False)
+    engine.start()
+    return rt, engine, server
+
+
+async def _start_pipeline(coord, migration_limit=8, n_instances=1,
+                          idle_timeout_s=2.0):
+    """Frontend side: client + router + Migration, with a short stream
+    idle deadline so lost-final-frame faults become typed promptly."""
+    rt = await DistributedRuntime.from_settings(RuntimeConfig(
+        coordinator_url=coord.url, lease_ttl_s=1.0, namespace=NS,
+        stream_idle_timeout_s=idle_timeout_s))
+    client = await rt.namespace(NS).component("mocker").endpoint(
+        "generate").client()
+    await client.wait_for_instances(timeout=10)
+    while len(client.instance_ids()) < n_instances:
+        await asyncio.sleep(0.02)
+    migration = Migration(migration_limit, inner=RouterEngine(client),
+                          metrics=rt.metrics)
+    return rt, client, migration
+
+
+def _make_req(max_tokens=24):
+    req = PreprocessedRequest(model="mock-model",
+                              token_ids=list(range(1, 9)))
+    req.stop_conditions.max_tokens = max_tokens
+    req.stop_conditions.ignore_eos = True
+    return req
+
+
+async def _run_one(migration, max_tokens, deadline_s):
+    """Drive one request under the invariant. Returns ("ok", n_tokens),
+    ("typed", name), ("untyped", detail) or ("hang", n_tokens)."""
+    tokens = []
+
+    async def consume():
+        async for out in migration.generate(_make_req(max_tokens), Context()):
+            tokens.extend(out.token_ids)
+            if out.finish_reason:
+                return
+
+    try:
+        await asyncio.wait_for(consume(), deadline_s)
+    except TYPED as exc:
+        return ("typed", type(exc).__name__)
+    except asyncio.TimeoutError:
+        return ("hang", len(tokens))
+    except Exception as exc:  # noqa: BLE001 — the invariant check itself
+        return ("untyped", f"{type(exc).__name__}: {exc}")
+    return ("ok", len(tokens))
+
+
+def _assert_invariant(results, max_tokens, require_ok=False):
+    for r in results:
+        assert r[0] in ("ok", "typed"), f"invariant violated: {results}"
+        if r[0] == "ok":
+            assert r[1] == max_tokens, \
+                f"token count drifted (want {max_tokens}): {results}"
+        elif require_ok:
+            raise AssertionError(f"expected completions only: {results}")
+
+
+async def _batch(migration, n, max_tokens, deadline_s):
+    return await asyncio.gather(
+        *(_run_one(migration, max_tokens, deadline_s) for _ in range(n)))
+
+
+# -- scenario matrix -----------------------------------------------------------
+
+@async_test(timeout=120)
+async def test_scenario_frame_loss():
+    """Dropped response frames (worker->client) are DETECTED via stream
+    sequence numbers and migrated — never silently shortened streams."""
+    coord = Coordinator()
+    await coord.start()
+    workers = [await _start_worker(coord) for _ in range(2)]
+    rt, client, migration = await _start_pipeline(coord, n_instances=2)
+    try:
+        with chaos.active("seed=11;frame.drop@service=0.04"):
+            results = await _batch(migration, 6, 24, deadline_s=30)
+        _assert_invariant(results, 24)
+        assert any(r[0] == "ok" for r in results), results
+    finally:
+        chaos.uninstall()
+        await client.close()
+        await rt.close()
+        for wrt, engine, server in workers:
+            await engine.stop()
+            await server.shutdown()
+            await wrt.close()
+        await coord.stop()
+
+
+@async_test(timeout=120)
+async def test_scenario_connection_reset_mid_stream():
+    """Abrupt connection resets on worker sends: every stream migrates to
+    a live connection and completes exactly, or fails typed."""
+    coord = Coordinator()
+    await coord.start()
+    workers = [await _start_worker(coord) for _ in range(2)]
+    rt, client, migration = await _start_pipeline(coord, n_instances=2,
+                                                  migration_limit=10)
+    try:
+        with chaos.active("seed=12;conn.reset@service=0.02"):
+            results = await _batch(migration, 6, 24, deadline_s=30)
+        _assert_invariant(results, 24)
+        assert any(r[0] == "ok" for r in results), results
+    finally:
+        chaos.uninstall()
+        await client.close()
+        await rt.close()
+        for wrt, engine, server in workers:
+            await engine.stop()
+            await server.shutdown()
+            await wrt.close()
+        await coord.stop()
+
+
+@async_test(timeout=60)
+async def test_scenario_deterministic_disconnects_migrate():
+    """First 3 received data frames sever the instance connection
+    (count-form rule): the request still completes with exactly the
+    requested tokens via migration, and migrations are observable."""
+    coord = Coordinator()
+    await coord.start()
+    workers = [await _start_worker(coord)]
+    rt, client, migration = await _start_pipeline(coord, migration_limit=5)
+    try:
+        with chaos.active("seed=13;stream.disconnect=x3") as plan:
+            result = await _run_one(migration, 24, deadline_s=20)
+        assert result == ("ok", 24), result
+        assert len([f for f in plan.log
+                    if f[0] == "stream.disconnect"]) == 3
+        # migrations_total counted the retries (1..3: several injected
+        # disconnects can land inside one attempt's queued frames).
+        migrated = rt.metrics.counter(
+            "migrations_total",
+            "Mid-stream migrations (retries after disconnect)").get()
+        assert 1 <= migrated <= 3, migrated
+    finally:
+        chaos.uninstall()
+        await client.close()
+        await rt.close()
+        for wrt, engine, server in workers:
+            await engine.stop()
+            await server.shutdown()
+            await wrt.close()
+        await coord.stop()
+
+
+@async_test(timeout=120)
+async def test_scenario_lease_starvation():
+    """Keepalive starvation forces server-side lease expiry: in-flight
+    streams drain through the retire grace, workers re-register via the
+    regrant path, and the instance set recovers to full strength."""
+    coord = Coordinator()
+    await coord.start()
+    # Slower decode so streams genuinely span the starvation window.
+    workers = [await _start_worker(coord, decode_step_s=0.005)
+               for _ in range(2)]
+    rt, client, migration = await _start_pipeline(coord, n_instances=2)
+    try:
+        with chaos.active("seed=14;lease.starve@t=1..2.2"):
+            all_results = []
+            # Issue batches continuously across the starvation window
+            # (~1.1s serve each + pauses covers t=0..6).
+            for _ in range(4):
+                all_results.extend(await _batch(migration, 3, 200,
+                                                deadline_s=30))
+                await asyncio.sleep(0.5)
+            _assert_invariant(all_results, 200)
+            assert any(r[0] == "ok" for r in all_results), all_results
+        # Recovery: both instances re-registered after lease regrant.
+        for _ in range(200):
+            if len(client.instance_ids()) == 2:
+                break
+            await asyncio.sleep(0.05)
+        assert len(client.instance_ids()) == 2
+        results = await _batch(migration, 3, 24, deadline_s=30)
+        _assert_invariant(results, 24, require_ok=True)
+    finally:
+        chaos.uninstall()
+        await client.close()
+        await rt.close()
+        for wrt, engine, server in workers:
+            await engine.stop()
+            await server.shutdown()
+            await wrt.close()
+        await coord.stop()
+
+
+@async_test(timeout=120)
+async def test_scenario_coordinator_restart_under_load():
+    """The control plane dies and restarts while requests are flowing.
+    In-flight streams ride their direct TCP connections; gap requests may
+    fail typed (instances transiently invisible); after clients replay
+    their registrations everything completes again."""
+    import socket as pysocket
+
+    with pysocket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = Coordinator("127.0.0.1", port)
+    await coord.start()
+    # Slower decode so the in-flight batch brackets the restart.
+    workers = [await _start_worker(coord, decode_step_s=0.005)
+               for _ in range(2)]
+    rt, client, migration = await _start_pipeline(coord, n_instances=2)
+    coord2 = None
+    try:
+        inflight = asyncio.ensure_future(_batch(migration, 4, 200,
+                                                deadline_s=60))
+        await asyncio.sleep(0.1)
+        await coord.stop()
+        await asyncio.sleep(0.3)
+        coord2 = Coordinator("127.0.0.1", port)
+        await coord2.start()
+        # Requests issued while clients reconnect: ok or typed, no hangs.
+        gap_results = await _batch(migration, 3, 24, deadline_s=30)
+        _assert_invariant(gap_results, 24)
+        _assert_invariant(await inflight, 200)
+        # Full recovery: discovery repopulates and requests complete.
+        for _ in range(400):
+            if len(client.instance_ids()) == 2:
+                break
+            await asyncio.sleep(0.05)
+        assert len(client.instance_ids()) == 2
+        results = await _batch(migration, 4, 24, deadline_s=30)
+        _assert_invariant(results, 24, require_ok=True)
+    finally:
+        await client.close()
+        await rt.close()
+        for wrt, engine, server in workers:
+            await engine.stop()
+            await server.shutdown()
+            await wrt.close()
+        if coord2 is not None:
+            await coord2.stop()
+
+
+@async_test(timeout=60)
+async def test_scenario_kv_pull_failure_retries_then_succeeds():
+    """Injected KV-plane pull errors and a partial parcel: the parcel
+    stays staged across failed attempts and the unified retry recovers
+    the exact bytes."""
+    from dynamo_tpu.llm.kv_plane import KvPlaneClient, KvPlaneServer
+
+    server = KvPlaneServer(use_jax_path=False)
+    server.start()
+    client = KvPlaneClient(timeout=5.0)
+    try:
+        kv = np.arange(2 * 3 * 4 * 8, dtype=np.float32).reshape(2, 3, 4, 8)
+        with chaos.active("seed=15;kv.pull_error=x2"):
+            ticket = server.stage(kv=kv, prompt_len=7)
+            out = await client.pull(ticket)
+        np.testing.assert_array_equal(out, kv)
+        assert server._staged == {}  # released after the successful pull
+        # Partial parcel: server sends half then severs; retry refetches.
+        with chaos.active("seed=15;kv.partial=x1"):
+            ticket = server.stage(kv=kv, prompt_len=7)
+            out = await client.pull(ticket)
+        np.testing.assert_array_equal(out, kv)
+    finally:
+        chaos.uninstall()
+        client.close()
+        server.close()
+
+
+@async_test(timeout=60)
+async def test_scenario_prefill_queue_pop_recovery_and_worker_crash():
+    """(a) queue_pop failures: the worker's pull loop survives through the
+    unified backoff and then serves. (b) a worker that wedges mid-serve:
+    the dispatcher times out typed-ly and returns None (caller prefills
+    locally) — never hangs."""
+    from dynamo_tpu.llm.kv_plane import KvPlaneClient, KvPlaneServer
+    from dynamo_tpu.llm.prefill_queue import (QueuePrefillDispatcher,
+                                              QueuePrefillWorker)
+
+    coord = Coordinator()
+    await coord.start()
+    rt_w = await DistributedRuntime.from_settings(RuntimeConfig(
+        coordinator_url=coord.url, lease_ttl_s=5.0, namespace=NS))
+    rt_d = await DistributedRuntime.from_settings(RuntimeConfig(
+        coordinator_url=coord.url, lease_ttl_s=5.0, namespace=NS))
+    plane = KvPlaneServer(use_jax_path=False)
+    plane.start()
+    plane_client = KvPlaneClient(timeout=5.0)
+    kv = np.ones((2, 2, 4, 8), dtype=np.float32)
+
+    class ScriptedPrefillEngine:
+        async def run_job(self, fn):
+            return fn()
+
+        def prefill_extract_staged(self, req, plane):
+            ticket = plane.stage(kv=kv, prompt_len=len(req.token_ids))
+            return req.token_ids[0], ticket, len(req.token_ids)
+
+    worker = QueuePrefillWorker(ScriptedPrefillEngine(),
+                                rt_w.require_coordinator(), "mock-model",
+                                plane, poll_timeout=0.2)
+    dispatcher = QueuePrefillDispatcher(rt_d.require_coordinator(),
+                                        "mock-model", plane_client,
+                                        reply_timeout=15.0)
+    try:
+        with chaos.active("seed=16;queue.pop_error=x3"):
+            worker.start()
+            req = _make_req(8)
+            result = await asyncio.wait_for(
+                dispatcher.remote_prefill(req, context=Context()), 30)
+        assert result is not None, "queue prefill should recover after pops"
+        first_token, pulled = result
+        assert first_token == req.token_ids[0]
+        np.testing.assert_array_equal(pulled, kv)
+        assert worker.pulled == 1
+
+        # (b) crash mid-serve: stop the worker, then dispatch with a short
+        # reply deadline — the dispatcher degrades to local prefill.
+        await worker.stop()
+        dispatcher.reply_timeout = 0.5
+        result = await asyncio.wait_for(
+            dispatcher.remote_prefill(_make_req(8), context=Context()), 10)
+        assert result is None
+    finally:
+        chaos.uninstall()
+        await worker.stop()
+        plane_client.close()
+        plane.close()
+        await rt_w.close()
+        await rt_d.close()
+        await coord.stop()
+
+
+@pytest.mark.slow
+@async_test(timeout=300)
+async def test_chaos_matrix_combined_heavy():
+    """The full-strength matrix: several fault classes at once, more
+    workers, more requests. Everything still lands inside the invariant."""
+    coord = Coordinator()
+    await coord.start()
+    workers = [await _start_worker(coord) for _ in range(3)]
+    rt, client, migration = await _start_pipeline(coord, n_instances=3,
+                                                  migration_limit=16)
+    try:
+        with chaos.active("seed=7;frame.drop@service=0.02;"
+                          "conn.reset@service=0.01;"
+                          "frame.delay_ms@service=1..10:0.05;"
+                          "stream.disconnect=0.01"):
+            results = await _batch(migration, 16, 32, deadline_s=120)
+        _assert_invariant(results, 32)
+        assert sum(1 for r in results if r[0] == "ok") >= len(results) // 2, \
+            results
+    finally:
+        chaos.uninstall()
+        await client.close()
+        await rt.close()
+        for wrt, engine, server in workers:
+            await engine.stop()
+            await server.shutdown()
+            await wrt.close()
+        await coord.stop()

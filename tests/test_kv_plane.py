@@ -134,9 +134,13 @@ async def test_concurrent_pulls_serve_exactly_once(plane):
 @async_test
 async def test_failed_send_restages_ticket(plane):
     """A pull whose resolve fails must release the in-progress claim so
-    the sink's retry still finds the parcel staged."""
+    a retry still finds the parcel staged. A single transient fault is
+    now absorbed by the client's own unified retry (runtime/retry.py,
+    policies.KV_PULL); a persistent fault exhausts it and raises, and a
+    LATER client still finds the parcel staged once the fault clears."""
     server, client = plane
     kv = _rand_kv(seed=8)
+    # One transient fault: the same pull() call recovers by itself.
     boom = [True]
 
     def resolve():
@@ -146,11 +150,27 @@ async def test_failed_send_restages_ticket(plane):
 
     ticket = server.stage(meta={"shape": list(kv.shape),
                                 "dtype": "bfloat16"}, resolve=resolve)
+    out = await client.pull(ticket)
+    np.testing.assert_array_equal(kv.view(np.uint16), out.view(np.uint16))
+
+    # Persistent fault (outlives the retry policy's attempts): the pull
+    # raises, but the parcel stays staged for a later retry.
+    # 6 faults: the first pull's 4 attempts (1 + 3 retries) all fail;
+    # the later client fails twice more, then succeeds.
+    boom2 = [True] * 6
+
+    def resolve2():
+        if boom2.pop() if boom2 else False:
+            raise RuntimeError("device fault")
+        return kv
+
+    ticket2 = server.stage(meta={"shape": list(kv.shape),
+                                 "dtype": "bfloat16"}, resolve=resolve2)
     with pytest.raises((ConnectionError, OSError)):
-        await client.pull(ticket)
+        await client.pull(ticket2)
     retry = KvPlaneClient()
     try:
-        out = await retry.pull(ticket)
+        out = await retry.pull(ticket2)
         np.testing.assert_array_equal(kv.view(np.uint16), out.view(np.uint16))
     finally:
         retry.close()

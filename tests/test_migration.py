@@ -1,0 +1,132 @@
+"""Migration operator edge cases, against a scripted flaky inner engine.
+
+Covers the corners the cross-process e2e (test_fault_tolerance_e2e) can't
+script deterministically: budget arithmetic across retries, stop-aborted
+retries, repeated migrations not double-counting carried tokens, and the
+died-on-the-final-boundary case where a retry would overshoot max_tokens.
+"""
+
+import pytest
+from conftest import async_test
+
+from dynamo_tpu.llm.migration import Migration
+from dynamo_tpu.llm.protocols import (FinishReason, LLMEngineOutput,
+                                      PreprocessedRequest)
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import AsyncEngine
+from dynamo_tpu.runtime.errors import StreamIncompleteError
+from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+
+class FlakyEngine(AsyncEngine):
+    """Scripted inner engine: per attempt, yield N tokens then either
+    die (StreamIncompleteError) or finish cleanly. Records every request
+    it saw so tests can assert the retry arithmetic."""
+
+    def __init__(self, script):
+        self.script = list(script)  # [(n_tokens, dies), ...]
+        self.requests: list[PreprocessedRequest] = []
+
+    async def generate(self, request, context):
+        req = PreprocessedRequest.from_wire(request)
+        self.requests.append(req)
+        n, dies = self.script.pop(0)
+        budget = req.stop_conditions.max_tokens
+        count = n if budget is None else min(n, budget)
+        base = 1000 + len(req.token_ids)  # distinct per-attempt tokens
+        for i in range(count):
+            yield LLMEngineOutput(token_ids=[base + i]).to_wire()
+        if dies:
+            raise StreamIncompleteError()
+        yield LLMEngineOutput(token_ids=[],
+                              finish_reason=FinishReason.LENGTH).to_wire()
+
+
+def _req(max_tokens):
+    req = PreprocessedRequest(model="m", token_ids=[1, 2, 3])
+    req.stop_conditions.max_tokens = max_tokens
+    return req
+
+
+async def _collect(migration, req, ctx=None):
+    tokens, finish = [], None
+    async for out in migration.generate(req, ctx or Context()):
+        tokens.extend(out.token_ids)
+        finish = out.finish_reason or finish
+    return tokens, finish
+
+
+@async_test
+async def test_budget_shrinks_across_retry_and_total_is_exact():
+    engine = FlakyEngine([(4, True), (99, False)])
+    migration = Migration(3, inner=engine)
+    tokens, _ = await _collect(migration, _req(10))
+    assert len(tokens) == 10
+    # Retry prompt = original + the 4 carried tokens; budget 10 - 4 = 6.
+    assert len(engine.requests) == 2
+    retry = engine.requests[1]
+    assert retry.token_ids[:3] == [1, 2, 3]
+    assert len(retry.token_ids) == 3 + 4
+    assert retry.stop_conditions.max_tokens == 6
+
+
+@async_test
+async def test_budget_exhausted_at_disconnect_does_not_overshoot():
+    """Inner dies exactly at the budget boundary (tokens delivered, final
+    frame lost): the stream is complete — a retry would deliver budget+1."""
+    engine = FlakyEngine([(5, True), (99, False)])
+    migration = Migration(3, inner=engine)
+    tokens, _ = await _collect(migration, _req(5))
+    assert len(tokens) == 5
+    assert len(engine.requests) == 1, "no retry once the budget is spent"
+
+
+@async_test
+async def test_stopped_context_aborts_retry():
+    engine = FlakyEngine([(2, True), (99, False)])
+    migration = Migration(3, inner=engine)
+    ctx = Context()
+    req = _req(10)
+    tokens = []
+    with pytest.raises(StreamIncompleteError):
+        async for out in migration.generate(req, ctx):
+            tokens.extend(out.token_ids)
+            ctx.stop_generating()
+    assert len(tokens) == 2
+    assert len(engine.requests) == 1, "stopped context must not migrate"
+
+
+@async_test
+async def test_repeated_migrations_do_not_double_count():
+    engine = FlakyEngine([(3, True), (2, True), (99, False)])
+    migration = Migration(5, inner=engine)
+    tokens, _ = await _collect(migration, _req(12))
+    assert len(tokens) == 12
+    assert len(engine.requests) == 3
+    r2, r3 = engine.requests[1], engine.requests[2]
+    # Each retry rebuilds from the ORIGINAL prompt + all accumulated.
+    assert len(r2.token_ids) == 3 + 3 and r2.stop_conditions.max_tokens == 9
+    assert len(r3.token_ids) == 3 + 5 and r3.stop_conditions.max_tokens == 7
+
+
+@async_test
+async def test_migration_limit_exhaustion_reraises_typed():
+    engine = FlakyEngine([(1, True), (1, True), (1, True)])
+    migration = Migration(2, inner=engine)
+    tokens = []
+    with pytest.raises(StreamIncompleteError):
+        async for out in migration.generate(_req(10), Context()):
+            tokens.extend(out.token_ids)
+    assert len(engine.requests) == 3  # 1 attempt + 2 retries
+
+
+@async_test
+async def test_migrations_total_counter():
+    metrics = MetricsRegistry()
+    engine = FlakyEngine([(2, True), (2, True), (99, False)])
+    migration = Migration(5, inner=engine, metrics=metrics)
+    tokens, _ = await _collect(migration, _req(9))
+    assert len(tokens) == 9
+    counter = metrics.counter(
+        "migrations_total", "Mid-stream migrations (retries after disconnect)")
+    assert counter.get() == 2

@@ -1,0 +1,77 @@
+"""Unit tests for runtime/retry.py: the unified backoff curve, the retry
+budget's storm-braking escalation, and the named policy registry every
+recovery site routes through."""
+
+import random
+
+from conftest import async_test
+
+from dynamo_tpu.runtime.retry import (Backoff, RetryBudget, RetryPolicy,
+                                      policies)
+
+
+def test_delay_curve_is_capped_and_jittered():
+    policy = RetryPolicy(initial_delay_s=0.1, max_delay_s=1.0,
+                         multiplier=2.0, jitter=0.1)
+    rng = random.Random(0)
+    delays = [policy.delay(a, rng) for a in range(10)]
+    # Exponential up to the cap, +/- 10% jitter around each point.
+    for a, d in enumerate(delays):
+        base = min(1.0, 0.1 * 2.0 ** a)
+        assert base * 0.9 - 1e-9 <= d <= base * 1.1 + 1e-9, (a, d)
+    assert max(delays) <= 1.1
+
+
+def test_zero_jitter_is_exact():
+    policy = RetryPolicy(initial_delay_s=0.5, max_delay_s=4.0,
+                         multiplier=2.0, jitter=0.0)
+    assert [policy.delay(a) for a in range(4)] == [0.5, 1.0, 2.0, 4.0]
+
+
+def test_backoff_exhausts_after_max_attempts():
+    policy = RetryPolicy(initial_delay_s=0.0, jitter=0.0, max_attempts=3)
+    backoff = Backoff(policy)
+    assert [backoff.next_delay() is not None for _ in range(5)] == \
+        [True, True, True, False, False]
+    backoff.reset()
+    assert backoff.next_delay() is not None
+
+
+def test_budget_escalates_instead_of_giving_up():
+    policy = RetryPolicy(initial_delay_s=0.01, max_delay_s=5.0,
+                         multiplier=1.0, jitter=0.0)
+    budget = RetryBudget(rate=0.0, burst=2.0)  # two tokens, no refill
+    backoff = Backoff(policy, budget=budget)
+    assert backoff.next_delay() == 0.01
+    assert backoff.next_delay() == 0.01
+    # Bucket empty: retries continue but at the policy max (storm brake).
+    assert backoff.next_delay() == 5.0
+    assert backoff.next_delay() == 5.0
+
+
+def test_budget_refills_over_time():
+    budget = RetryBudget(rate=1000.0, burst=1.0)
+    assert budget.try_spend()
+    import time
+    time.sleep(0.01)  # 1000/s refill: full again almost immediately
+    assert budget.try_spend()
+
+
+@async_test
+async def test_async_sleep_contract():
+    backoff = Backoff(RetryPolicy(initial_delay_s=0.0, jitter=0.0,
+                                  max_attempts=1))
+    assert await backoff.sleep() is True
+    assert await backoff.sleep() is False
+
+
+def test_named_policies_cover_every_recovery_site():
+    # The registry is the single home of retry constants; these sites
+    # reference it (coordinator connect/reconnect, queue pop, KV pull,
+    # migration). Bounded where a local fallback exists, unbounded where
+    # the loop must never die.
+    assert policies.COORD_CONNECT.max_attempts == 40
+    assert policies.COORD_RECONNECT.max_attempts is None
+    assert policies.QUEUE_POP.max_attempts is None
+    assert policies.KV_PULL.max_attempts == 3
+    assert policies.MIGRATION.initial_delay_s <= 0.1  # user-visible latency
