@@ -17,7 +17,7 @@ from dynamo_tpu.analysis.core import (
     Finding, Module, ProjectRule, Rule, analyze, load_paths)
 from dynamo_tpu.analysis.rules_async import (
     BlockingCallInAsync, FireAndForgetTask, LockAcrossAwait,
-    SwallowedCancellation, UnboundedWait)
+    SwallowedCancellation, UnboundedQueue, UnboundedWait)
 from dynamo_tpu.analysis.rules_jax import JitRecompileHazard
 from dynamo_tpu.analysis.rules_wire import WireErrorTaxonomy
 
@@ -31,6 +31,7 @@ DEFAULT_RULES: tuple[type[Rule], ...] = (
     FireAndForgetTask,
     LockAcrossAwait,
     SwallowedCancellation,
+    UnboundedQueue,
     UnboundedWait,
     JitRecompileHazard,
     WireErrorTaxonomy,

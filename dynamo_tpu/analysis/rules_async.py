@@ -291,6 +291,45 @@ class UnboundedWait(Rule):
         return names
 
 
+_ASYNC_QUEUE_CTORS = {"asyncio.Queue", "asyncio.LifoQueue",
+                      "asyncio.PriorityQueue"}
+
+
+class UnboundedQueue(Rule):
+    rule_id = "unbounded-queue"
+    description = ("`asyncio.Queue()` constructed without a maxsize outside "
+                   "test code: under overload it buffers arrivals "
+                   "unboundedly — memory grows and every queued item's "
+                   "latency is already blown before service starts. Bound "
+                   "it (with a shed/backpressure policy for the full case) "
+                   "or suppress with the rationale that bounds it naturally")
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        path = module.path.replace("\\", "/")
+        parts = path.split("/")
+        # Test code is exempt: tests build throwaway queues where the
+        # producer is the test itself.
+        if "tests" in parts[:-1] or parts[-1].startswith("test_"):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if qualified_name(node.func) not in _ASYNC_QUEUE_CTORS:
+                continue
+            size = node.args[0] if node.args else _kw(node, "maxsize")
+            if size is not None and not (isinstance(size, ast.Constant)
+                                         and not size.value):
+                continue
+            yield self.finding(
+                module, node,
+                f"`{qualified_name(node.func)}()` without maxsize: "
+                "unbounded buffering under overload",
+                "pass maxsize= (pair put_nowait with a QueueFull "
+                "shed/backpressure policy), or suppress with the "
+                "invariant that bounds the queue (e.g. one item per "
+                "in-flight request capped elsewhere)")
+
+
 _CANCELLED = {"asyncio.CancelledError", "CancelledError"}
 
 

@@ -237,6 +237,13 @@ class EngineConfig:
     # projected TTFT including QUEUED cold tokens exceeds budget x this
     # factor. 0 disables rejection: requests queue unboundedly instead.
     admission_reject_factor: float = 0.0
+    # Engine-local brownout (runtime/overload.py has the frontend half):
+    # at projected-TTFT pressure level >= this, speculative drafting is
+    # suspended for decode windows until pressure drops — the verify
+    # step's extra positions are overhead exactly when the engine is
+    # behind. 0 disables the hook. Needs ttft_budget_ms to have a
+    # pressure signal at all.
+    brownout_spec_disable_level: int = 2
 
     def bucket_for(self, length: int) -> int:
         for b in self.prefill_buckets:

@@ -185,6 +185,11 @@ class TPUEngine(AsyncEngine):
         self.spec_drafts = 0        # verify steps that had drafts
         self.spec_tokens = 0        # draft tokens proposed
         self.spec_accepted = 0      # draft tokens accepted
+        # Engine-local brownout (see _update_brownout): 0..3 pressure
+        # level from the TTFT projection; spec_brownout_windows counts
+        # decode windows where drafting was suspended by it.
+        self.brownout_level = 0
+        self.spec_brownout_windows = 0
         # Control jobs executed on the engine thread between windows
         # (disagg prefill-extract, KV injection helpers, etc.).
         self._jobs: queue.Queue = queue.Queue()
@@ -358,6 +363,9 @@ class TPUEngine(AsyncEngine):
         req = (request if isinstance(request, PreprocessedRequest)
                else PreprocessedRequest.from_wire(request))
         self._validate(req)
+        # One emitted item per generated token, capped by len_cap; the
+        # consumer is this generator's own caller.
+        # dtpu: ignore[unbounded-queue] -- bounded by max_tokens via len_cap
         r = _Request(req=req, ctx=context, out_q=asyncio.Queue(),
                      loop=asyncio.get_running_loop(),
                      tokens_all=list(req.token_ids),
@@ -394,6 +402,7 @@ class TPUEngine(AsyncEngine):
         req = (request if isinstance(request, PreprocessedRequest)
                else PreprocessedRequest.from_wire(request))
         self._validate(req)
+        # dtpu: ignore[unbounded-queue] -- bounded by max_tokens via len_cap
         r = _Request(req=req, ctx=context, out_q=asyncio.Queue(),
                      loop=asyncio.get_running_loop(),
                      tokens_all=list(req.token_ids),
@@ -894,8 +903,28 @@ class TPUEngine(AsyncEngine):
                         f"window processing failed: {exc}"))
                     self._finish_slot(i, register=False)
 
+    # -- engine-local brownout -------------------------------------------------
+    def _update_brownout(self) -> None:
+        """Pressure level 0..3 from the projected-TTFT/budget ratio —
+        the engine-local analogue of the frontend limiter's
+        pressure_level() (runtime/overload.py). Level >=
+        brownout_spec_disable_level suspends speculative drafting: under
+        prefill backlog the verify steps' extra positions are pure decode
+        overhead whenever drafts stop being accepted."""
+        cfg = self.config
+        projected = (self.estimated_ttft_ms()
+                     if cfg.ttft_budget_ms else None)
+        if not projected:
+            self.brownout_level = 0
+            return
+        ratio = projected / cfg.ttft_budget_ms
+        self.brownout_level = (0 if ratio < 1.0 else
+                               1 if ratio < 1.5 else
+                               2 if ratio < 2.5 else 3)
+
     # -- admission / prefill --------------------------------------------------
     def _admit(self) -> bool:
+        self._update_brownout()
         free_slots = [i for i, r in enumerate(self.slot_req) if r is None]
         staged: list[tuple[_Request, int, PrefillSeq]] = []
         while free_slots:
@@ -1456,7 +1485,16 @@ class TPUEngine(AsyncEngine):
             self.disp_positions[i] += adv
             self.disp_seq_lens[i] += adv
         self._flush_spills()
-        if self.config.spec_decode:
+        # Brownout degradation hook: drop back to plain decode windows
+        # while the engine-local pressure level is at/above the
+        # configured threshold (0 in config disables the hook).
+        use_spec = bool(self.config.spec_decode)
+        if (use_spec and self.config.brownout_spec_disable_level
+                and self.brownout_level
+                >= self.config.brownout_spec_disable_level):
+            use_spec = False
+            self.spec_brownout_windows += 1
+        if use_spec:
             outs = self.runner.decode_spec_window(
                 packed, self.spec_m_outer, self.config.spec_k)
         else:
@@ -1468,7 +1506,7 @@ class TPUEngine(AsyncEngine):
                 pass
         return _Window(toks=outs, slots=slots, frozen=frozen, size=M,
                        serial=self._dispatch_serial,
-                       spec=bool(self.config.spec_decode),
+                       spec=use_spec,
                        t0=time.monotonic())
 
     def _process_window(self, w: _Window) -> None:

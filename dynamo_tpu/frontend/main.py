@@ -16,6 +16,7 @@ from dynamo_tpu.llm.http_service import HttpService
 from dynamo_tpu.runtime.config import RuntimeConfig
 from dynamo_tpu.runtime.distributed import DistributedRuntime
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.overload import AdaptiveLimiter
 
 log = get_logger("frontend")
 
@@ -34,6 +35,21 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument("--kv-router-temperature", type=float, default=0.0)
     parser.add_argument("--busy-threshold", type=float, default=None,
                         help="reject (503) when all workers exceed this load")
+    # Overload defense (runtime/overload.py; docs/RESILIENCE.md):
+    # adaptive admission + deadline-aware shedding + brownout on the
+    # HTTP ingress, per-worker circuit breakers on the request plane.
+    # Fine-grained knobs via DTPU_OVERLOAD_* env / [overload] TOML.
+    parser.add_argument("--no-overload-defense", action="store_true",
+                        help="disable adaptive admission/shedding on the "
+                             "HTTP ingress (breakers stay governed by "
+                             "DTPU_OVERLOAD_BREAKER_ENABLED)")
+    parser.add_argument("--overload-target-ms", type=float, default=None,
+                        help="AIMD per-phase (TTFT) latency target the "
+                             "admission limit adapts against")
+    parser.add_argument("--overload-max-concurrency", type=int, default=None)
+    parser.add_argument("--default-deadline-ms", type=float, default=None,
+                        help="server default when a request carries no "
+                             "x-request-deadline-ms header")
     parser.add_argument("--coordinator-url", default=None)
     parser.add_argument("--grpc-port", type=int, default=None,
                         help="also serve the KServe v2 gRPC inference "
@@ -67,9 +83,21 @@ async def run(args: argparse.Namespace) -> None:
     watcher = ModelWatcher(runtime, manager, router_mode=args.router_mode,
                            kv_router_factory=kv_router_factory)
     await watcher.start()
+    ov = cfg.overload
+    if args.no_overload_defense:
+        ov.enabled = False
+    if args.overload_target_ms is not None:
+        ov.target_latency_ms = args.overload_target_ms
+    if args.overload_max_concurrency is not None:
+        ov.max_concurrency = args.overload_max_concurrency
+    if args.default_deadline_ms is not None:
+        ov.default_deadline_ms = args.default_deadline_ms
+    limiter = (AdaptiveLimiter(ov, metrics=runtime.metrics)
+               if ov.enabled else None)
     service = HttpService(runtime, manager, args.http_host, args.http_port,
                           tls_cert_path=args.tls_cert_path,
-                          tls_key_path=args.tls_key_path)
+                          tls_key_path=args.tls_key_path,
+                          overload=limiter)
     await service.start()
     grpc_server = None
     if args.grpc_port is not None:

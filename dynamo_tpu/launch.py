@@ -285,8 +285,15 @@ async def run(args) -> None:
             await runtime.wait_for_shutdown()
             await server.stop(grace=1.0)
             return
+        # Overload defense (runtime/overload.py): same adaptive
+        # admission the distributed frontend gets, DTPU_OVERLOAD_*
+        # configurable (DTPU_OVERLOAD_ENABLED=0 disables).
+        from dynamo_tpu.runtime.overload import AdaptiveLimiter
+        ov = runtime.config.overload
+        limiter = (AdaptiveLimiter(ov, metrics=runtime.metrics)
+                   if ov.enabled else None)
         service = HttpService(runtime, manager, host=args.http_host,
-                              port=args.http_port)
+                              port=args.http_port, overload=limiter)
         await service.start()
         print(f"LAUNCH_READY in={args.input} out={args.output} "
               f"port={service.port}", flush=True)

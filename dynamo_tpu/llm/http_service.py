@@ -11,6 +11,7 @@ including TTFT/ITL observations (http/service/metrics.rs).
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
 import time
 from typing import AsyncIterator
@@ -27,12 +28,19 @@ from dynamo_tpu.llm.protocols import (
 )
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.errors import (InvalidRequestError, NoInstancesError,
-                                       OverloadedError)
+                                       OverloadedError, RateLimitedError)
 from dynamo_tpu.runtime.logging import (current_trace, get_logger,
                                         parse_traceparent)
+from dynamo_tpu.runtime.overload import (PRIORITY_BATCH, PRIORITY_INTERACTIVE,
+                                         AdaptiveLimiter)
 from dynamo_tpu.runtime.tracing import span
 
 log = get_logger("http")
+
+# Overload-defense request headers (docs/RESILIENCE.md "Overload model").
+DEADLINE_HEADER = "x-request-deadline-ms"
+PRIORITY_HEADER = "x-priority"
+BROWNOUT_HEADER = "X-Overload-Brownout"
 
 
 def _response_object(full: dict, model: str, text: str | None) -> dict:
@@ -58,10 +66,17 @@ def _response_object(full: dict, model: str, text: str | None) -> dict:
 
 
 def _error_body(message: str, err_type: str = "invalid_request_error",
-                code: int = 400) -> web.Response:
+                code: int = 400,
+                retry_after_s: float | None = None) -> web.Response:
+    headers = {}
+    if retry_after_s is not None:
+        # Retry-After is integer seconds (RFC 9110); round UP so "0.4s"
+        # doesn't tell clients to hammer back immediately.
+        headers["Retry-After"] = str(max(1, int(-(-retry_after_s // 1))))
     return web.Response(
         status=code,
         content_type="application/json",
+        headers=headers,
         text=json.dumps({"error": {"message": message, "type": err_type,
                                    "param": None, "code": None}}))
 
@@ -70,7 +85,8 @@ class HttpService:
     def __init__(self, runtime, manager: ModelManager,
                  host: str = "0.0.0.0", port: int = 8000,
                  tls_cert_path: str | None = None,
-                 tls_key_path: str | None = None):
+                 tls_key_path: str | None = None,
+                 overload: AdaptiveLimiter | None = None):
         self._runtime = runtime
         self.manager = manager
         self.host, self.port = host, port
@@ -79,6 +95,10 @@ class HttpService:
         # error surfaced at start().
         self.tls_cert_path = tls_cert_path
         self.tls_key_path = tls_key_path
+        # Overload defense (runtime/overload.py): adaptive admission +
+        # deadline-aware shedding + brownout around the generate routes.
+        # None = no admission control (tests, embedded use).
+        self.overload = overload
         self._runner: web.AppRunner | None = None
         metrics = runtime.metrics.namespace("http")
         self._m_requests = metrics.counter(
@@ -145,8 +165,99 @@ class HttpService:
         current_trace.set({"trace_id": ctx.trace_id, "span_id": ctx.span_id})
         return ctx
 
+    def _retry_after(self, exc: Exception | None = None) -> float:
+        """Retry-After seconds for a shed/overloaded response: the
+        error's own projection if it carries one, else the limiter's
+        admission-queue projection, else the config default."""
+        hint = getattr(exc, "retry_after_s", None)
+        if hint:
+            return hint
+        if self.overload is not None:
+            return self.overload.retry_after_s()
+        ov = getattr(self._runtime.config, "overload", None)
+        return ov.retry_after_default_s if ov is not None else 1.0
+
+    def _overload_params(self, request: web.Request
+                         ) -> tuple[str, float | None, web.Response | None]:
+        """(priority, deadline_ms, error_response) from the overload
+        request headers. A malformed deadline is the caller's bug: 400,
+        not a silent default."""
+        priority = request.headers.get(
+            PRIORITY_HEADER, PRIORITY_INTERACTIVE).strip().lower()
+        if priority not in (PRIORITY_INTERACTIVE, PRIORITY_BATCH):
+            return PRIORITY_INTERACTIVE, None, _error_body(
+                f"unknown {PRIORITY_HEADER} {priority!r} "
+                f"(use 'interactive' or 'batch')")
+        raw = request.headers.get(DEADLINE_HEADER)
+        deadline_ms: float | None = None
+        if raw is not None:
+            try:
+                deadline_ms = float(raw)
+                if deadline_ms <= 0:
+                    raise ValueError
+            except ValueError:
+                return priority, None, _error_body(
+                    f"invalid {DEADLINE_HEADER} {raw!r} "
+                    "(positive milliseconds)")
+        return priority, deadline_ms, None
+
+    async def _admit(self, request: web.Request, route: str):
+        """Run the overload-defense admission for one request. Returns
+        (permit_ctx, response_headers, error_response): on a shed,
+        error_response is the typed 429/503 (+ Retry-After) and the
+        caller returns it immediately."""
+        null = contextlib.nullcontext()
+        if self.overload is None:
+            return null, {}, None
+        priority, deadline_ms, bad = self._overload_params(request)
+        if bad is not None:
+            self._m_requests.inc(route=route, status="400")
+            return null, {}, bad
+        try:
+            permit = await self.overload.admit(priority, deadline_ms)
+        except RateLimitedError as exc:
+            self._m_requests.inc(route=route, status="429")
+            return null, {}, _error_body(
+                str(exc), "rate_limited", 429,
+                retry_after_s=self._retry_after(exc))
+        except OverloadedError as exc:
+            self._m_requests.inc(route=route, status="503")
+            return null, {}, _error_body(
+                str(exc), "overloaded", 503,
+                retry_after_s=self._retry_after(exc))
+        headers = {}
+        level = self.overload.pressure_level()
+        if level > 0:
+            # Brownout reported in response metadata so clients can see
+            # (and log) that they got degraded service.
+            headers[BROWNOUT_HEADER] = str(level)
+        return permit, headers, None
+
+    def _apply_brownout(self, req) -> None:
+        """Degradation hook: clamp max_tokens under brownout (the
+        clamped value is visible in the response's usage block)."""
+        if self.overload is None:
+            return
+        clamped = self.overload.clamp_max_tokens(
+            getattr(req, "max_tokens", None))
+        if clamped is not None:
+            req.max_tokens = clamped
+
+    @staticmethod
+    async def _timed_first(chunks: AsyncIterator[dict], permit,
+                           started: float) -> AsyncIterator[dict]:
+        """Report time-to-first-chunk (the per-phase latency AIMD adapts
+        against) into the admission permit."""
+        async for chunk in chunks:
+            if permit is not None and hasattr(permit, "note_latency"):
+                permit.note_latency(time.monotonic() - started)
+                permit = None
+            yield chunk
+
     async def _sse_stream(self, request: web.Request, chunks: AsyncIterator[dict],
-                          ctx: Context, model: str) -> web.StreamResponse:
+                          ctx: Context, model: str,
+                          extra_headers: dict | None = None
+                          ) -> web.StreamResponse:
         # Pull the first chunk BEFORE sending headers so pipeline errors
         # (no instances, overload) still surface as proper HTTP statuses.
         start_t = time.monotonic()
@@ -158,7 +269,8 @@ class HttpService:
         self._m_ttft.observe(time.monotonic() - start_t, model=model)
         response = web.StreamResponse(
             headers={"Content-Type": "text/event-stream",
-                     "Cache-Control": "no-cache"})
+                     "Cache-Control": "no-cache",
+                     **(extra_headers or {})})
         await response.prepare(request)
         last_t = time.monotonic()
         try:
@@ -196,14 +308,21 @@ class HttpService:
                 self._m_requests.inc(route=route, status="404")
                 return _error_body(f"model {chat_req.model!r} not found",
                                    "model_not_found", 404)
+            permit, meta_headers, shed = await self._admit(request, route)
+            if shed is not None:
+                return shed
             ctx = self._make_context(request)
             try:
-                with span("http.request", ctx=ctx, route=route,
-                          model=chat_req.model):
-                    chunks = served.preprocessor.generate(chat_req, ctx)
+                with permit, span("http.request", ctx=ctx, route=route,
+                                  model=chat_req.model):
+                    self._apply_brownout(chat_req)
+                    chunks = self._timed_first(
+                        served.preprocessor.generate(chat_req, ctx),
+                        permit, time.monotonic())
                     if chat_req.stream:
                         resp = await self._sse_stream(request, chunks, ctx,
-                                                      chat_req.model)
+                                                      chat_req.model,
+                                                      meta_headers)
                         self._m_requests.inc(route=route, status="200")
                         return resp
                     # Non-streaming: force the usage chunk through the
@@ -212,13 +331,19 @@ class HttpService:
                     chat_req.stream_options = {"include_usage": True}
                     full = await aggregate_chat_stream(chunks, 0)
                     self._m_requests.inc(route=route, status="200")
-                    return web.json_response(full)
+                    return web.json_response(full, headers=meta_headers)
             except NoInstancesError as exc:
                 self._m_requests.inc(route=route, status="503")
-                return _error_body(str(exc), "service_unavailable", 503)
+                return _error_body(str(exc), "service_unavailable", 503,
+                                   retry_after_s=self._retry_after(exc))
+            except RateLimitedError as exc:
+                self._m_requests.inc(route=route, status="429")
+                return _error_body(str(exc), "rate_limited", 429,
+                                   retry_after_s=self._retry_after(exc))
             except OverloadedError as exc:
                 self._m_requests.inc(route=route, status="503")
-                return _error_body(str(exc), "overloaded", 503)
+                return _error_body(str(exc), "overloaded", 503,
+                                   retry_after_s=self._retry_after(exc))
             except (ValueError, InvalidRequestError) as exc:
                 # Engine-level request validation (unsupported sampling
                 # features, over-length prompts): the caller's fault —
@@ -249,19 +374,26 @@ class HttpService:
                 self._m_requests.inc(route=route, status="404")
                 return _error_body(f"model {comp_req.model!r} not found",
                                    "model_not_found", 404)
+            permit, meta_headers, shed = await self._admit(request, route)
+            if shed is not None:
+                return shed
             ctx = self._make_context(request)
             try:
-                with span("http.request", ctx=ctx, route=route,
-                          model=comp_req.model):
+                with permit, span("http.request", ctx=ctx, route=route,
+                                  model=comp_req.model):
+                    self._apply_brownout(comp_req)
                     if not comp_req.stream:
                         # Force the usage chunk so the folded response
                         # has counts.
                         comp_req.stream_options = {"include_usage": True}
-                    chunks = served.preprocessor.generate_completion(
-                        comp_req, ctx)
+                    chunks = self._timed_first(
+                        served.preprocessor.generate_completion(
+                            comp_req, ctx),
+                        permit, time.monotonic())
                     if comp_req.stream:
                         resp = await self._sse_stream(request, chunks, ctx,
-                                                      comp_req.model)
+                                                      comp_req.model,
+                                                      meta_headers)
                         self._m_requests.inc(route=route, status="200")
                         return resp
                     texts: list[str] = []
@@ -285,16 +417,22 @@ class HttpService:
                                      "finish_reason": finish,
                                      "logprobs": None}],
                         "usage": usage or usage_block(0, 0),
-                    })
+                    }, headers=meta_headers)
             except ValueError as exc:
                 self._m_requests.inc(route=route, status="400")
                 return _error_body(str(exc))
             except NoInstancesError as exc:
                 self._m_requests.inc(route=route, status="503")
-                return _error_body(str(exc), "service_unavailable", 503)
+                return _error_body(str(exc), "service_unavailable", 503,
+                                   retry_after_s=self._retry_after(exc))
+            except RateLimitedError as exc:
+                self._m_requests.inc(route=route, status="429")
+                return _error_body(str(exc), "rate_limited", 429,
+                                   retry_after_s=self._retry_after(exc))
             except OverloadedError as exc:
                 self._m_requests.inc(route=route, status="503")
-                return _error_body(str(exc), "overloaded", 503)
+                return _error_body(str(exc), "overloaded", 503,
+                                   retry_after_s=self._retry_after(exc))
             except Exception as exc:  # noqa: BLE001
                 log.exception("completion handler failed")
                 self._m_requests.inc(route=route, status="500")
@@ -358,7 +496,8 @@ class HttpService:
                         raise RuntimeError("worker returned no embeddings")
             except NoInstancesError as exc:
                 self._m_requests.inc(route=route, status="503")
-                return _error_body(str(exc), "service_unavailable", 503)
+                return _error_body(str(exc), "service_unavailable", 503,
+                                   retry_after_s=self._retry_after(exc))
             self._m_requests.inc(route=route, status="200")
             total = sum(len(t) for t in token_lists)
             return web.json_response({
@@ -460,7 +599,8 @@ class HttpService:
                         break
             except NoInstancesError as exc:
                 self._m_requests.inc(route=route, status="503")
-                return _error_body(str(exc), "service_unavailable", 503)
+                return _error_body(str(exc), "service_unavailable", 503,
+                                   retry_after_s=self._retry_after(exc))
             self._m_requests.inc(route=route, status="200")
             resp = {
                 "text": tokenizer.decode(toks),
@@ -522,9 +662,16 @@ class HttpService:
             except ValidationError as exc:
                 self._m_requests.inc(route=route, status="400")
                 return _error_body(str(exc))
+            permit, meta_headers, shed = await self._admit(request, route)
+            if shed is not None:
+                return shed
             ctx = self._make_context(request)
-            with span("http.request", ctx=ctx, route=route, model=model):
-                chunks = served.preprocessor.generate(chat_req, ctx)
+            with permit, span("http.request", ctx=ctx, route=route,
+                              model=model):
+                self._apply_brownout(chat_req)
+                chunks = self._timed_first(
+                    served.preprocessor.generate(chat_req, ctx),
+                    permit, time.monotonic())
                 if body.get("stream"):
                     resp = await self._responses_sse(request, chunks, ctx,
                                                      model)
@@ -534,11 +681,21 @@ class HttpService:
                 msg = full["choices"][0]["message"]
                 usage = full.get("usage") or {}
                 self._m_requests.inc(route=route, status="200")
-                return web.json_response(_response_object(full, model,
-                                                          msg.get("content")))
+                return web.json_response(
+                    _response_object(full, model, msg.get("content")),
+                    headers=meta_headers)
+        except RateLimitedError as exc:
+            self._m_requests.inc(route=route, status="429")
+            return _error_body(str(exc), "rate_limited", 429,
+                               retry_after_s=self._retry_after(exc))
+        except OverloadedError as exc:
+            self._m_requests.inc(route=route, status="503")
+            return _error_body(str(exc), "overloaded", 503,
+                               retry_after_s=self._retry_after(exc))
         except NoInstancesError as exc:
             self._m_requests.inc(route=route, status="503")
-            return _error_body(str(exc), "service_unavailable", 503)
+            return _error_body(str(exc), "service_unavailable", 503,
+                               retry_after_s=self._retry_after(exc))
         except Exception as exc:  # noqa: BLE001
             log.exception("responses handler failed")
             self._m_requests.inc(route=route, status="500")

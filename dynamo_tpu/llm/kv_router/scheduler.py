@@ -45,6 +45,12 @@ class KvScheduler:
         self.sequences = sequences
         # Latest ForwardPassMetrics per worker.
         self.metrics: dict[int, ForwardPassMetrics] = {}
+        # Optional per-worker circuit-breaker board (runtime/overload.py
+        # BreakerBoard, shared with the request-plane client): open
+        # breakers are excluded from selection before any cost math, so
+        # a sick worker stops receiving traffic until its half-open
+        # probe succeeds.
+        self.health = None
 
     def update_metrics(self, metrics: ForwardPassMetrics) -> None:
         self.metrics[metrics.worker_id] = metrics
@@ -72,9 +78,18 @@ class KvScheduler:
     def select(self, workers: list[int], request_blocks: int,
                overlaps: OverlapScores) -> tuple[int, int]:
         """Pick a worker; returns (worker_id, overlap_blocks). Raises
-        OverloadedError when busy_threshold is set and all workers are busy."""
+        OverloadedError (retryable -> 503 + Retry-After at the frontend)
+        when every worker is circuit-open or, with busy_threshold set,
+        above it."""
         if not workers:
             raise OverloadedError("no candidate workers")
+        if self.health is not None:
+            admitted = self.health.admitted(workers)
+            if not admitted:
+                raise OverloadedError(
+                    f"all {len(workers)} workers circuit-open "
+                    "(consecutive failures); retry shortly")
+            workers = admitted
         if self.config.busy_threshold is not None:
             free = [w for w in workers
                     if self._usage(w) < self.config.busy_threshold]

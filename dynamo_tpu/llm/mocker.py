@@ -128,6 +128,9 @@ class _Seq:
     def __init__(self, req: PreprocessedRequest, ctx: Context, block_size: int):
         self.req = req
         self.ctx = ctx
+        # One item per generated token, capped by the request's
+        # max_tokens budget in _emit_token.
+        # dtpu: ignore[unbounded-queue] -- bounded by max_tokens
         self.out_q: asyncio.Queue = asyncio.Queue()
         self.blocks = TokenBlockSequence(block_size, req.token_ids)
         self.generated = 0

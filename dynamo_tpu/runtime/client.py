@@ -14,15 +14,18 @@ from __future__ import annotations
 import asyncio
 import itertools
 import random
+import time
 import uuid
 from typing import Any, AsyncIterator
 
 from dynamo_tpu.runtime import chaos
 from dynamo_tpu.runtime.component import Endpoint, Instance, instance_prefix
 from dynamo_tpu.runtime.context import Context
-from dynamo_tpu.runtime.errors import EngineError, NoInstancesError, StreamIncompleteError
+from dynamo_tpu.runtime.errors import (EngineError, NoInstancesError,
+                                       OverloadedError, StreamIncompleteError)
 from dynamo_tpu.runtime.frame import read_frame, write_frame
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.overload import BreakerBoard
 
 log = get_logger("client")
 
@@ -78,6 +81,12 @@ class _InstanceConn:
             await write_frame(self._writer, obj, chaos_site="client")
 
     def open_stream(self, rid: str) -> asyncio.Queue:
+        # Per-stream response frames: bounded by the request's token
+        # budget (the worker emits one data frame per token, then
+        # final); bounding here would force the shared read loop to
+        # block — a slow consumer would head-of-line-block every other
+        # stream on this connection.
+        # dtpu: ignore[unbounded-queue] -- see above
         q: asyncio.Queue = asyncio.Queue()
         self._streams[rid] = q
         return q
@@ -107,6 +116,13 @@ class EndpointClient:
         self._watch = None
         self._watch_task: asyncio.Task | None = None
         self._instances_event = asyncio.Event()
+        # Per-worker circuit breakers (runtime/overload.py): typed
+        # transport/handler failures and latency outliers open a
+        # worker's breaker; selection skips it until a half-open probe
+        # succeeds. The KV router shares this board via its scheduler.
+        self.breakers = BreakerBoard(
+            getattr(runtime.config, "overload", None),
+            metrics=getattr(runtime, "metrics", None))
 
     async def start(self) -> None:
         if self._runtime.has_discovery:
@@ -129,6 +145,7 @@ class EndpointClient:
 
     def _remove_instance(self, instance_id: int) -> None:
         self._instances.pop(instance_id, None)
+        self.breakers.remove(instance_id)
         conn = self._conns.pop(instance_id, None)
         if conn:
             # Deregistration only stops NEW routing to the instance.
@@ -184,10 +201,18 @@ class EndpointClient:
                 raise NoInstancesError(
                     f"instance {instance_id:x} not found for {self._endpoint.path}")
             return self._instances[instance_id]
+        # Circuit breakers: skip workers whose breaker is open (direct
+        # mode bypasses — the KV router already filtered, and admin ops
+        # must be able to reach a sick instance deliberately).
+        healthy = self.breakers.admitted(ids)
+        if not healthy:
+            raise OverloadedError(
+                f"all {len(ids)} instances for {self._endpoint.path} are "
+                "circuit-open; retry shortly")
         if mode == "random":
-            return self._instances[random.choice(ids)]
+            return self._instances[random.choice(healthy)]
         # round_robin
-        return self._instances[ids[next(self._rr) % len(ids)]]
+        return self._instances[healthy[next(self._rr) % len(healthy)]]
 
     async def _conn_for(self, instance: Instance) -> _InstanceConn:
         # Per-instance lock: concurrent first requests share one connection
@@ -229,6 +254,8 @@ class EndpointClient:
     async def _stream(self, instance: Instance, request: Any, ctx: Context
                       ) -> AsyncIterator[Any]:
         rid = uuid.uuid4().hex
+        iid = instance.instance_id
+        breakers = self.breakers
         try:
             conn = await self._conn_for(instance)
             q = conn.open_stream(rid)
@@ -242,9 +269,14 @@ class EndpointClient:
             conn = self._conns.pop(instance.instance_id, None)
             if conn:
                 conn.close()
+            breakers.record_failure(iid)
             raise StreamIncompleteError(
                 f"Stream ended before generation completed "
                 f"(connect to {instance.instance_id:x} failed: {exc})") from exc
+        breakers.on_dispatch(iid)
+        sent_t = time.monotonic()
+        first_latency: float | None = None
+        failed = False
         stop_sent = False
         # A stop/kill issued while we're blocked on the queue must reach the
         # worker immediately (not only after the next frame arrives): a single
@@ -288,6 +320,8 @@ class EndpointClient:
                         await conn.send({"t": "kill", "rid": rid})
                     except (ConnectionError, OSError):
                         pass
+                    failed = True
+                    breakers.record_failure(iid)
                     raise StreamIncompleteError(
                         f"Stream ended before generation completed (no "
                         f"frames from {instance.instance_id:x} for "
@@ -299,10 +333,14 @@ class EndpointClient:
                                                    "client"):
                         conn.close()  # read loop broadcasts ("lost")
                         continue
+                    if first_latency is None:
+                        first_latency = time.monotonic() - sent_t
                     if seq is not None:
                         if seq < expected_seq:
                             continue  # duplicate frame: already delivered
                         if seq > expected_seq:
+                            failed = True
+                            breakers.record_failure(iid)
                             raise StreamIncompleteError(
                                 "Stream ended before generation completed "
                                 f"(frame gap: expected #{expected_seq}, "
@@ -311,15 +349,19 @@ class EndpointClient:
                     yield payload
                 elif kind == "final":
                     if seq is not None and seq != expected_seq:
+                        failed = True
+                        breakers.record_failure(iid)
                         raise StreamIncompleteError(
                             "Stream ended before generation completed "
                             f"(final after #{expected_seq} of {seq} frames)")
                     return
                 elif kind == "err":
                     if payload == "incomplete":
+                        failed = True
+                        breakers.record_failure(iid)
                         raise StreamIncompleteError()
                     from dynamo_tpu.runtime.errors import (
-                        InvalidRequestError, OverloadedError)
+                        InvalidRequestError, RateLimitedError)
                     # Wire-typed errors: decode every class that carries
                     # a WIRE_PREFIX so HTTP status / retry semantics
                     # survive remote deployment. One explicit branch per
@@ -327,17 +369,43 @@ class EndpointClient:
                     # references stay in sync with runtime/errors.py.
                     if isinstance(payload, str):
                         if payload.startswith(InvalidRequestError.WIRE_PREFIX):
+                            # The caller's fault, not the worker's: no
+                            # breaker signal.
                             raise InvalidRequestError(
                                 payload[len(InvalidRequestError.WIRE_PREFIX):])
+                        if payload.startswith(RateLimitedError.WIRE_PREFIX):
+                            raise RateLimitedError(
+                                payload[len(RateLimitedError.WIRE_PREFIX):])
                         if payload.startswith(OverloadedError.WIRE_PREFIX):
+                            # Saturated worker: a breaker failure signal
+                            # so selection steers away while it drains.
+                            failed = True
+                            breakers.record_failure(iid)
                             raise OverloadedError(
                                 payload[len(OverloadedError.WIRE_PREFIX):])
+                        if payload == "killed":
+                            # Client-initiated kill echoed back: not a
+                            # worker-health signal.
+                            raise EngineError(payload)
+                    failed = True
+                    breakers.record_failure(iid)
                     raise EngineError(payload)
                 else:  # lost
+                    failed = True
+                    breakers.record_failure(iid)
                     raise StreamIncompleteError(
                         "Stream ended before generation completed "
                         f"(connection to {instance.instance_id:x} lost)")
         finally:
+            # Breaker outcome: a stream that delivered frames and saw no
+            # failure counts as a success even when the consumer
+            # abandons the generator early (HTTP pipelines break on
+            # finish_reason without draining the final frame). Latency
+            # sample = time to FIRST frame (the TTFT analogue): total
+            # stream time scales with max_tokens, the client's choice,
+            # not the worker's health.
+            if not failed and first_latency is not None:
+                breakers.record_success(iid, first_latency)
             stop_t.cancel()
             conn.close_stream(rid)
 

@@ -11,6 +11,8 @@ import dataclasses
 import os
 from typing import Any
 
+from dynamo_tpu.runtime.overload import OverloadConfig
+
 try:  # tomllib is stdlib from 3.11; fall back to tomli, else TOML-less.
     import tomllib
 except ModuleNotFoundError:  # pragma: no cover - depends on interpreter
@@ -41,6 +43,23 @@ def _env_int(name: str, default: int) -> int:
 def _env_float(name: str, default: float) -> float:
     raw = _env(name)
     return default if raw is None else float(raw)
+
+
+def _apply_overload_env(ov: OverloadConfig) -> None:
+    """Generic DTPU_OVERLOAD_<FIELD> override: OverloadConfig is all
+    plain bool/int/float scalars, so the mapping is mechanical."""
+    for field in dataclasses.fields(OverloadConfig):
+        raw = _env("OVERLOAD_" + field.name.upper())
+        if raw is None:
+            continue
+        current = getattr(ov, field.name)
+        if isinstance(current, bool):
+            value: Any = raw.strip().lower() in ("1", "true", "yes", "on")
+        elif isinstance(current, int):
+            value = int(raw)
+        else:
+            value = float(raw)
+        setattr(ov, field.name, value)
 
 
 @dataclasses.dataclass
@@ -90,6 +109,12 @@ class RuntimeConfig:
     # disables.
     stream_idle_timeout_s: float = 300.0
 
+    # Overload defense (runtime/overload.py): adaptive admission,
+    # deadline-aware shedding, per-worker circuit breakers, brownout.
+    # TOML: an [overload] table; env: DTPU_OVERLOAD_<FIELD>.
+    overload: OverloadConfig = dataclasses.field(
+        default_factory=OverloadConfig)
+
     @classmethod
     def from_settings(cls, path: str | None = None) -> "RuntimeConfig":
         """defaults <- TOML (DTPU_CONFIG_PATH or ``path``) <- DTPU_* env."""
@@ -104,7 +129,10 @@ class RuntimeConfig:
                 data: dict[str, Any] = tomllib.load(fh)
             for field in dataclasses.fields(cls):
                 if field.name in data:
-                    setattr(cfg, field.name, data[field.name])
+                    value = data[field.name]
+                    if field.name == "overload" and isinstance(value, dict):
+                        value = OverloadConfig(**value)
+                    setattr(cfg, field.name, value)
         cfg.coordinator_url = _env("COORDINATOR_URL", cfg.coordinator_url)
         cfg.static_mode = _env_bool("STATIC_MODE", cfg.static_mode)
         cfg.namespace = _env("NAMESPACE", cfg.namespace)
@@ -118,6 +146,7 @@ class RuntimeConfig:
         cfg.retire_drain_s = _env_float("RETIRE_DRAIN_S", cfg.retire_drain_s)
         cfg.stream_idle_timeout_s = _env_float(
             "STREAM_IDLE_TIMEOUT_S", cfg.stream_idle_timeout_s)
+        _apply_overload_env(cfg.overload)
         return cfg
 
     @property

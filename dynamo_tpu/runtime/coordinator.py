@@ -66,6 +66,11 @@ class _Conn:
         self.watches: dict[int, str] = {}  # wid -> prefix
         self.subs: dict[int, str] = {}  # sid -> pattern
         self.closed = False
+        # Control-plane writer queue: producers are coordinator-local
+        # event fan-out (watch/pubsub deltas, no user payload
+        # amplification); bounding would make kv_put on one slow peer
+        # block every other peer's watch delivery.
+        # dtpu: ignore[unbounded-queue] -- see above
         self._outbox: asyncio.Queue = asyncio.Queue()
         self._writer_task = asyncio.create_task(self._write_loop())
 

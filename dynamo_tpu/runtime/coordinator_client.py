@@ -31,6 +31,10 @@ class WatchStream:
         self.watch_id = watch_id
         self.snapshot = snapshot
         self.prefix = prefix
+        # Watch deltas must never be dropped (a lost DELETE strands a
+        # dead instance in discovery forever); volume is bounded by
+        # actual cluster-state churn, not request traffic.
+        # dtpu: ignore[unbounded-queue] -- lossless-by-contract control stream
         self.events: asyncio.Queue[dict] = asyncio.Queue()
         # Keys this watch has reported as present — lets a reconnect
         # synthesize DELETE events for keys that vanished with the old
@@ -87,6 +91,9 @@ class Subscription:
         self._client = client
         self.sub_id = sub_id
         self.subject = subject
+        # Control-plane pubsub: volume bounded by cluster churn
+        # (KV events/metrics), not user traffic.
+        # dtpu: ignore[unbounded-queue] -- see above
         self.messages: asyncio.Queue[dict] = asyncio.Queue()
 
     def __aiter__(self) -> AsyncIterator[dict]:

@@ -1,8 +1,19 @@
-"""Shared runtime error types."""
+"""Shared runtime error types.
+
+Shedding/transport errors carry a ``retryable`` class attribute — the
+wire-level retryable/non-retryable split the overload defense maps to
+HTTP: retryable capacity errors become 503 (+ Retry-After, try
+elsewhere/later), non-retryable client-pacing rejections become 429
+(the same request won't succeed without the client slowing down or
+extending its deadline).
+"""
 
 
 class EngineError(RuntimeError):
     """Error raised by an engine/handler, propagated through response streams."""
+
+    #: Whether retrying the same request (elsewhere or later) can succeed.
+    retryable = False
 
 
 class StreamIncompleteError(EngineError):
@@ -11,6 +22,8 @@ class StreamIncompleteError(EngineError):
     this condition (reference lib/llm/src/migration.rs:26 — matches on
     'Stream ended before generation completed')."""
 
+    retryable = True
+
     def __init__(self, message: str = "Stream ended before generation completed"):
         super().__init__(message)
 
@@ -18,15 +31,43 @@ class StreamIncompleteError(EngineError):
 class NoInstancesError(EngineError):
     """No live instances are registered for the target endpoint."""
 
+    retryable = True
+
 
 class OverloadedError(EngineError):
-    """All workers busy (reference: router 503 busy_threshold path).
-    Maps to HTTP 503 at the frontend so the router can retry elsewhere;
-    workers mark it on the wire with an 'overloaded: ' prefix so the
-    class — and therefore the 503/retry semantics — survive the request
-    plane in distributed deployments."""
+    """Capacity rejection: all workers busy, admission queue full, or a
+    projected-SLA gate fired (reference: router 503 busy_threshold path).
+    Maps to HTTP 503 + Retry-After at the frontend so the client (or an
+    upstream router) retries elsewhere/later; workers mark it on the
+    wire with an 'overloaded: ' prefix so the class — and therefore the
+    503/retry semantics — survive the request plane in distributed
+    deployments."""
 
     WIRE_PREFIX = "overloaded: "
+    retryable = True
+
+    def __init__(self, message: str = "overloaded",
+                 retry_after_s: float | None = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class RateLimitedError(EngineError):
+    """Client-pacing rejection (deadline infeasible under the admission
+    projection, deadline expired while queued, or batch traffic shed
+    under brownout). Maps to HTTP 429 with ``error.type="rate_limited"``
+    and Retry-After: unlike OverloadedError this is NOT retryable as-is —
+    the same request with the same deadline/priority fails again until
+    the client paces down. Wire-prefixed so the class survives the
+    request plane."""
+
+    WIRE_PREFIX = "rate_limited: "
+    retryable = False
+
+    def __init__(self, message: str = "rate limited",
+                 retry_after_s: float | None = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 class InvalidRequestError(EngineError):

@@ -145,6 +145,26 @@ class _Bound:
         absent series)."""
         self._resolve(**labels)
 
+    def collect(self) -> dict[tuple[str, ...], float]:
+        """Snapshot every instantiated child of this metric at THIS
+        hierarchy position: extra-label value tuple -> current value.
+        Counters/gauges only (histograms: use get() per label set).
+        Lets callers enumerate label combinations they didn't create —
+        e.g. summing shed_total across every (reason, priority) to
+        assert zero silent drops."""
+        out: dict[tuple[str, ...], float] = {}
+        children = getattr(self._metric, "_metrics", {})
+        n_hier = len(self._hier)
+        for labelvalues, child in list(children.items()):
+            if tuple(labelvalues[:n_hier]) != self._hier:
+                continue
+            if not hasattr(child, "_value"):
+                raise TypeError(
+                    f"collect() unsupported for "
+                    f"{type(self._metric).__name__}")
+            out[tuple(labelvalues[n_hier:])] = child._value.get()
+        return out
+
     def get(self, **labels):
         """Current value: float for counters/gauges, HistogramValue
         (count, total) for histograms. Raises TypeError for metric types

@@ -18,7 +18,8 @@ from typing import Any, AsyncIterator, Callable
 
 from dynamo_tpu.runtime.component import Endpoint, Instance
 from dynamo_tpu.runtime.context import Context
-from dynamo_tpu.runtime.errors import InvalidRequestError, OverloadedError
+from dynamo_tpu.runtime.errors import (InvalidRequestError, OverloadedError,
+                                       RateLimitedError)
 from dynamo_tpu.runtime.frame import read_frame, write_frame
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.runtime.tracing import span
@@ -191,6 +192,15 @@ class EndpointServer:
             try:
                 await send({"t": "err", "rid": rid,
                             "e": f"{OverloadedError.WIRE_PREFIX}{exc}"})
+            except (ConnectionError, OSError):
+                pass
+        except RateLimitedError as exc:
+            # Client-pacing rejection (deadline/priority shed): typed so
+            # a remote frontend answers 429, not 500.
+            self._m_errors.inc()
+            try:
+                await send({"t": "err", "rid": rid,
+                            "e": f"{RateLimitedError.WIRE_PREFIX}{exc}"})
             except (ConnectionError, OSError):
                 pass
         except GeneratorExit:

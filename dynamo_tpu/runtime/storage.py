@@ -63,6 +63,9 @@ class LocalWatch:
         self.snapshot = snapshot
         self.prefix = prefix
         self.known_keys = {item["k"] for item in snapshot}
+        # Watch deltas are lossless by contract; volume is bounded by
+        # store churn, not request traffic.
+        # dtpu: ignore[unbounded-queue] -- see above
         self.events: asyncio.Queue[dict] = asyncio.Queue()
         self._on_cancel = on_cancel
         self._cancelled = False

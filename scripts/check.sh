@@ -15,6 +15,13 @@ echo "== chaos smoke (seeded fault injection, docs/RESILIENCE.md) =="
 timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
 
+echo "== overload smoke (deterministic limiter/breaker unit matrix) =="
+# Fake-clock-driven AIMD/deadline/priority/breaker units: no sleeps, no
+# network — fails in seconds when shedding or breaker semantics drift.
+timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest tests/test_overload.py \
+    -q -m 'not slow' -k 'unit' -p no:cacheprovider -p no:xdist \
+    -p no:randomly || exit 1
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

@@ -256,6 +256,53 @@ def test_unbounded_wait_suppression(tmp_path):
     assert run_rule(tmp_path, "unbounded-wait", src) == []
 
 
+# -- unbounded-queue ----------------------------------------------------------
+
+UNBOUNDED_QUEUE_BAD = """\
+import asyncio
+
+class Conn:
+    def __init__(self):
+        self.inbox = asyncio.Queue()
+        self.replies: asyncio.Queue = asyncio.Queue(maxsize=0)
+        self.ordered = asyncio.PriorityQueue()
+"""
+
+UNBOUNDED_QUEUE_GOOD = """\
+import asyncio, queue
+
+class Conn:
+    def __init__(self):
+        self.inbox = asyncio.Queue(maxsize=128)
+        self.replies = asyncio.Queue(64)
+        self.thread_q = queue.Queue()   # thread queues are out of scope
+"""
+
+
+def test_unbounded_queue_fires(tmp_path):
+    found = run_rule(tmp_path, "unbounded-queue", UNBOUNDED_QUEUE_BAD)
+    assert len(found) == 3
+    assert all("without maxsize" in f.message for f in found)
+
+
+def test_unbounded_queue_quiet_on_good(tmp_path):
+    assert run_rule(tmp_path, "unbounded-queue", UNBOUNDED_QUEUE_GOOD) == []
+
+
+def test_unbounded_queue_exempts_test_code(tmp_path):
+    assert run_rule(tmp_path, "unbounded-queue", UNBOUNDED_QUEUE_BAD,
+                    name="test_snippet.py") == []
+    assert run_rule(tmp_path, "unbounded-queue", UNBOUNDED_QUEUE_BAD,
+                    name="tests/helper.py") == []
+
+
+def test_unbounded_queue_suppression(tmp_path):
+    src = ("import asyncio\n"
+           "# dtpu: ignore[unbounded-queue] -- one item per in-flight req\n"
+           "q = asyncio.Queue()\n")
+    assert run_rule(tmp_path, "unbounded-queue", src) == []
+
+
 # -- jit-recompile-hazard -----------------------------------------------------
 
 JIT_BAD = """\
@@ -474,8 +521,8 @@ def test_default_rules_catalog():
     ids = {r.rule_id for r in default_rules()}
     assert ids == {"blocking-call-in-async", "fire-and-forget-task",
                    "lock-across-await", "swallowed-cancellation",
-                   "unbounded-wait", "jit-recompile-hazard",
-                   "wire-error-taxonomy"}
+                   "unbounded-queue", "unbounded-wait",
+                   "jit-recompile-hazard", "wire-error-taxonomy"}
 
 
 def test_unparseable_file_reports_parse_error(tmp_path):

@@ -17,6 +17,8 @@ from dynamo_tpu.llm.tokenizer import make_test_tokenizer
 from dynamo_tpu.runtime.config import RuntimeConfig
 from dynamo_tpu.runtime.coordinator import Coordinator
 from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.errors import OverloadedError
+from dynamo_tpu.runtime.overload import AdaptiveLimiter, OverloadConfig
 
 
 async def start_stack(migration_limit=0):
@@ -136,6 +138,110 @@ async def test_chat_completion_non_streaming_and_models_and_errors():
             async with session.get(f"{base}/metrics") as resp:
                 body = await resp.text()
                 assert "dynamo_tpu_http_requests_total" in body
+    finally:
+        await stop_stack(*stack)
+
+
+@async_test
+async def test_overload_status_split_and_retry_after():
+    """HTTP status mapping for the overload defense: client-pacing
+    rejections (deadline infeasible, batch/priority shed) -> 429 with
+    error.type="rate_limited"; capacity rejections (queue full, engine
+    OverloadedError) -> 503 "overloaded". Every shed carries
+    Retry-After; a malformed deadline header is the caller's bug (400)."""
+    stack = await start_stack()
+    coord, worker_rt, frontend_rt, server, watcher, service = stack
+    try:
+        url = f"http://127.0.0.1:{service.port}/v1/chat/completions"
+        body = {"model": "echo-model", "max_tokens": 2,
+                "messages": [{"role": "user", "content": "x"}]}
+        async with aiohttp.ClientSession() as session:
+            # -- capacity: bounded queue full -> 503 "overloaded" ---------
+            service.overload = AdaptiveLimiter(OverloadConfig(
+                initial_concurrency=1, queue_depth=0))
+            held = await service.overload.admit()
+            async with session.post(url, json=body) as resp:
+                assert resp.status == 503
+                err = (await resp.json())["error"]
+                assert err["type"] == "overloaded"
+                assert int(resp.headers["Retry-After"]) >= 1
+            # -- pacing: infeasible deadline -> 429 "rate_limited" --------
+            service.overload = AdaptiveLimiter(OverloadConfig(
+                initial_concurrency=1, queue_depth=4))
+            service.overload.avg_service_s = 2.0  # calibrated projection
+            held2 = await service.overload.admit()
+            async with session.post(
+                    url, json=body,
+                    headers={"x-request-deadline-ms": "10"}) as resp:
+                assert resp.status == 429
+                err = (await resp.json())["error"]
+                assert err["type"] == "rate_limited"
+                assert "deadline" in err["message"]
+                assert int(resp.headers["Retry-After"]) >= 1
+            # -- pacing: batch sheds under brownout -> 429 ----------------
+            service.overload = AdaptiveLimiter(OverloadConfig(
+                initial_concurrency=1, queue_depth=4, batch_shed_level=1,
+                level1_pressure=0.9))
+            held3 = await service.overload.admit()
+            async with session.post(
+                    url, json=body,
+                    headers={"x-priority": "batch"}) as resp:
+                assert resp.status == 429
+                assert (await resp.json())["error"]["type"] == "rate_limited"
+                assert "Retry-After" in resp.headers
+            for permit in (held, held2, held3):
+                permit.release()
+            # -- malformed overload headers are 400, not silent defaults --
+            async with session.post(
+                    url, json=body,
+                    headers={"x-request-deadline-ms": "soon"}) as resp:
+                assert resp.status == 400
+            async with session.post(
+                    url, json=body,
+                    headers={"x-priority": "urgent"}) as resp:
+                assert resp.status == 400
+            # -- feasible deadline + free capacity: serves normally -------
+            async with session.post(
+                    url, json=body,
+                    headers={"x-request-deadline-ms": "30000",
+                             "x-priority": "interactive"}) as resp:
+                assert resp.status == 200
+            # -- engine capacity rejection (wire taxonomy) -> 503 ---------
+            service.overload = None
+            served = service.manager.get("echo-model")
+            orig_generate = served.preprocessor.generate
+
+            def rejecting(req, ctx):
+                raise OverloadedError("engine saturated", retry_after_s=2.5)
+
+            served.preprocessor.generate = rejecting
+            async with session.post(url, json=body) as resp:
+                assert resp.status == 503
+                assert (await resp.json())["error"]["type"] == "overloaded"
+                # Retry-After honors the error's own hint (ceil 2.5 -> 3).
+                assert resp.headers["Retry-After"] == "3"
+            served.preprocessor.generate = orig_generate
+    finally:
+        await stop_stack(*stack)
+
+
+@async_test
+async def test_overload_brownout_header_reports_degraded_service():
+    """Admitted-but-degraded responses carry X-Overload-Brownout."""
+    stack = await start_stack()
+    coord, worker_rt, frontend_rt, server, watcher, service = stack
+    try:
+        service.overload = AdaptiveLimiter(OverloadConfig(
+            initial_concurrency=2, queue_depth=4, level1_pressure=0.4))
+        held = await service.overload.admit()  # pressure 0.5 -> level >= 1
+        url = f"http://127.0.0.1:{service.port}/v1/chat/completions"
+        async with aiohttp.ClientSession() as session:
+            async with session.post(url, json={
+                "model": "echo-model", "max_tokens": 2,
+                "messages": [{"role": "user", "content": "y"}]}) as resp:
+                assert resp.status == 200
+                assert int(resp.headers["X-Overload-Brownout"]) >= 1
+        held.release()
     finally:
         await stop_stack(*stack)
 

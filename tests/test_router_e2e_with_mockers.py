@@ -151,10 +151,19 @@ async def test_busy_threshold_returns_503():
             if m and m.kv_stats.kv_active_blocks / max(1, m.kv_stats.kv_total_blocks) >= 0.5:
                 break
             await asyncio.sleep(0.02)
-        status, body = await post_chat(service.port, "another " * 50,
-                                       max_tokens=5)
-        assert status == 503, body
-        assert body["error"]["type"] == "overloaded"
+        # The router-side OverloadedError must arrive at the HTTP client
+        # as a full 503 contract: status, typed error body, Retry-After.
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                f"http://127.0.0.1:{service.port}/v1/chat/completions",
+                json={"model": "mock-model", "max_tokens": 5,
+                      "messages": [{"role": "user",
+                                    "content": "another " * 50}]}) as resp:
+                status, body = resp.status, await resp.json()
+                assert status == 503, body
+                assert body["error"]["type"] == "overloaded"
+                assert "busy threshold" in body["error"]["message"]
+                assert int(resp.headers["Retry-After"]) >= 1
         hog.cancel()
     finally:
         await service.stop()
