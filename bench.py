@@ -41,6 +41,12 @@ ISL = int(os.environ.get("BENCH_ISL", "128"))
 OSL = int(os.environ.get("BENCH_OSL", "128"))
 BATCH = int(os.environ.get("BENCH_BATCH", "40"))
 ROUNDS = int(os.environ.get("BENCH_ROUNDS", "3"))
+# Mixed-workload mode (BENCH_MIXED=1 or --mode mixed): long prompts
+# arriving mid-steady-decode; the headline is the steady decoders'
+# itl_gap_p99 DURING prefill interference (stall-free chunked prefill,
+# docs/PERF_NOTES.md "Stall-free prefill").
+LONG_ISL = int(os.environ.get("BENCH_LONG_ISL", "4096"))
+LONG_N = int(os.environ.get("BENCH_LONG_N", "4"))
 # HBM bandwidth lives in ModelSpec.weight_read_step_ms (env DTPU_HBM_GBPS,
 # default v5e 819 GB/s) so bench, auto-window sizing, and profiling agree.
 
@@ -100,6 +106,83 @@ async def run_round(engine, spec, rng, tag, batch=BATCH, osl=OSL):
     }
 
 
+async def run_mixed(engine, spec, rng):
+    """Steady decoders + LONG_N long prompts injected mid-decode.
+
+    Returns the steady decoders' inter-burst gap p99 split into the
+    interference window (first long submitted -> last long's first
+    token) vs outside it, plus the longs' TTFTs. With stall-free
+    chunked prefill the two p99s should be within ~one chunk's compute;
+    the pre-rework engine stalled every decoder for the WHOLE long
+    prompt (one gap >= full prefill per long)."""
+    from dynamo_tpu.llm.protocols import PreprocessedRequest
+    from dynamo_tpu.runtime.context import Context
+
+    window = {"t0": None, "t1": None}
+    first_tokens = asyncio.Event()
+    started = 0
+
+    async def steady(i):
+        nonlocal started
+        prompt = rng.integers(0, spec.vocab_size, size=ISL).tolist()
+        req = PreprocessedRequest(model="bench", token_ids=prompt)
+        req.stop_conditions.max_tokens = OSL
+        req.stop_conditions.ignore_eos = True
+        arrivals = []
+        async for out in engine.generate(req, Context()):
+            n = len(out.get("token_ids", []))
+            if n:
+                arrivals.append((time.monotonic(), n))
+                if len(arrivals) == 1:
+                    started += 1
+                    if started >= BATCH:
+                        first_tokens.set()
+            if out.get("finish_reason"):
+                break
+        return arrivals
+
+    async def long_one(i):
+        prompt = rng.integers(0, spec.vocab_size, size=LONG_ISL).tolist()
+        req = PreprocessedRequest(model="bench", token_ids=prompt)
+        req.stop_conditions.max_tokens = 8
+        req.stop_conditions.ignore_eos = True
+        t_submit = time.monotonic()
+        t_first = None
+        async for out in engine.generate(req, Context()):
+            if out.get("token_ids") and t_first is None:
+                t_first = time.monotonic()
+            if out.get("finish_reason"):
+                break
+        return t_submit, t_first
+
+    steady_tasks = [asyncio.ensure_future(steady(i)) for i in range(BATCH)]
+    await first_tokens.wait()
+    window["t0"] = time.monotonic()
+    long_results = await asyncio.gather(
+        *[long_one(i) for i in range(LONG_N)])
+    window["t1"] = max(t for _, t in long_results)
+    steady_results = await asyncio.gather(*steady_tasks)
+    gaps_in, gaps_out = [], []
+    for arrivals in steady_results:
+        for (t_prev, _), (t_cur, n_cur) in zip(arrivals, arrivals[1:]):
+            gap = t_cur - t_prev
+            bucket = (gaps_in if window["t0"] <= t_cur <= window["t1"]
+                      else gaps_out)
+            bucket.append(gap)
+            bucket.extend([0.0] * (n_cur - 1))
+    ttfts = [t1 - t0 for t0, t1 in long_results]
+    p99 = lambda xs: 1e3 * float(np.percentile(xs, 99)) if xs else 0.0
+    return {
+        "itl_gap_p99_ms_during_prefill": p99(gaps_in),
+        "itl_gap_p99_ms_steady": p99(gaps_out),
+        "itl_gap_max_ms_during_prefill":
+            1e3 * max(gaps_in) if gaps_in else 0.0,
+        "long_ttft_p50_ms": 1e3 * float(np.percentile(ttfts, 50)),
+        "long_ttft_max_ms": 1e3 * max(ttfts),
+        "interference_window_s": window["t1"] - window["t0"],
+    }
+
+
 async def main_async(mode: str = "serve"):
     import jax
 
@@ -117,14 +200,27 @@ async def main_async(mode: str = "serve"):
         spec = dataclasses.replace(spec, quant=quant)
     page = 16
     maxp = 64  # up to 1024 tokens/seq
+    seqs = BATCH
+    if mode == "mixed":
+        # Long prompts need room (LONG_ISL + outputs), and the longs ride
+        # ALONGSIDE the steady batch. Page budget: steady seqs at their
+        # full length + the longs at theirs.
+        maxp = max(maxp, -(-(LONG_ISL + 64) // page))
+        seqs = BATCH + LONG_N
+    steady_pages = BATCH * (-(-(ISL + OSL) // page))
     config = EngineConfig(
-        model=spec, page_size=page, num_pages=BATCH * maxp + 16,
-        max_pages_per_seq=maxp, max_num_seqs=BATCH,
+        model=spec, page_size=page,
+        num_pages=(steady_pages + LONG_N * maxp + 16 if mode == "mixed"
+                   else BATCH * 64 + 16),
+        max_pages_per_seq=maxp, max_num_seqs=seqs,
         prefill_buckets=(128, 256, 512, 1024),
         max_prefill_tokens=int(os.environ.get("BENCH_MAX_PREFILL", "1024")),
         attention_backend=os.environ.get("BENCH_ATTN", "auto"),
         decode_window=int(os.environ.get("BENCH_WINDOW", "32")),
-        pipeline_depth=int(os.environ.get("BENCH_DEPTH", "4")))
+        pipeline_depth=int(os.environ.get("BENCH_DEPTH", "4")),
+        prefill_chunk_tokens=os.environ.get("BENCH_CHUNK_TOKENS", "auto")
+        if not os.environ.get("BENCH_CHUNK_TOKENS", "auto").isdigit()
+        else int(os.environ["BENCH_CHUNK_TOKENS"]))
     engine = TPUEngine(config)
     engine.start()
     rng = np.random.default_rng(0)
@@ -152,6 +248,46 @@ async def main_async(mode: str = "serve"):
                                          "(stability; 1.0 = no outliers)",
                 "rounds": [round(BATCH * ISL / e, 1) for e in by_el],
                 "ttft_p99_ms": round(med_round["ttft_p99_ms"], 1),
+                "platform": jax.devices()[0].platform,
+                "device": str(jax.devices()[0]),
+            },
+        }))
+        return
+
+    if mode == "mixed":
+        # Warm every bucket incl. the chunk/history variants, then run
+        # the interference rounds; the headline is the steady decoders'
+        # gap p99 DURING long-prompt prefill.
+        await run_round(engine, spec, rng, "warmup", batch=4, osl=8)
+        warm = await run_mixed(engine, spec, rng)  # compiles long path
+        rounds_m = [await run_mixed(engine, spec, rng)
+                    for _ in range(max(1, ROUNDS))]
+        med = sorted(rounds_m,
+                     key=lambda r: r["itl_gap_p99_ms_during_prefill"])[
+                         len(rounds_m) // 2]
+        engine.stop()
+        steady_p99 = med["itl_gap_p99_ms_steady"]
+        during_p99 = med["itl_gap_p99_ms_during_prefill"]
+        print(json.dumps({
+            "metric": f"mixed_itl_gap_p99_ms_during_prefill_{spec.name}"
+                      f"_bs{BATCH}_long{LONG_ISL}x{LONG_N}",
+            "value": round(during_p99, 3),
+            "unit": "ms",
+            # 1.0 = stall-free ideal (interference-window gap p99 equals
+            # the steady-state gap p99); the pre-rework engine stalled
+            # decoders for the whole long prefill.
+            "vs_baseline": round(steady_p99 / during_p99, 3)
+            if during_p99 else 0.0,
+            "detail": {
+                "vs_baseline_semantics": "steady gap p99 / during-prefill "
+                                         "gap p99 (1.0 = no decode stall "
+                                         "from long-prompt prefill)",
+                "rounds": [
+                    {k: round(v, 3) for k, v in r.items()}
+                    for r in rounds_m],
+                "warmup_round": {k: round(v, 3) for k, v in warm.items()},
+                "prefill_chunk_tokens": engine.prefill_chunk_tokens,
+                "decode_window": config.decode_window,
                 "platform": jax.devices()[0].platform,
                 "device": str(jax.devices()[0]),
             },
@@ -237,12 +373,17 @@ async def main_async(mode: str = "serve"):
 def main() -> None:
     import argparse
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--mode", choices=("serve", "prefill"),
+    ap.add_argument("--mode", choices=("serve", "prefill", "mixed"),
                     default=os.environ.get("BENCH_MODE", "serve"),
                     help="serve: full continuous-batching bench (default); "
                          "prefill: disagg prefill-worker pattern "
-                         "(max_tokens=1 bursts, headline = prefill tok/s)")
+                         "(max_tokens=1 bursts, headline = prefill tok/s); "
+                         "mixed: long prompts injected mid-steady-decode "
+                         "(headline = decode itl_gap_p99 during prefill "
+                         "interference; also BENCH_MIXED=1)")
     args = ap.parse_args()
+    if os.environ.get("BENCH_MIXED") == "1":
+        args.mode = "mixed"
     asyncio.run(main_async(args.mode))
     # Hard-exit after the JSON line: interpreter teardown races the
     # tunnel client's destructor and prints a harmless-but-ugly Rust

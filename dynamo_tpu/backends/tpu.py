@@ -79,6 +79,19 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument("--pipeline-depth", type=int, default=4,
                         help="decode windows in flight before the host "
                              "blocks on the oldest readback")
+    parser.add_argument("--prefill-chunk-tokens", default="auto",
+                        type=_chunk_arg,
+                        help="stall-free chunked prefill: prompt tokens "
+                             "dispatched as prefill chunks per engine-loop "
+                             "iteration before the next decode window; "
+                             "'auto' sizes one chunk to ~one "
+                             "DTPU_WINDOW_TARGET_MS window period "
+                             "(DTPU_PREFILL_CHUNK_TOKENS overrides)")
+    parser.add_argument("--warmup-prefill-ladder", action="store_true",
+                        help="pre-compile EVERY prefill bucket incl. the "
+                             "with-history chunk variants at startup, so "
+                             "the first long prompt never pays per-bucket "
+                             "XLA compiles while decode slots wait")
     parser.add_argument("--attention-backend", default="auto",
                         choices=["auto", "pallas", "xla"])
     parser.add_argument("--quant", default=None, choices=["int8"],
@@ -185,7 +198,10 @@ def build_engine_config(args) -> EngineConfig:
         attention_backend=args.attention_backend,
         decode_window=_window_arg(getattr(args, "decode_window", "auto")),
         pipeline_depth=getattr(args, "pipeline_depth", 4),
+        prefill_chunk_tokens=_chunk_arg(
+            getattr(args, "prefill_chunk_tokens", "auto")),
         warmup_windows=True,
+        warmup_prefill_ladder=getattr(args, "warmup_prefill_ladder", False),
         host_cache_pages=args.host_cache_pages,
         kv_disk_cache_dir=args.kv_disk_cache_dir,
         spec_decode=getattr(args, "spec_decode", None),
@@ -204,6 +220,16 @@ def _window_arg(value) -> int | str:
     n = int(value)
     if n < 1:
         raise ValueError(f"decode window must be >= 1, got {n}")
+    return n
+
+
+def _chunk_arg(value) -> int | str:
+    """argparse type for --prefill-chunk-tokens: positive int or 'auto'."""
+    if value == "auto":
+        return value
+    n = int(value)
+    if n < 1:
+        raise ValueError(f"prefill chunk tokens must be >= 1, got {n}")
     return n
 
 

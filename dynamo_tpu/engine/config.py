@@ -174,6 +174,22 @@ class EngineConfig:
     # compile on first use). Workers enable this; tests skip it to keep
     # CPU suites fast.
     warmup_windows: bool = False
+    # Extend warmup to the FULL prefill-bucket ladder including the
+    # with-history (chunk) program variants. Without it the first long
+    # prompt pays seconds of XLA compile per new bucket while every live
+    # decode slot waits (the BENCH_r05 13.7 s TTFT-p99 outlier round).
+    # Off by default so small-RAM CPU runs keep warmup cheap; serving
+    # workers opt in (--warmup-prefill-ladder).
+    warmup_prefill_ladder: bool = False
+    # Stall-free chunked prefill (engine scheduler): per engine-loop
+    # iteration at most this many prompt tokens are dispatched as prefill
+    # chunks before the next decode window, so decode ITL interference
+    # from a long prompt is bounded by ~one chunk's compute instead of
+    # the whole prompt. "auto" derives the budget from the same
+    # DTPU_WINDOW_TARGET_MS model as decode_window="auto" (one chunk ~
+    # one window period). Env DTPU_PREFILL_CHUNK_TOKENS overrides either
+    # form (docs/PERF_NOTES.md "Stall-free prefill").
+    prefill_chunk_tokens: int | str = "auto"
     # Windows in flight before the host blocks on the oldest readback.
     # Each dispatch/readback pays a host<->device round trip (~100 ms
     # through a tunneled chip, ~100 us locally); depth D overlaps D of
@@ -275,6 +291,39 @@ class EngineConfig:
         raw = target_ms / step_ms
         nice = (1, 2, 4, 8, 12, 16, 24, 32, 48, 64)
         return min(nice, key=lambda m: abs(m - raw))
+
+    def resolve_prefill_chunk_tokens(self) -> int:
+        """Resolve ``prefill_chunk_tokens="auto"`` to a concrete budget.
+
+        Cost model: a prefill chunk of n tokens costs ~max(1, n/knee)
+        weight-read periods — below the knee the chunk is bandwidth-bound
+        (one weight read regardless of n), above it compute-bound (linear
+        in n). knee ~= the chip's flops/byte ratio (~240 for v5e bf16);
+        DTPU_PREFILL_KNEE_TOK overrides per part. The budget is sized so
+        one iteration's chunk work costs about one DTPU_WINDOW_TARGET_MS
+        window period, then rounded DOWN to a prefill bucket (chunks pad
+        to bucket shapes, so a between-buckets budget would pad up and
+        overshoot the target)."""
+        val = self.prefill_chunk_tokens
+        env = os.environ.get("DTPU_PREFILL_CHUNK_TOKENS")
+        if env:
+            val = env if env.strip() == "auto" else int(env)
+        if not isinstance(val, str):
+            if val < 1:
+                raise ValueError(
+                    f"prefill_chunk_tokens must be >= 1, got {val}")
+            return max(self.page_size, int(val))
+        if val != "auto":
+            raise ValueError(
+                f"prefill_chunk_tokens must be an int or 'auto', "
+                f"got {val!r}")
+        target_ms = float(os.environ.get("DTPU_WINDOW_TARGET_MS", "75"))
+        step_ms = self.model.weight_read_step_ms(self.tp, self.pp)
+        knee = float(os.environ.get("DTPU_PREFILL_KNEE_TOK", "256"))
+        raw = int(knee * max(1.0, target_ms / max(step_ms, 1e-6)))
+        raw = min(raw, self.max_prefill_tokens, self.prefill_buckets[-1])
+        fit = [b for b in self.prefill_buckets if b <= raw]
+        return max(self.page_size, fit[-1] if fit else raw)
 
     @property
     def max_model_len(self) -> int:

@@ -43,7 +43,8 @@ from dynamo_tpu.llm.tokens import TokenBlockSequence
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.engine import AsyncEngine
 from dynamo_tpu.runtime.logging import current_trace, get_logger
-from dynamo_tpu.runtime.tracing import get_recorder, phase_metrics
+from dynamo_tpu.runtime.tracing import (_LATENCY_BUCKETS, get_recorder,
+                                        phase_metrics)
 
 log = get_logger("tpu_engine")
 
@@ -85,6 +86,14 @@ class _Request:
     # Queue-wait observed for the current stint (reset on requeue so a
     # preempted request's second wait records too).
     wait_noted: bool = False
+    # Stall-free chunked prefill: while True the request owns a slot and
+    # pages but is still being prefilled by SCHEDULED chunk dispatches
+    # (decode windows never touch the slot). prefill_pos is the next
+    # prompt position to dispatch; prefill_t0 anchors the end-to-end
+    # prefill phase (admission -> first-token readback).
+    prefilling: bool = False
+    prefill_pos: int = 0
+    prefill_t0: float = 0.0
 
     def push(self, item) -> None:
         self.loop.call_soon_threadsafe(self.out_q.put_nowait, item)
@@ -117,6 +126,7 @@ class TPUEngine(AsyncEngine):
         self.phase = (phase_metrics(metrics_registry)
                       if metrics_registry is not None else None)
         self.decode_window = config.resolve_decode_window()
+        self.prefill_chunk_tokens = config.resolve_prefill_chunk_tokens()
         self.runner = ModelRunner(config, params=params, devices=devices)
         self.allocator = PageAllocator(self.runner.num_pages, config.page_size)
         # KV tiering (G2 host DRAM + optional G3 disk): HBM evictions are
@@ -204,6 +214,34 @@ class TPUEngine(AsyncEngine):
         # flight: (serial of the newest dispatched window at free time,
         # pages). Released once that window has been processed.
         self._pending_release: list[tuple[int, list[int]]] = []
+        # Stall-free chunked prefill: requests whose long prompts are
+        # scheduled as interleaved chunk work (oldest-first fair share of
+        # prefill_chunk_tokens per loop iteration), and the chunk
+        # programs dispatched but not yet observed complete (bounded by
+        # pipeline_depth like decode windows).
+        self._prefilling: list[_Request] = []
+        self._chunk_inflight: collections.deque[dict] = collections.deque()
+        self.chunk_tokens_total = 0     # prompt tokens dispatched as chunks
+        self.chunk_dispatch_count = 0   # chunk programs dispatched
+        self.decode_stall_max_s = 0.0   # widest observed dispatch gap
+        self._last_decode_dispatch: float | None = None
+        self.m_chunk_tokens = self.m_chunks_inflight = None
+        self.m_decode_stall = None
+        if metrics_registry is not None:
+            self.m_chunk_tokens = metrics_registry.counter(
+                "prefill_chunk_tokens_total",
+                "Prompt tokens dispatched as scheduled prefill chunks")
+            self.m_chunks_inflight = metrics_registry.gauge(
+                "prefill_chunks_inflight",
+                "Prefill chunk programs dispatched but not yet retired")
+            self.m_decode_stall = metrics_registry.histogram(
+                "decode_stall_seconds",
+                "Gap between consecutive decode-window dispatches while "
+                "decode slots are active",
+                buckets=_LATENCY_BUCKETS)
+            for bound in (self.m_chunk_tokens, self.m_chunks_inflight,
+                          self.m_decode_stall):
+                bound.ensure()
         self._running = False
         self._thread: threading.Thread | None = None
         self._publish_loop: asyncio.AbstractEventLoop | None = None
@@ -352,7 +390,15 @@ class TPUEngine(AsyncEngine):
     def estimated_ttft_ms(self, extra_tokens: int = 0) -> float | None:
         """Projected TTFT for a hypothetical arrival, from the measured
         prefill rate and the cold-token backlog. None until the first
-        prefill has calibrated the rate."""
+        prefill has calibrated the rate.
+
+        Chunked-prefill backlog is included: a long prompt's cold tokens
+        stay in the ledger from admission until its FINAL chunk's
+        first-token readback, and the rate EWMA is sampled over that same
+        end-to-end interval — so the interleaved decode windows the
+        chunk scheduler inserts are priced into the projection, and the
+        frontend's deadline shedding / brownout (runtime/overload.py)
+        sees long prompts at their true cost."""
         if not self.prefill_rate_tok_s:
             return None
         return ((self._cold_inflight + self._waiting_cold + extra_tokens)
@@ -614,6 +660,7 @@ class TPUEngine(AsyncEngine):
             self.runner.prefill_batch([seq])
             log.info("warmed prefill bucket %d in %.1fs", bucket,
                      time.monotonic() - t0)
+            self._warmup_prefill_ladder()
             return
         outs = self.runner.decode_window(packed, self.decode_window)
         np.asarray(outs[0])  # force compile + execute
@@ -643,6 +690,34 @@ class TPUEngine(AsyncEngine):
         self.runner.prefill_batch([seq])  # slots=None blocks until done
         log.info("warmed prefill bucket %d in %.1fs", bucket,
                  time.monotonic() - t0)
+        self._warmup_prefill_ladder()
+
+    def _warmup_prefill_ladder(self) -> None:
+        """Pre-compile EVERY prefill bucket, with and without history
+        (config.warmup_prefill_ladder): larger buckets otherwise compile
+        on first use — the first long prompt then pays seconds of XLA
+        compile per bucket while every live decode slot waits (the
+        BENCH_r05 13.7 s TTFT-p99 outlier round). Warmup rows are inert:
+        zero tokens, all writes to the reserved scratch page 0. jit
+        COMPILATION blocks the caller, so each call here really pays
+        (and logs) its compile; the inert executions drain async."""
+        if not self.config.warmup_prefill_ladder:
+            return
+        page = self.config.page_size
+        for bucket in self.config.prefill_buckets:
+            for with_h in (False, True):
+                t0 = time.monotonic()
+                seq = PrefillSeq(
+                    tokens=np.zeros(bucket, np.int32),
+                    start_pos=page if with_h else 0,
+                    chunk_pages=np.zeros(1, np.int32),
+                    hist_pages=(np.zeros(1, np.int32) if with_h
+                                else None),
+                    sampling=(0.0, 0, 1.0))
+                self.runner.prefill_batch([seq], fetch=False)
+                log.info("warmed prefill bucket %d%s in %.1fs", bucket,
+                         " +history" if with_h else "",
+                         time.monotonic() - t0)
 
     def _engine_loop(self) -> None:
         log.info("engine loop starting (slots=%d pages=%d window=%d)",
@@ -658,20 +733,35 @@ class TPUEngine(AsyncEngine):
             self._run_jobs()
             self._resolve_ready_first()
             self._resolve_spills()
+            self._retire_chunks()
             try:
                 admitted = self._admit()
             except Exception:  # noqa: BLE001
                 log.exception("admission failed")
                 admitted = False
-            have_active = any(r is not None for r in self.slot_req)
+            # Stall-free chunked prefill: at most prefill_chunk_tokens of
+            # chunk work BEFORE the decode window, so a long prompt's
+            # interference with live decode slots is bounded by ~one
+            # chunk's compute per window instead of the whole prompt.
+            chunk_dispatched = self._dispatch_prefill_chunks()
+            have_active = any(r is not None and not r.prefilling
+                              for r in self.slot_req)
             dispatched = False
             if have_active and len(self._inflight) < depth:
+                now = time.monotonic()
+                if self._last_decode_dispatch is not None:
+                    gap = now - self._last_decode_dispatch
+                    self.decode_stall_max_s = max(self.decode_stall_max_s,
+                                                  gap)
+                    if self.m_decode_stall is not None:
+                        self.m_decode_stall.observe(gap)
+                self._last_decode_dispatch = now
                 try:
                     window = self._dispatch_window()
                 except Exception as exc:  # noqa: BLE001 — fail all, keep serving
                     log.exception("decode window dispatch failed")
                     for i, r in enumerate(self.slot_req):
-                        if r is not None:
+                        if r is not None and not r.prefilling:
                             r.push(RuntimeError(f"engine step failed: {exc}"))
                             self._finish_slot(i, register=False)
                 else:
@@ -682,6 +772,8 @@ class TPUEngine(AsyncEngine):
                     else:
                         self._inflight.append(window)
                         dispatched = True
+            elif not have_active:
+                self._last_decode_dispatch = None
             # Process the oldest window once the pipe is full (or drain it
             # when nothing new can be dispatched).
             if self._inflight and (len(self._inflight) >= depth
@@ -690,13 +782,19 @@ class TPUEngine(AsyncEngine):
                 self.step_count += 1
                 self._publish()
             self._release_ready_pages()
-            if not self._inflight and not admitted and not have_active:
-                self._resolve_spills(force=True)
-                time.sleep(0.002)  # fully idle
-            elif not self._inflight and self._pending_first:
+            if self._inflight or chunk_dispatched:
+                continue  # device busy; windows/chunks pace the loop
+            if not have_active and self._chunk_inflight:
+                # Prefill-only phase at full chunk depth: block on the
+                # oldest chunk program instead of spinning.
+                self._retire_chunks(block=True)
+            elif self._pending_first:
                 # Nothing left on the device but first tokens unfetched
                 # (e.g. a lone max_tokens=1 request): block on them now.
                 self._resolve_ready_first(force=True)
+            elif not admitted and not have_active and not self._prefilling:
+                self._resolve_spills(force=True)
+                time.sleep(0.002)  # fully idle
 
     # -- KV tiering (G2/G3 offload + onboard) ---------------------------------
     def _on_evict(self, block_hash: int, page: int) -> None:
@@ -868,7 +966,9 @@ class TPUEngine(AsyncEngine):
                                 r.ctx.span_id, t0, t1,
                                 attrs={"prompt_tokens":
                                        len(r.req.token_ids),
-                                       "reuse_tokens": r.reuse_tokens})
+                                       "reuse_tokens": r.reuse_tokens,
+                                       "chunked": bool(
+                                           entry.get("chunked"))})
         for row, r, slot, epoch in entry["rows"]:
             if self.slot_req[slot] is not r or r.epoch != epoch:
                 continue  # slot reassigned (failure path already notified)
@@ -986,32 +1086,22 @@ class TPUEngine(AsyncEngine):
                 break
             slot = free_slots.pop(0)
             if plan == "chunked":
-                cold = len(r.tokens_all) - r.reuse_tokens
-                self._cold_inflight += cold
-                t0 = time.monotonic()
-                try:
-                    self._prefill_chunked(r, slot)
-                    # Success only: a fast FAILURE would sample an
-                    # absurd tok/s and poison the admission projection.
-                    self._prefill_rate_sample(cold,
-                                              time.monotonic() - t0)
-                    if self.phase is not None:
-                        self.phase.prefill.observe(time.monotonic() - t0)
-                    if self._recorder.enabled:
-                        self._recorder.add(
-                            "engine.prefill", r.ctx.trace_id,
-                            r.ctx.span_id, t0, time.monotonic(),
-                            attrs={"prompt_tokens": len(r.req.token_ids),
-                                   "reuse_tokens": r.reuse_tokens,
-                                   "chunked": True})
-                except Exception as exc:  # noqa: BLE001
-                    log.exception("chunked prefill failed")
-                    self.allocator.release(r.pages)
-                    r.pages = []
-                    r.push(RuntimeError(f"prefill failed: {exc}"))
-                    free_slots.insert(0, slot)
-                finally:
-                    self._cold_inflight -= cold
+                # Stall-free chunked prefill: the long prompt becomes
+                # SCHEDULED chunk work interleaved with decode windows
+                # (_dispatch_prefill_chunks) instead of a blocking loop.
+                # The slot and all pages are held now; decode windows
+                # skip the slot until the final chunk places it.
+                r.cold_tokens = len(r.tokens_all) - r.reuse_tokens
+                self._cold_inflight += r.cold_tokens
+                r.prefilling = True
+                r.prefill_pos = r.reuse_tokens
+                r.prefill_t0 = time.monotonic()
+                r.slot = slot
+                self.slot_req[slot] = r
+                self.disp_positions[slot] = 0
+                self.disp_seq_lens[slot] = 0
+                self.overrides.pop(slot, None)
+                self._prefilling.append(r)
                 continue
             r.cold_tokens = len(r.tokens_all) - r.reuse_tokens
             self._cold_inflight += r.cold_tokens
@@ -1193,75 +1283,205 @@ class TPUEngine(AsyncEngine):
             penalties=self._penalties_of(r), seed=self._seed_of(r),
             embeds=emb, embeds_mask=mask)
 
-    def _prefill_chunked(self, r: _Request, slot: int) -> None:
-        """Long prompt: prefill in page-aligned chunks with history."""
-        token = self._prefill_chunked_token(r)
-        if self.runner.hist_dev is not None:
-            self.runner.seed_history([
-                (slot, np.asarray(r.tokens_all, np.int32), 0, True,
-                 token)])
-        lp_out = None
-        if r.req.sampling_options.logprobs is not None:
-            lg = np.asarray(self.runner.last_prefill_logits[0], np.float32)
-            lp_out = self._host_logprobs(lg, token,
-                                         r.req.sampling_options.logprobs)
-        self._place_in_slot(r, slot, token, lp_out)
+    # -- stall-free chunked prefill -------------------------------------------
+    def _chunk_seq(self, r: _Request, start: int, n: int,
+                   final: bool) -> PrefillSeq:
+        """One chunk row of ``r``'s prompt at [start, start+n). Penalty/
+        seed/logprob state matters only for the FINAL chunk — earlier
+        chunks' sampled tokens are discarded, so they take the cheapest
+        (greedy, common-variant) program."""
+        page = self.config.page_size
+        first_page = start // page
+        chunk_pages = np.asarray(
+            r.pages[first_page:first_page + (-(-n // page))], np.int32)
+        hist = np.asarray(r.pages[:first_page], np.int32)
+        emb = emb_mask = None
+        if r.mm_buf is not None:
+            full_emb, full_mask = r.mm_buf
+            sl = full_mask[start:start + n]
+            if sl.any():
+                emb, emb_mask = full_emb[start:start + n], sl
+        tokens = np.asarray(r.tokens_all[start:start + n], np.int32)
+        if not final:
+            return PrefillSeq(
+                tokens=tokens, start_pos=start, chunk_pages=chunk_pages,
+                hist_pages=hist if len(hist) else None,
+                sampling=(0.0, 0, 1.0), embeds=emb, embeds_mask=emb_mask)
+        return PrefillSeq(
+            tokens=tokens, start_pos=start, chunk_pages=chunk_pages,
+            hist_pages=hist if len(hist) else None,
+            sampling=self._sampling_of(r),
+            logprobs=r.req.sampling_options.logprobs is not None,
+            penalties=self._penalties_of(r), seed=self._seed_of(r),
+            embeds=emb, embeds_mask=emb_mask)
 
-    @staticmethod
-    def _host_logprobs(logits_row: np.ndarray, token: int,
-                       k: int) -> tuple[list, list]:
-        """Host-side logprobs for sync prefill paths (chunked prompts)."""
-        lg = logits_row.astype(np.float64)
-        m = float(lg.max())
-        lse = m + float(np.log(np.exp(lg - m).sum()))
-        alts = []
-        if k > 0:
-            idx = np.argpartition(-lg, k)[:k]
-            idx = idx[np.argsort(-lg[idx])]
-            alts = [{"token_id": int(t), "logprob": float(lg[t] - lse)}
-                    for t in idx]
-        return [float(lg[token] - lse)], [alts]
+    def _dispatch_prefill_chunks(self) -> bool:
+        """One scheduling pass over the prefilling requests: dispatch at
+        most ``prefill_chunk_tokens`` of chunk work, shared fairly
+        oldest-first (each request's slice rounds down to page alignment
+        — non-final chunks must end on a page boundary). Chunk programs
+        in flight are bounded by pipeline_depth, like decode windows.
+        Returns True when anything was dispatched. ENGINE THREAD."""
+        if not self._prefilling:
+            return False
+        page = self.config.page_size
+        depth = max(1, self.config.pipeline_depth)
+        max_chunk = min(self.config.max_prefill_tokens,
+                        self.config.prefill_buckets[-1])
+        budget = self.prefill_chunk_tokens
+        dispatched = False
+        queue_snap = sorted(self._prefilling, key=lambda x: x.enqueue_t)
+        for idx, r in enumerate(queue_snap):
+            if budget < page or len(self._chunk_inflight) >= depth:
+                break
+            if r.ctx.is_killed or r.ctx.is_stopped:
+                self._abort_prefilling(r, finish=FinishReason.CANCELLED)
+                continue
+            share = max(page, budget // (len(queue_snap) - idx))
+            remaining = len(r.tokens_all) - r.prefill_pos
+            n = min(share, max_chunk, remaining)
+            final = n >= remaining
+            if not final:
+                n = (n // page) * page
+                if n <= 0:
+                    continue
+            try:
+                self._dispatch_one_chunk(r, n, final)
+            except Exception as exc:  # noqa: BLE001
+                log.exception("chunk prefill dispatch failed")
+                self._abort_prefilling(r, error=exc)
+                continue
+            budget -= n
+            dispatched = True
+        if self.m_chunks_inflight is not None:
+            self.m_chunks_inflight.set(len(self._chunk_inflight))
+        return dispatched
+
+    def _dispatch_one_chunk(self, r: _Request, n: int, final: bool) -> None:
+        start = r.prefill_pos
+        seq = self._chunk_seq(r, start, n, final)
+        t0 = time.monotonic()
+        if not final:
+            # Intermediate chunk: KV state chains ON DEVICE; no host
+            # readback of any kind (not even an async copy).
+            arr = self.runner.prefill_chunk_async(seq)
+            self._chunk_inflight.append(
+                {"arr": arr, "r": r, "tokens": n, "t0": t0, "start": start})
+            r.prefill_pos = start + n
+            self._note_chunk_dispatch(n)
+            return
+        # Final chunk: a 1-row batched prefill — the sampled first token
+        # is scattered into tokens_dev[slot] on device (decode windows
+        # chain from it with no override) and its host value resolves
+        # asynchronously through the _pending_first machinery.
+        pen = self._penalties_of(r)
+        rows = self._count_row_of(r)[None] if any(pen) else None
+        slot = r.slot
+        handle = self.runner.prefill_batch([seq], slots=[slot],
+                                           count_rows=rows)
+        self._place_in_slot_pending(r, slot)
+        if self.runner.hist_dev is not None:
+            # Spec decode: seed the on-device draft history with the full
+            # accumulated tokens; the chained first token rides from
+            # tokens_dev (dispatched after the scatter above).
+            self.runner.seed_history([
+                (slot, np.asarray(r.tokens_all, np.int32), 0, True, None)])
+        self._prefilling.remove(r)
+        r.prefilling = False
+        r.prefill_pos = start + n
+        self._pending_first.append({
+            "handle": handle, "rows": [(0, r, slot, r.epoch)],
+            "cold": r.cold_tokens, "t0": r.prefill_t0, "chunked": True})
+        self._note_chunk_dispatch(n)
+
+    def _note_chunk_dispatch(self, n: int) -> None:
+        self.chunk_tokens_total += n
+        self.chunk_dispatch_count += 1
+        if self.m_chunk_tokens is not None:
+            self.m_chunk_tokens.inc(n)
+
+    def _retire_chunks(self, block: bool = False) -> None:
+        """Pop completed chunk programs off the in-flight deque (oldest
+        first; they complete in dispatch order) and record their spans.
+        With ``block``, wait for the oldest — the prefill-only phase's
+        pacing when the pipeline is full. ENGINE THREAD."""
+        while self._chunk_inflight:
+            entry = self._chunk_inflight[0]
+            arr = entry["arr"]
+            if not getattr(arr, "is_ready", lambda: True)():
+                if not block:
+                    break
+                try:
+                    arr.block_until_ready()
+                except Exception:  # noqa: BLE001 — surfaces at final fetch
+                    pass
+                block = False  # only ever block on the oldest
+            self._chunk_inflight.popleft()
+            r = entry["r"]
+            if self._recorder.enabled:
+                self._recorder.add(
+                    "prefill.chunk", r.ctx.trace_id, r.ctx.span_id,
+                    entry["t0"], time.monotonic(),
+                    attrs={"tokens": entry["tokens"],
+                           "start": entry["start"]})
+        if self.m_chunks_inflight is not None:
+            self.m_chunks_inflight.set(len(self._chunk_inflight))
+
+    def _abort_prefilling(self, r: _Request,
+                          finish: FinishReason | None = None,
+                          error: Exception | None = None) -> None:
+        """Terminate a request mid-chunked-prefill (cancellation or a
+        dispatch failure): the cold ledger is squared, the slot and pages
+        free (deferred past in-flight device work), and the stream is
+        closed with the finish reason or error. Chunk pages were never
+        registered, so the prefix cache needs no scrub."""
+        if r in self._prefilling:
+            self._prefilling.remove(r)
+        r.prefilling = False
+        self._cold_inflight -= r.cold_tokens
+        r.cold_tokens = 0
+        if error is not None:
+            r.push(RuntimeError(f"prefill failed: {error}"))
+        else:
+            r.push(LLMEngineOutput(
+                token_ids=[],
+                finish_reason=finish or FinishReason.CANCELLED).to_wire())
+        self._finish_slot(r.slot, register=True)
+
+    def _preempt_prefilling(self, r: _Request) -> None:
+        """KV-pressure victim while still prefilling: drop the remaining
+        chunk plan and requeue the whole request (recompute semantics —
+        seeded draws are position-stable, so the retry's tokens are
+        identical to an uninterrupted run)."""
+        self._prefilling.remove(r)
+        r.prefilling = False
+        self._cold_inflight -= r.cold_tokens
+        r.cold_tokens = 0
+        self._requeue_slot(r.slot)
 
     def _prefill_chunked_token(self, r: _Request) -> int:
+        """SYNCHRONOUS chunked prefill for the disagg extract path (runs
+        as an engine-thread job between windows). Chunks are dispatched
+        back-to-back with NO per-chunk host readback — only the final
+        chunk's sampled token is fetched, one blocking round trip total.
+        The serving path never comes here; it schedules chunks through
+        _dispatch_prefill_chunks instead."""
         cfg = self.config
-        page = cfg.page_size
         prompt = r.tokens_all
-        pages = r.pages
         start = r.reuse_tokens  # cached prefix pinned by the plan
         max_chunk = min(cfg.max_prefill_tokens, cfg.prefill_buckets[-1])
-        first_token = None
         while start < len(prompt):
             n = min(max_chunk, len(prompt) - start)
-            chunk_tokens = np.asarray(prompt[start:start + n], np.int32)
-            first_page = start // page
-            chunk_pages = np.asarray(
-                pages[first_page:first_page + (-(-n // page))], np.int32)
-            hist = np.asarray(pages[:first_page], np.int32)
-            # Penalty state matters only for the FINAL chunk: earlier
-            # chunks' sampled tokens are discarded, so don't pay the
-            # [vocab] row build / penalized program / multihost publish
-            # for them.
             final = start + n >= len(prompt)
-            pen = self._penalties_of(r)
-            emb = emb_mask = None
-            if r.mm_buf is not None:
-                full_emb, full_mask = r.mm_buf
-                sl = full_mask[start:start + n]
-                if sl.any():
-                    emb, emb_mask = full_emb[start:start + n], sl
-            token, _ = self.runner.prefill(
-                chunk_tokens, start, chunk_pages,
-                hist if len(hist) else None, self._sampling_of(r),
-                penalties=pen,
-                count_row=self._count_row_of(r)
-                if final and any(pen) else None,
-                seed=self._seed_of(r) if final else None,
-                embeds=emb, embeds_mask=emb_mask)
+            seq = self._chunk_seq(r, start, n, final)
+            if final:
+                pen = self._penalties_of(r)
+                rows = self._count_row_of(r)[None] if any(pen) else None
+                return int(self.runner.prefill_batch(
+                    [seq], count_rows=rows)[0])
+            self.runner.prefill_chunk_async(seq)
             start += n
-            if start >= len(prompt):
-                first_token = token
-        assert first_token is not None
-        return first_token
+        raise AssertionError("chunked plan with no chunks")
 
     def _sampling_of(self, r: _Request) -> tuple[float, int, float]:
         s = r.req.sampling_options
@@ -1370,7 +1590,11 @@ class TPUEngine(AsyncEngine):
         satisfied: set[int] = set()
         deficits: dict[int, int] = {}
         needed_max = 1
-        live = [i for i, r in enumerate(self.slot_req) if r is not None]
+        # Prefilling slots are invisible to the decode window: they have
+        # no token chain yet, and their pages were fully allocated at
+        # admission (chunk work never allocates mid-flight).
+        live = [i for i, r in enumerate(self.slot_req)
+                if r is not None and not r.prefilling]
         n_live = len(live)
         # Allocate pages oldest-request-first (requeued requests keep their
         # original enqueue time, so they age past new arrivals — no
@@ -1403,7 +1627,8 @@ class TPUEngine(AsyncEngine):
                 r.pages.extend(new)
             if not ok:
                 pending = sum(len(p) for _, p in self._pending_release)
-                if (n_live == 1 and needed - len(r.pages)
+                if (n_live == 1 and not self._prefilling
+                        and needed - len(r.pages)
                         > self.allocator.num_free + pending):
                     # Only live slot and the pool — even counting pages
                     # queued for release behind in-flight windows — is
@@ -1439,6 +1664,18 @@ class TPUEngine(AsyncEngine):
                 stalled.discard(j)
                 frozen[j] = (r_j, r_j.epoch, "requeue")
                 freed += len(r_j.pages)
+            if freed < want:
+                # Decode victims alone can't cover the deficit: preempt
+                # PREFILLING requests youngest-first (their chunk work is
+                # recomputable, and prefix-cache hits make the re-prefill
+                # cheap). Immediate — no in-flight window carries tokens
+                # for a prefilling slot.
+                for rp in sorted(self._prefilling,
+                                 key=lambda x: x.enqueue_t, reverse=True):
+                    if freed >= want:
+                        break
+                    freed += len(rp.pages)
+                    self._preempt_prefilling(rp)
         active_rows = [i for i in live if i not in frozen
                        and i not in stalled and i not in satisfied]
         # A slot frozen at a PREVIOUS dispatch that this dispatch decided

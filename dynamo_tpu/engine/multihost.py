@@ -169,13 +169,21 @@ class LeaderRunner:
         fut, self._prev_fut = self._prev_fut, None
         return fut
 
-    def prefill_batch(self, seqs, slots=None, count_rows=None):
+    def prefill_batch(self, seqs, slots=None, count_rows=None, fetch=True):
         self._publish({"m": "prefill_batch",
                        "seqs": [_pack_seq(s) for s in seqs],
                        "slots": None if slots is None
                        else [int(x) for x in slots],
                        "count_rows": _pack_array(count_rows)})
-        return self._inner.prefill_batch(seqs, slots, count_rows)
+        return self._inner.prefill_batch(seqs, slots, count_rows,
+                                         fetch=fetch)
+
+    def prefill_chunk_async(self, seq):
+        """Stall-free chunked prefill: followers replay the chunk
+        dispatch for its collectives; nobody fetches (the sampled token
+        is discarded on every process)."""
+        self._publish({"m": "prefill_chunk", "seq": _pack_seq(seq)})
+        return self._inner.prefill_chunk_async(seq)
 
     def set_count_rows(self, slots, rows):
         self._publish({"m": "set_count_rows",
@@ -322,6 +330,11 @@ async def run_follower(config, client, group: str, node_rank: int,
                                    s.hist_pages, s.sampling, s.penalties,
                                    _unpack_array(msg.get("count_row")),
                                    s.seed, s.embeds, s.embeds_mask)
+                elif m == "prefill_chunk":
+                    # Intermediate prefill chunk: dispatch-only on every
+                    # process (no fetch anywhere — the sampled token is
+                    # discarded; KV chains on device).
+                    runner.prefill_chunk_async(_unpack_seq(msg["seq"]))
                 elif m == "decode_window":
                     runner.decode_window(_unpack_array(msg["packed"]),
                                          msg["window"])

@@ -262,6 +262,10 @@ class ModelRunner:
                 (config.max_num_seqs,), jnp.int32,
                 NamedSharding(self.mesh, P()))
         self._seed_hist_cache: dict = {}
+        # Blocking prefill readbacks performed (slots=None fetch path).
+        # The scheduled chunk path must never bump this: intermediate
+        # chunks dispatch with no host readback at all (tests assert 0).
+        self.sync_prefill_fetches = 0
         # Per-slot generated-token counts [slots, vocab] for OpenAI
         # frequency/presence penalties (vLLM semantics: output tokens
         # only). uint8 with saturation at 255; read ONLY by the penalized
@@ -804,7 +808,8 @@ class ModelRunner:
     # -- public API (blocking; called from the engine thread) -----------------
     def prefill_batch(self, seqs: list[PrefillSeq],
                       slots: list[int] | None = None,
-                      count_rows: np.ndarray | None = None):
+                      count_rows: np.ndarray | None = None,
+                      fetch: bool = True):
         """Prefill a batch of chunks (same compiled program per
         (bucket, padded-batch, with_history) key).
 
@@ -918,7 +923,23 @@ class ModelRunner:
                     pass
             return {"tokens": sampled, "lp": lp, "top_v": top_v,
                     "top_i": top_i}
+        if not fetch:
+            # Dispatch-only (intermediate prefill chunks): the KV pages
+            # are written on device and the sampled token is discarded.
+            # Return the device array purely as a completion handle
+            # (is_ready pacing) — no host copy is even started.
+            return sampled
+        self.sync_prefill_fetches += 1
         return np.asarray(jax.device_get(sampled))[:len(seqs)]
+
+    def prefill_chunk_async(self, seq: PrefillSeq):
+        """Dispatch ONE intermediate prefill chunk with NO host readback
+        (the stall-free chunked-prefill path): device-stream order
+        guarantees the chunk's KV writes land before any later program
+        reads them as history, so nothing about the chunk needs to come
+        back to the host. Returns the sampled-token device array as a
+        completion handle only."""
+        return self.prefill_batch([seq], fetch=False)
 
     def prefill(self, tokens: np.ndarray, start_pos: int,
                 chunk_pages: np.ndarray, hist_pages: np.ndarray | None,

@@ -64,11 +64,18 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument("--sp", type=int, default=1)
     parser.add_argument("--attention-backend", default="auto",
                         choices=["auto", "pallas", "xla"])
-    from dynamo_tpu.backends.tpu import _window_arg
+    from dynamo_tpu.backends.tpu import _chunk_arg, _window_arg
     parser.add_argument("--decode-window", default="auto", type=_window_arg,
                         help="positive int or 'auto' (size from the model's "
                              "weight-read step estimate)")
     parser.add_argument("--pipeline-depth", type=int, default=4)
+    parser.add_argument("--prefill-chunk-tokens", default="auto",
+                        type=_chunk_arg,
+                        help="stall-free chunked prefill budget per "
+                             "engine-loop iteration (int or 'auto')")
+    parser.add_argument("--warmup-prefill-ladder", action="store_true",
+                        help="pre-compile every prefill bucket (incl. "
+                             "chunk/history variants) at startup")
     parser.add_argument("--host-cache-pages", type=int, default=0)
     parser.add_argument("--kv-disk-cache-dir", default=None)
     parser.add_argument("--coordinator-url", default=None,

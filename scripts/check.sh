@@ -22,6 +22,16 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest tests/test_overload.py 
     -q -m 'not slow' -k 'unit' -p no:cacheprovider -p no:xdist \
     -p no:randomly || exit 1
 
+echo "== chunked-prefill smoke (stall-free scheduling) =="
+# Tiny CPU model: one long prompt prefilling in chunks with concurrent
+# short decoders — asserts completion, decode windows interleaved between
+# every chunk dispatch (no engine-loop stall beyond one chunk budget),
+# and chunked/whole-prompt token parity.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_chunked_prefill.py -q -m 'not slow' \
+    -k 'decode_progresses or parity' \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
