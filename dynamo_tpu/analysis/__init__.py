@@ -19,6 +19,7 @@ from dynamo_tpu.analysis.rules_async import (
     BlockingCallInAsync, FireAndForgetTask, LockAcrossAwait,
     SwallowedCancellation, UnboundedQueue, UnboundedWait)
 from dynamo_tpu.analysis.rules_jax import JitRecompileHazard
+from dynamo_tpu.analysis.rules_metrics import DirectPrometheusImport
 from dynamo_tpu.analysis.rules_wire import WireErrorTaxonomy
 
 __all__ = [
@@ -34,6 +35,7 @@ DEFAULT_RULES: tuple[type[Rule], ...] = (
     UnboundedQueue,
     UnboundedWait,
     JitRecompileHazard,
+    DirectPrometheusImport,
     WireErrorTaxonomy,
 )
 

@@ -1,0 +1,55 @@
+"""direct-prometheus-import rule: every metric goes through the registry.
+
+``runtime/metrics.py`` is the single chokepoint where series get their
+hierarchy labels and where same-name/different-shape registrations fail
+fast with a ``ValueError`` (instead of prometheus_client's confusing
+labels() error at call time, far from the bug). A module that imports
+``prometheus_client`` directly bypasses all of that: its series skip the
+``dynamo_tpu_`` prefix convention, the hierarchy labels dashboards join
+on, and the label/name collision checks — and silently lands in the
+DEFAULT prometheus registry, which ``/metrics`` never serves. This rule
+makes the chokepoint a lint invariant: ``prometheus_client`` may only be
+imported by ``runtime/metrics.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from dynamo_tpu.analysis.core import Finding, Module, Rule
+
+_ALLOWED_SUFFIX = "runtime/metrics.py"
+_TARGET = "prometheus_client"
+
+
+class DirectPrometheusImport(Rule):
+    rule_id = "direct-prometheus-import"
+    description = ("prometheus_client may only be imported by "
+                   "runtime/metrics.py — every series must go through "
+                   "MetricsRegistry so it gets the dynamo_tpu_ prefix, "
+                   "hierarchy labels, name/label collision checks, and "
+                   "actually appears in /metrics exposition")
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        path = module.path.replace("\\", "/")
+        if path.endswith(_ALLOWED_SUFFIX):
+            return
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Import):
+                names = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom):
+                names = [node.module or ""]
+            else:
+                continue
+            for name in names:
+                if name == _TARGET or name.startswith(_TARGET + "."):
+                    yield self.finding(
+                        module, node,
+                        f"direct `{_TARGET}` import outside "
+                        "runtime/metrics.py: series created here bypass "
+                        "the registry's prefix/hierarchy-label/collision "
+                        "checks and never reach /metrics",
+                        "construct the metric through a MetricsRegistry "
+                        "node (runtime.metrics) instead")
+                    break

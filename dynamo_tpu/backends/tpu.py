@@ -485,6 +485,24 @@ async def run(args: argparse.Namespace) -> None:
                     # (mm_embeds spans must match the model hidden size).
                     extra={"hidden_size": engine_cfg.model.hidden_size}))
         engine.start()
+        # Observability plane (docs/OBSERVABILITY.md): flight-recorder
+        # bundle context for THIS worker, and the per-worker system
+        # status server (DTPU_SYSTEM_ENABLED=1) serving /metrics +
+        # /debug/{traces,slo,requests,flight} next to the engine.
+        import dataclasses as _dc
+
+        from dynamo_tpu.runtime import flight as _flight
+        from dynamo_tpu.runtime import slo as _slo
+        _flight.configure(metrics=runtime.metrics,
+                          config_fingerprint=_dc.asdict(cfg))
+        _slo.configure(cfg.slo, metrics=runtime.metrics).on_page(
+            _flight.on_slo_page)
+        status_server = None
+        if cfg.system_enabled:
+            from dynamo_tpu.runtime.health import SystemStatusServer
+            status_server = SystemStatusServer(runtime, host=cfg.bind_host,
+                                               port=cfg.system_port)
+            await status_server.start()
         print(f"TPU_WORKER_READY mode={args.mode} port={server.port} "
               f"worker={runtime.instance_id:x} pages={engine.runner.num_pages}",
               flush=True)
@@ -514,6 +532,8 @@ async def run(args: argparse.Namespace) -> None:
                 # followers exit with it.
                 pass
         await server.shutdown()
+        if status_server is not None:
+            await status_server.stop()
         if queue_worker is not None:
             await queue_worker.stop()
         if peer_watch_task is not None:

@@ -10,6 +10,9 @@ prints OK/WARN/FAIL per check. The TPU-native equivalent probes:
 - coordinator connectivity + KV/queue/pub-sub round-trips + latency
 - registered models and live endpoint instances (with TCP reachability)
 - an HTTP frontend, when given (``/health``, ``/v1/models``)
+- the observability plane on that frontend: ``/metrics`` exposition
+  (FAIL when unreachable), ``/debug/slo`` (WARN when no SLO targets are
+  configured), ``/debug/flight``, and tracing (WARN when disabled)
 
 Exit code 0 = no FAIL. Run: ``python -m dynamo_tpu.doctor
 [--coordinator-url tcp://...] [--frontend-url http://...]``.
@@ -181,6 +184,75 @@ async def check_frontend(rep: Report, url: str) -> None:
         rep.add(FAIL, "frontend", f"{url}: {exc}")
 
 
+async def check_observability(rep: Report, url: str) -> None:
+    """Probe the decision-grade observability surface on a frontend (or
+    a worker status server): metrics exposition, the SLO plane, and the
+    flight recorder. docs/OBSERVABILITY.md documents every endpoint."""
+    import os
+
+    import aiohttp
+    url = url.rstrip("/")
+    if os.environ.get("DTPU_TRACING", "1").strip().lower() in (
+            "0", "false", "no", "off"):
+        rep.add(WARN, "tracing env", "DTPU_TRACING=0: spans disabled in "
+                "processes launched from this environment")
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"{url}/metrics",
+                                   timeout=aiohttp.ClientTimeout(5)) as r:
+                body = await r.text()
+                series = sum(1 for line in body.splitlines()
+                             if line.startswith("dynamo_tpu_"))
+                rep.add(OK if r.status == 200 and series else FAIL,
+                        "metrics exposition",
+                        f"{series} dynamo_tpu_* sample lines"
+                        if r.status == 200 else f"HTTP {r.status}")
+            async with session.get(f"{url}/debug/slo",
+                                   timeout=aiohttp.ClientTimeout(5)) as r:
+                if r.status != 200:
+                    rep.add(FAIL, "/debug/slo", f"HTTP {r.status}")
+                else:
+                    slo = await r.json()
+                    targets = sorted(slo.get("targets") or {})
+                    if not slo.get("enabled") or not targets:
+                        rep.add(WARN, "/debug/slo",
+                                "no SLO targets configured (set "
+                                "DTPU_SLO_TTFT_P99_MS etc. or the [slo] "
+                                "TOML table): burn-rate alerting is off")
+                    else:
+                        level = (slo.get("pressure") or {}).get("level", 0)
+                        rep.add(OK, "/debug/slo",
+                                f"targets: {', '.join(targets)}; "
+                                f"pressure level {level}")
+            async with session.get(f"{url}/debug/flight",
+                                   timeout=aiohttp.ClientTimeout(5)) as r:
+                if r.status != 200:
+                    rep.add(FAIL, "/debug/flight", f"HTTP {r.status}")
+                else:
+                    fl = await r.json()
+                    meta = fl.get("meta") or {}
+                    rep.add(OK if meta.get("enabled") else WARN,
+                            "/debug/flight",
+                            f"{meta.get('records', 0)} windows recorded"
+                            if meta.get("enabled")
+                            else "flight recorder disabled "
+                            "(DTPU_FLIGHT_CAPACITY=0)")
+            async with session.get(f"{url}/debug/traces/recent",
+                                   timeout=aiohttp.ClientTimeout(5)) as r:
+                if r.status != 200:
+                    rep.add(FAIL, "/debug/traces", f"HTTP {r.status}")
+                else:
+                    idx = await r.json()
+                    rep.add(OK if idx.get("enabled") else WARN,
+                            "/debug/traces",
+                            f"{len(idx.get('traces') or [])} recent traces"
+                            if idx.get("enabled")
+                            else "tracing disabled (DTPU_TRACING=0) on "
+                            "the probed process")
+    except (aiohttp.ClientError, OSError, asyncio.TimeoutError) as exc:
+        rep.add(FAIL, "observability", f"{url}: {exc}")
+
+
 async def run(args) -> int:
     rep = Report()
     check_imports(rep)
@@ -193,6 +265,7 @@ async def run(args) -> int:
         rep.add(SKIP, "coordinator", "no --coordinator-url / DTPU_COORDINATOR_URL")
     if args.frontend_url:
         await check_frontend(rep, args.frontend_url)
+        await check_observability(rep, args.frontend_url)
     n_fail = sum(1 for s, _, _ in rep.rows if s == FAIL)
     print(f"doctor: {len(rep.rows)} checks, {n_fail} failures", flush=True)
     return 1 if rep.failed else 0

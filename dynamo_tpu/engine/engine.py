@@ -40,6 +40,7 @@ from dynamo_tpu.llm.kv_router.protocols import (ForwardPassMetrics, KvStats,
                                                 SpecDecodeStats, WorkerStats)
 from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput, PreprocessedRequest
 from dynamo_tpu.llm.tokens import TokenBlockSequence
+from dynamo_tpu.runtime import chaos, flight
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.engine import AsyncEngine
 from dynamo_tpu.runtime.logging import current_trace, get_logger
@@ -242,6 +243,12 @@ class TPUEngine(AsyncEngine):
             for bound in (self.m_chunk_tokens, self.m_chunks_inflight,
                           self.m_decode_stall):
                 bound.ensure()
+        # Flight recorder (runtime/flight.py): one compact row per
+        # processed decode window into the process-global ring; the
+        # deltas below turn cumulative counters into per-window values.
+        self._flight = flight.get_recorder()
+        self._flight_chunk_last = 0
+        self._flight_stall_last = 0.0
         self._running = False
         self._thread: threading.Thread | None = None
         self._publish_loop: asyncio.AbstractEventLoop | None = None
@@ -730,6 +737,14 @@ class TPUEngine(AsyncEngine):
                 log.exception("window warmup failed; compiling lazily")
         depth = max(1, self.config.pipeline_depth)
         while self._running:
+            if chaos.ACTIVE:
+                # Chaos site "engine": engine.stall_ms freezes the loop
+                # thread mid-iteration — the observable effect is a real
+                # decode-dispatch gap (decode_stall_seconds tail) which
+                # the flight-recorder anomaly trigger must catch.
+                stall = chaos.value("engine.stall_ms", "engine")
+                if stall is not None:
+                    time.sleep(stall / 1e3)
             self._run_jobs()
             self._resolve_ready_first()
             self._resolve_spills()
@@ -755,6 +770,13 @@ class TPUEngine(AsyncEngine):
                                                   gap)
                     if self.m_decode_stall is not None:
                         self.m_decode_stall.observe(gap)
+                    self._flight_stall_last = max(self._flight_stall_last,
+                                                  gap)
+                    if (flight.stall_threshold_s
+                            and gap >= flight.stall_threshold_s):
+                        # Decode-stall tail spike: freeze the flight ring
+                        # and capture a diagnostic bundle (throttled).
+                        flight.trigger(f"decode_stall_{gap:.2f}s")
                 self._last_decode_dispatch = now
                 try:
                     window = self._dispatch_window()
@@ -778,9 +800,11 @@ class TPUEngine(AsyncEngine):
             # when nothing new can be dispatched).
             if self._inflight and (len(self._inflight) >= depth
                                    or not dispatched):
-                self._do_process(self._inflight.popleft())
+                window = self._inflight.popleft()
+                self._do_process(window)
                 self.step_count += 1
                 self._publish()
+                self._note_flight(window)
             self._release_ready_pages()
             if self._inflight or chunk_dispatched:
                 continue  # device busy; windows/chunks pace the loop
@@ -1211,6 +1235,11 @@ class TPUEngine(AsyncEngine):
         cached_pages = cached_pages + extra_pages
         reuse_tokens += extra_tokens
         r.reuse_tokens = reuse_tokens
+        # Accounting attribution (in-process pipelines: the frontend's
+        # ctx IS this ctx, so the ledger record picks these up).
+        r.ctx.values["reuse_tokens"] = reuse_tokens
+        r.ctx.values["kv_hit_ratio"] = (
+            round(reuse_tokens / len(prompt), 4) if prompt else 0.0)
         total_prompt_pages = -(-len(prompt) // page)
         need = total_prompt_pages - len(cached_pages)
         new_pages = self.allocator.allocate(need)
@@ -2004,6 +2033,29 @@ class TPUEngine(AsyncEngine):
         self._queue_put(r)
 
     # -- metrics + events -----------------------------------------------------
+    def _note_flight(self, w: _Window) -> None:
+        """One flight-recorder row per processed decode window (engine
+        thread; the ring skips idle-stable windows itself)."""
+        fr = self._flight
+        if not fr.enabled:
+            return
+        now = time.monotonic()
+        chunk_total = self.chunk_tokens_total
+        accepted = fr.record(
+            now, now - w.t0 if w.t0 else 0.0,
+            sum(1 for r in self.slot_req if r is not None),
+            self.num_waiting, self.allocator.num_free,
+            chunk_total - self._flight_chunk_last,
+            len(self._chunk_inflight), self.preempt_count,
+            self.brownout_level, self._flight_stall_last,
+            self.step_count)
+        if accepted:
+            # A frozen ring (bundle capture in flight) rejects the row:
+            # keep accumulating so the stall/chunk deltas land in the
+            # first post-thaw record instead of vanishing.
+            self._flight_chunk_last = chunk_total
+            self._flight_stall_last = 0.0
+
     def _publish(self) -> None:
         loop = self._publish_loop
         if loop is None or loop.is_closed():

@@ -10,15 +10,32 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import dataclasses
 
 from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
 from dynamo_tpu.llm.http_service import HttpService
+from dynamo_tpu.llm.recorder import configure_ledger
+from dynamo_tpu.runtime import flight, slo
 from dynamo_tpu.runtime.config import RuntimeConfig
 from dynamo_tpu.runtime.distributed import DistributedRuntime
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.runtime.overload import AdaptiveLimiter
 
 log = get_logger("frontend")
+
+
+def init_observability(cfg: RuntimeConfig, runtime) -> None:
+    """Arm the SLO plane, the accounting ledger, and the flight
+    recorder's bundle context for this process (shared by the frontend
+    and launcher entrypoints)."""
+    plane = slo.configure(cfg.slo, metrics=runtime.metrics)
+    configure_ledger(cfg.slo.request_ring,
+                     cfg.slo.request_log_path or None)
+    flight.configure(metrics=runtime.metrics,
+                     config_fingerprint=dataclasses.asdict(cfg))
+    # A fast-burn SLO page freezes the flight ring and captures a
+    # diagnostic bundle (runtime/flight.py).
+    plane.on_page(flight.on_slo_page)
 
 
 def parse_args(argv=None) -> argparse.Namespace:
@@ -50,6 +67,24 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument("--default-deadline-ms", type=float, default=None,
                         help="server default when a request carries no "
                              "x-request-deadline-ms header")
+    # SLO plane (runtime/slo.py; docs/OBSERVABILITY.md "SLO plane"):
+    # targets default off; fine-grained knobs via DTPU_SLO_* / [slo] TOML.
+    parser.add_argument("--no-slo", action="store_true",
+                        help="disable the SLO plane (SLIs, burn-rate "
+                             "alerts, /debug/slo)")
+    parser.add_argument("--slo-ttft-p99-ms", type=float, default=None,
+                        help="TTFT target: 99%% of requests must reach "
+                             "their first token within this budget")
+    parser.add_argument("--slo-itl-p99-ms", type=float, default=None,
+                        help="ITL target: 99%% of inter-token gaps under "
+                             "this budget")
+    parser.add_argument("--slo-error-rate", type=float, default=None,
+                        help="availability target: max fraction of "
+                             "requests that may fail (e.g. 0.001)")
+    parser.add_argument("--request-log", default=None,
+                        help="append per-request accounting records as "
+                             "JSONL here (scripts/slo_report.py rolls "
+                             "them up)")
     parser.add_argument("--coordinator-url", default=None)
     parser.add_argument("--grpc-port", type=int, default=None,
                         help="also serve the KServe v2 gRPC inference "
@@ -94,6 +129,17 @@ async def run(args: argparse.Namespace) -> None:
         ov.default_deadline_ms = args.default_deadline_ms
     limiter = (AdaptiveLimiter(ov, metrics=runtime.metrics)
                if ov.enabled else None)
+    if args.no_slo:
+        cfg.slo.enabled = False
+    if args.slo_ttft_p99_ms is not None:
+        cfg.slo.ttft_p99_ms = args.slo_ttft_p99_ms
+    if args.slo_itl_p99_ms is not None:
+        cfg.slo.itl_p99_ms = args.slo_itl_p99_ms
+    if args.slo_error_rate is not None:
+        cfg.slo.error_rate = args.slo_error_rate
+    if args.request_log is not None:
+        cfg.slo.request_log_path = args.request_log
+    init_observability(cfg, runtime)
     service = HttpService(runtime, manager, args.http_host, args.http_port,
                           tls_cert_path=args.tls_cert_path,
                           tls_key_path=args.tls_key_path,

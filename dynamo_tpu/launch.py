@@ -92,6 +92,13 @@ def parse_args(argv=None) -> argparse.Namespace:
                         help="in=batch: max in-flight requests")
     parser.add_argument("--batch-max-tokens", type=int, default=128,
                         help="in=batch: default max_tokens per prompt")
+    # SLO plane + per-request accounting (runtime/slo.py,
+    # docs/OBSERVABILITY.md); fine-grained knobs via DTPU_SLO_*.
+    parser.add_argument("--slo-ttft-p99-ms", type=float, default=None,
+                        help="TTFT SLO target (99%% within this budget)")
+    parser.add_argument("--request-log", default=None,
+                        help="append per-request accounting records as "
+                             "JSONL here (scripts/slo_report.py)")
     args = parser.parse_args(rest)
     args.input = io["in"]
     args.output = io["out"]
@@ -272,6 +279,15 @@ async def run(args) -> None:
             args, runtime.metrics.namespace("local").component(args.output))
         manager.models[served.name] = served
         watcher = None
+    # SLO plane + accounting ledger + flight-bundle context: the static
+    # pipeline gets the same decision-grade observability the
+    # distributed frontend does (DTPU_SLO_* / [slo] TOML configurable).
+    from dynamo_tpu.frontend.main import init_observability
+    if args.slo_ttft_p99_ms is not None:
+        runtime.config.slo.ttft_p99_ms = args.slo_ttft_p99_ms
+    if args.request_log is not None:
+        runtime.config.slo.request_log_path = args.request_log
+    init_observability(runtime.config, runtime)
     try:
         if args.input in ("text", "batch"):
             if args.output == "dyn":

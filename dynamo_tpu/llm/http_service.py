@@ -26,6 +26,8 @@ from dynamo_tpu.llm.protocols import (
     CompletionRequest,
     usage_block,
 )
+from dynamo_tpu.llm.recorder import finish_account, make_account
+from dynamo_tpu.runtime import slo as slo_mod
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.errors import (InvalidRequestError, NoInstancesError,
                                        OverloadedError, RateLimitedError)
@@ -41,6 +43,8 @@ log = get_logger("http")
 DEADLINE_HEADER = "x-request-deadline-ms"
 PRIORITY_HEADER = "x-priority"
 BROWNOUT_HEADER = "X-Overload-Brownout"
+# Accounting: multi-tenant attribution for /debug/requests rollups.
+TENANT_HEADER = "x-tenant"
 
 
 def _response_object(full: dict, model: str, text: str | None) -> dict:
@@ -201,32 +205,54 @@ class HttpService:
                     "(positive milliseconds)")
         return priority, deadline_ms, None
 
-    async def _admit(self, request: web.Request, route: str):
+    async def _admit(self, request: web.Request, route: str, acct=None):
         """Run the overload-defense admission for one request. Returns
         (permit_ctx, response_headers, error_response): on a shed,
         error_response is the typed 429/503 (+ Retry-After) and the
-        caller returns it immediately."""
+        caller returns it immediately. ``acct`` (the accounting record)
+        picks up tenant/priority/deadline, the admission queue wait, and
+        — on a shed — the limiter's typed reason."""
+        if acct is not None:
+            acct["tenant"] = request.headers.get(TENANT_HEADER)
         null = contextlib.nullcontext()
         if self.overload is None:
             return null, {}, None
         priority, deadline_ms, bad = self._overload_params(request)
+        if acct is not None:
+            acct["priority"] = priority
+            acct["deadline_ms"] = deadline_ms
         if bad is not None:
             self._m_requests.inc(route=route, status="400")
+            if acct is not None:
+                acct.update(status="error", reason="bad_overload_header",
+                            http_status=400)
             return null, {}, bad
+        t0 = time.monotonic()
         try:
             permit = await self.overload.admit(priority, deadline_ms)
         except RateLimitedError as exc:
             self._m_requests.inc(route=route, status="429")
+            if acct is not None:
+                acct.update(status="shed", http_status=429,
+                            reason=getattr(exc, "shed_reason",
+                                           "rate_limited"))
             return null, {}, _error_body(
                 str(exc), "rate_limited", 429,
                 retry_after_s=self._retry_after(exc))
         except OverloadedError as exc:
             self._m_requests.inc(route=route, status="503")
+            if acct is not None:
+                acct.update(status="shed", http_status=503,
+                            reason=getattr(exc, "shed_reason", "overloaded"))
             return null, {}, _error_body(
                 str(exc), "overloaded", 503,
                 retry_after_s=self._retry_after(exc))
+        if acct is not None:
+            acct["queue_wait_s"] = time.monotonic() - t0
         headers = {}
         level = self.overload.pressure_level()
+        if acct is not None:
+            acct["brownout_level"] = level
         if level > 0:
             # Brownout reported in response metadata so clients can see
             # (and log) that they got degraded service.
@@ -243,16 +269,54 @@ class HttpService:
         if clamped is not None:
             req.max_tokens = clamped
 
-    @staticmethod
-    async def _timed_first(chunks: AsyncIterator[dict], permit,
-                           started: float) -> AsyncIterator[dict]:
+    async def _timed_first(self, chunks: AsyncIterator[dict], permit,
+                           started: float, acct: dict | None = None
+                           ) -> AsyncIterator[dict]:
         """Report time-to-first-chunk (the per-phase latency AIMD adapts
-        against) into the admission permit."""
+        against) into the admission permit — and, from the SAME timing
+        point, feed the SLO plane's TTFT/ITL SLIs and the accounting
+        record (TTFT, inter-chunk gaps, the usage block's token
+        counts)."""
+        plane = slo_mod.get_plane()
+        last_t = None
         async for chunk in chunks:
-            if permit is not None and hasattr(permit, "note_latency"):
-                permit.note_latency(time.monotonic() - started)
-                permit = None
+            now = time.monotonic()
+            if last_t is None:
+                ttft = now - started
+                if permit is not None and hasattr(permit, "note_latency"):
+                    permit.note_latency(ttft)
+                plane.observe_ttft(ttft)
+                if acct is not None:
+                    acct["ttft_s"] = ttft
+            else:
+                plane.observe_itl(now - last_t)
+                if acct is not None:
+                    acct["_itls"].append(now - last_t)
+            last_t = now
+            if acct is not None and isinstance(chunk, dict):
+                usage = chunk.get("usage")
+                if usage:
+                    acct["prompt_tokens"] = usage.get("prompt_tokens")
+                    acct["output_tokens"] = usage.get("completion_tokens")
             yield chunk
+
+    def _account_done(self, acct: dict | None, ctx=None) -> None:
+        """Finalize + ledger the accounting record exactly once. Any
+        path that reached the route body lands here via its ``finally``
+        — an unmarked record means the handler unwound without an
+        explicit outcome (client disconnect / task cancellation).
+        Availability/goodput SLIs are fed for real outcomes only (400s
+        are the caller's bug, not an SLO event)."""
+        if acct is None or "_t0" not in acct:
+            return
+        status = acct.get("status") or "cancelled"
+        reason = acct.get("reason") or (
+            "client_disconnect" if status == "cancelled" else None)
+        http_status = acct.get("http_status")
+        feed = status in ("ok", "shed") or (http_status or 0) >= 500
+        finish_account(
+            acct, status, reason, http_status, ctx=ctx,
+            slo_plane=slo_mod.get_plane() if feed else None)
 
     async def _sse_stream(self, request: web.Request, chunks: AsyncIterator[dict],
                           ctx: Context, model: str,
@@ -296,6 +360,8 @@ class HttpService:
         route = "chat_completions"
         started = time.monotonic()
         self._m_inflight.inc(route=route)
+        acct = None
+        ctx = None
         try:
             try:
                 body = await request.json()
@@ -308,22 +374,26 @@ class HttpService:
                 self._m_requests.inc(route=route, status="404")
                 return _error_body(f"model {chat_req.model!r} not found",
                                    "model_not_found", 404)
-            permit, meta_headers, shed = await self._admit(request, route)
+            acct = make_account(route, chat_req.model)
+            permit, meta_headers, shed = await self._admit(request, route,
+                                                           acct)
             if shed is not None:
                 return shed
             ctx = self._make_context(request)
+            acct["request_id"], acct["trace_id"] = ctx.id, ctx.trace_id
             try:
                 with permit, span("http.request", ctx=ctx, route=route,
                                   model=chat_req.model):
                     self._apply_brownout(chat_req)
                     chunks = self._timed_first(
                         served.preprocessor.generate(chat_req, ctx),
-                        permit, time.monotonic())
+                        permit, time.monotonic(), acct)
                     if chat_req.stream:
                         resp = await self._sse_stream(request, chunks, ctx,
                                                       chat_req.model,
                                                       meta_headers)
                         self._m_requests.inc(route=route, status="200")
+                        acct.update(status="ok", http_status=200)
                         return resp
                     # Non-streaming: force the usage chunk through the
                     # delta stream so the aggregate carries real token
@@ -331,17 +401,25 @@ class HttpService:
                     chat_req.stream_options = {"include_usage": True}
                     full = await aggregate_chat_stream(chunks, 0)
                     self._m_requests.inc(route=route, status="200")
+                    acct.update(status="ok", http_status=200)
                     return web.json_response(full, headers=meta_headers)
             except NoInstancesError as exc:
                 self._m_requests.inc(route=route, status="503")
+                acct.update(status="shed", reason="no_instances",
+                            http_status=503)
                 return _error_body(str(exc), "service_unavailable", 503,
                                    retry_after_s=self._retry_after(exc))
             except RateLimitedError as exc:
                 self._m_requests.inc(route=route, status="429")
+                acct.update(status="shed", http_status=429,
+                            reason=getattr(exc, "shed_reason",
+                                           "rate_limited"))
                 return _error_body(str(exc), "rate_limited", 429,
                                    retry_after_s=self._retry_after(exc))
             except OverloadedError as exc:
                 self._m_requests.inc(route=route, status="503")
+                acct.update(status="shed", http_status=503,
+                            reason=getattr(exc, "shed_reason", "overloaded"))
                 return _error_body(str(exc), "overloaded", 503,
                                    retry_after_s=self._retry_after(exc))
             except (ValueError, InvalidRequestError) as exc:
@@ -349,12 +427,21 @@ class HttpService:
                 # features, over-length prompts): the caller's fault —
                 # whether raised in-process or typed over the wire.
                 self._m_requests.inc(route=route, status="400")
+                acct.update(status="error", reason="invalid_request",
+                            http_status=400)
                 return _error_body(str(exc))
             except Exception as exc:  # noqa: BLE001
+                if isinstance(exc, ConnectionResetError):
+                    acct.update(status="cancelled",
+                                reason="client_disconnect")
+                else:
+                    acct.update(status="error", reason=type(exc).__name__,
+                                http_status=500)
                 log.exception("chat handler failed")
                 self._m_requests.inc(route=route, status="500")
                 return _error_body(f"internal error: {exc}", "internal_error", 500)
         finally:
+            self._account_done(acct, ctx)
             self._m_inflight.dec(route=route)
             self._m_duration.observe(time.monotonic() - started, route=route)
 
@@ -362,6 +449,8 @@ class HttpService:
         route = "completions"
         started = time.monotonic()
         self._m_inflight.inc(route=route)
+        acct = None
+        ctx = None
         try:
             try:
                 body = await request.json()
@@ -374,10 +463,13 @@ class HttpService:
                 self._m_requests.inc(route=route, status="404")
                 return _error_body(f"model {comp_req.model!r} not found",
                                    "model_not_found", 404)
-            permit, meta_headers, shed = await self._admit(request, route)
+            acct = make_account(route, comp_req.model)
+            permit, meta_headers, shed = await self._admit(request, route,
+                                                           acct)
             if shed is not None:
                 return shed
             ctx = self._make_context(request)
+            acct["request_id"], acct["trace_id"] = ctx.id, ctx.trace_id
             try:
                 with permit, span("http.request", ctx=ctx, route=route,
                                   model=comp_req.model):
@@ -389,12 +481,13 @@ class HttpService:
                     chunks = self._timed_first(
                         served.preprocessor.generate_completion(
                             comp_req, ctx),
-                        permit, time.monotonic())
+                        permit, time.monotonic(), acct)
                     if comp_req.stream:
                         resp = await self._sse_stream(request, chunks, ctx,
                                                       comp_req.model,
                                                       meta_headers)
                         self._m_requests.inc(route=route, status="200")
+                        acct.update(status="ok", http_status=200)
                         return resp
                     texts: list[str] = []
                     finish = None
@@ -409,6 +502,7 @@ class HttpService:
                             texts.append(choice.get("text") or "")
                             finish = choice.get("finish_reason") or finish
                     self._m_requests.inc(route=route, status="200")
+                    acct.update(status="ok", http_status=200)
                     return web.json_response({
                         "id": meta.get("id"), "object": "text_completion",
                         "created": meta.get("created"),
@@ -420,24 +514,40 @@ class HttpService:
                     }, headers=meta_headers)
             except ValueError as exc:
                 self._m_requests.inc(route=route, status="400")
+                acct.update(status="error", reason="invalid_request",
+                            http_status=400)
                 return _error_body(str(exc))
             except NoInstancesError as exc:
                 self._m_requests.inc(route=route, status="503")
+                acct.update(status="shed", reason="no_instances",
+                            http_status=503)
                 return _error_body(str(exc), "service_unavailable", 503,
                                    retry_after_s=self._retry_after(exc))
             except RateLimitedError as exc:
                 self._m_requests.inc(route=route, status="429")
+                acct.update(status="shed", http_status=429,
+                            reason=getattr(exc, "shed_reason",
+                                           "rate_limited"))
                 return _error_body(str(exc), "rate_limited", 429,
                                    retry_after_s=self._retry_after(exc))
             except OverloadedError as exc:
                 self._m_requests.inc(route=route, status="503")
+                acct.update(status="shed", http_status=503,
+                            reason=getattr(exc, "shed_reason", "overloaded"))
                 return _error_body(str(exc), "overloaded", 503,
                                    retry_after_s=self._retry_after(exc))
             except Exception as exc:  # noqa: BLE001
+                if isinstance(exc, ConnectionResetError):
+                    acct.update(status="cancelled",
+                                reason="client_disconnect")
+                else:
+                    acct.update(status="error", reason=type(exc).__name__,
+                                http_status=500)
                 log.exception("completion handler failed")
                 self._m_requests.inc(route=route, status="500")
                 return _error_body(f"internal error: {exc}", "internal_error", 500)
         finally:
+            self._account_done(acct, ctx)
             self._m_inflight.dec(route=route)
             self._m_duration.observe(time.monotonic() - started, route=route)
 
@@ -630,6 +740,8 @@ class HttpService:
         route = "responses"
         started = time.monotonic()
         self._m_inflight.inc(route=route)
+        acct = None
+        ctx = None
         try:
             try:
                 body = await request.json()
@@ -662,45 +774,68 @@ class HttpService:
             except ValidationError as exc:
                 self._m_requests.inc(route=route, status="400")
                 return _error_body(str(exc))
-            permit, meta_headers, shed = await self._admit(request, route)
+            acct = make_account(route, model)
+            permit, meta_headers, shed = await self._admit(request, route,
+                                                           acct)
             if shed is not None:
                 return shed
             ctx = self._make_context(request)
+            acct["request_id"], acct["trace_id"] = ctx.id, ctx.trace_id
             with permit, span("http.request", ctx=ctx, route=route,
                               model=model):
                 self._apply_brownout(chat_req)
                 chunks = self._timed_first(
                     served.preprocessor.generate(chat_req, ctx),
-                    permit, time.monotonic())
+                    permit, time.monotonic(), acct)
                 if body.get("stream"):
                     resp = await self._responses_sse(request, chunks, ctx,
                                                      model)
                     self._m_requests.inc(route=route, status="200")
+                    acct.update(status="ok", http_status=200)
                     return resp
                 full = await aggregate_chat_stream(chunks, 0)
                 msg = full["choices"][0]["message"]
                 usage = full.get("usage") or {}
                 self._m_requests.inc(route=route, status="200")
+                acct.update(status="ok", http_status=200)
                 return web.json_response(
                     _response_object(full, model, msg.get("content")),
                     headers=meta_headers)
         except RateLimitedError as exc:
             self._m_requests.inc(route=route, status="429")
+            if acct is not None:
+                acct.update(status="shed", http_status=429,
+                            reason=getattr(exc, "shed_reason",
+                                           "rate_limited"))
             return _error_body(str(exc), "rate_limited", 429,
                                retry_after_s=self._retry_after(exc))
         except OverloadedError as exc:
             self._m_requests.inc(route=route, status="503")
+            if acct is not None:
+                acct.update(status="shed", http_status=503,
+                            reason=getattr(exc, "shed_reason", "overloaded"))
             return _error_body(str(exc), "overloaded", 503,
                                retry_after_s=self._retry_after(exc))
         except NoInstancesError as exc:
             self._m_requests.inc(route=route, status="503")
+            if acct is not None:
+                acct.update(status="shed", reason="no_instances",
+                            http_status=503)
             return _error_body(str(exc), "service_unavailable", 503,
                                retry_after_s=self._retry_after(exc))
         except Exception as exc:  # noqa: BLE001
+            if acct is not None:
+                if isinstance(exc, ConnectionResetError):
+                    acct.update(status="cancelled",
+                                reason="client_disconnect")
+                else:
+                    acct.update(status="error", reason=type(exc).__name__,
+                                http_status=500)
             log.exception("responses handler failed")
             self._m_requests.inc(route=route, status="500")
             return _error_body(f"internal error: {exc}", "internal_error", 500)
         finally:
+            self._account_done(acct, ctx)
             self._m_inflight.dec(route=route)
             self._m_duration.observe(time.monotonic() - started, route=route)
 

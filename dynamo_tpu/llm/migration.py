@@ -76,6 +76,9 @@ class Migration(Operator):
                     raise
                 retries_left -= 1
                 attempt += 1
+                # Per-request attribution: the accounting record
+                # (llm/recorder.py) reads this off the frontend-side ctx.
+                context.values["migrations"] = attempt
                 if self._m_migrations is not None:
                     self._m_migrations.inc()
                 log.warning(

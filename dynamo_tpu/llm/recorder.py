@@ -1,17 +1,30 @@
-"""Stream recording + JSONL event recorder.
+"""Stream recording, JSONL event recorder, per-request accounting.
 
 Capability parity with reference perf.rs (TimestampedResponse,
 RecordedStream, record_stream — perf.rs:32-137) and recorder.rs (Recorder:
 an mpsc-fed background task appending JSONL — recorder.rs:26-256): capture
 response streams with arrival timestamps for offline latency analysis, and
 durably log events to JSONL without blocking the hot path.
+
+On top of that, ``RequestLedger``: one structured accounting record per
+finished OR shed request (tenant/priority, token counts, queue wait,
+TTFT, per-request ITL percentiles, worker id, migrations, typed shed
+reason, brownout level, trace id) in a bounded in-memory ring with an
+optional JSONL sink that reuses ``Recorder``'s non-blocking appender —
+served at ``/debug/requests`` (runtime/health.py) and rolled up offline
+by ``scripts/slo_report.py``. The overload invariant extends into the
+accounting stream: every shed or failed request still produces a record
+with a typed reason — zero silent drops (asserted in
+tests/test_overload.py).
 """
 
 from __future__ import annotations
 
 import asyncio
+import collections
 import dataclasses
 import json
+import threading
 import time
 from typing import Any, AsyncIterator
 
@@ -189,3 +202,150 @@ class Recorder:
             await self._q.put(None)
             await self._task
             self._task = None
+
+
+# -- per-request accounting ----------------------------------------------------
+
+#: Record statuses. "shed" carries a typed reason from the overload
+#: defense (queue_full/deadline/deadline_wait/priority/no_instances);
+#: "error" is a genuine failure (5xx); "cancelled" is a client abort.
+ACCOUNT_STATUSES = ("ok", "shed", "error", "cancelled")
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float | None:
+    if not sorted_vals:
+        return None
+    return sorted_vals[min(len(sorted_vals) - 1,
+                           int(len(sorted_vals) * q))]
+
+
+class RequestLedger:
+    """Bounded ring of per-request accounting records + optional JSONL
+    sink. ``record()`` is synchronous and non-blocking: the ring append
+    happens under a lock, the disk write (when configured) rides the
+    ``Recorder`` queue."""
+
+    def __init__(self, capacity: int = 1024, path: str | None = None):
+        self.capacity = capacity
+        self._ring: collections.deque[dict] = collections.deque(
+            maxlen=capacity)
+        self._lock = threading.Lock()
+        self.counts: collections.Counter = collections.Counter()
+        self.total = 0
+        self._sink: Recorder | None = Recorder(path) if path else None
+
+    def configure_sink(self, path: str | None) -> None:
+        self._sink = Recorder(path) if path else None
+
+    def record(self, rec: dict) -> None:
+        status = rec.get("status")
+        if status not in ACCOUNT_STATUSES:
+            rec["status"] = status = "error"
+        with self._lock:
+            self._ring.append(rec)
+            self.counts[status] += 1
+            self.total += 1
+        sink = self._sink
+        if sink is not None:
+            try:
+                sink.start()  # idempotent; needs a running loop
+            except RuntimeError:
+                return  # engine-thread caller with no loop: ring only
+            sink.record(rec)
+
+    def recent(self, limit: int = 100) -> list[dict]:
+        """Newest-first records for /debug/requests."""
+        with self._lock:
+            snapshot = list(self._ring)
+        return snapshot[::-1][:max(0, limit)]
+
+    def snapshot(self, limit: int = 100) -> dict:
+        sink = self._sink
+        return {
+            "capacity": self.capacity,
+            "total": self.total,
+            "counts": dict(self.counts),
+            "sink": ({"path": sink.path, "written": sink.written,
+                      "dropped": sink.dropped} if sink else None),
+            "records": self.recent(limit),
+        }
+
+    async def close(self) -> None:
+        if self._sink is not None:
+            await self._sink.close()
+
+
+def make_account(route: str, model: str, ctx=None) -> dict:
+    """A fresh accounting record skeleton. The HTTP layer fills in what
+    it learns as the request progresses and hands the result to
+    ``finish_account``."""
+    return {
+        "ts": time.time(),
+        "route": route,
+        "model": model,
+        "request_id": getattr(ctx, "id", None),
+        "trace_id": getattr(ctx, "trace_id", None),
+        "tenant": None,
+        "priority": None,
+        "deadline_ms": None,
+        "status": None,
+        "reason": None,
+        "http_status": None,
+        "prompt_tokens": None,
+        "output_tokens": None,
+        "reuse_tokens": None,
+        "kv_hit_ratio": None,
+        "queue_wait_s": None,
+        "ttft_s": None,
+        "itl_p50_s": None,
+        "itl_p99_s": None,
+        "duration_s": None,
+        "worker_id": None,
+        "migrations": 0,
+        "brownout_level": 0,
+        "_t0": time.monotonic(),   # stripped at finish
+        "_itls": [],               # raw gaps; folded to p50/p99 at finish
+    }
+
+
+def finish_account(acct: dict, status: str, reason: str | None = None,
+                   http_status: int | None = None, ctx=None,
+                   ledger: "RequestLedger | None" = None,
+                   slo_plane=None) -> dict:
+    """Finalize + ledger a record, and feed the SLO availability/goodput
+    SLIs from the same event (one instrumentation point, two consumers)."""
+    acct["status"] = status
+    acct["reason"] = reason
+    acct["http_status"] = http_status
+    acct["duration_s"] = time.monotonic() - acct.pop("_t0")
+    gaps = sorted(acct.pop("_itls"))
+    acct["itl_p50_s"] = _percentile(gaps, 0.50)
+    acct["itl_p99_s"] = _percentile(gaps, 0.99)
+    if ctx is not None:
+        values = getattr(ctx, "values", {})
+        for key in ("worker_id", "migrations", "reuse_tokens",
+                    "kv_hit_ratio", "queue_wait_s"):
+            if values.get(key) is not None:
+                acct[key] = values[key]
+    (ledger or get_ledger()).record(acct)
+    if slo_plane is not None:
+        slo_plane.observe_request(ok=status == "ok", shed=status == "shed")
+    return acct
+
+
+_LEDGER = RequestLedger()
+
+
+def get_ledger() -> RequestLedger:
+    return _LEDGER
+
+
+def configure_ledger(capacity: int | None = None,
+                     path: str | None = None) -> RequestLedger:
+    """Entrypoint wiring (SloConfig.request_ring / request_log_path)."""
+    global _LEDGER
+    if capacity is not None and capacity != _LEDGER.capacity:
+        _LEDGER = RequestLedger(capacity, path)
+    elif path is not None:
+        _LEDGER.configure_sink(path)
+    return _LEDGER

@@ -49,6 +49,10 @@ Known keys (each hook site names the key it consults):
                      the connection
     queue.pop_error  coordinator client: fail queue_pop with
                      ConnectionError
+    engine.stall_ms  engine loop: freeze the engine thread for the
+                     drawn magnitude (ms) before dispatching — produces
+                     a genuine decode_stall_seconds gap, so flight-
+                     recorder anomaly capture is chaos-testable
 
 Disabled (``DTPU_CHAOS`` unset / ``uninstall()``), every hook site is
 guarded by the module-level ``ACTIVE`` bool — a single attribute read
@@ -78,7 +82,7 @@ _plan: "FaultPlan | None" = None
 _RANGE_RE = re.compile(r"^(-?[\d.]+)\.\.(-?[\d.]+)(?::([\d.]+))?$")
 
 # Injection-site names (for spec validation error messages only).
-KNOWN_SITES = ("service", "client", "coord", "coord_client", "kv")
+KNOWN_SITES = ("service", "client", "coord", "coord_client", "kv", "engine")
 
 
 class FaultRule:

@@ -274,6 +274,9 @@ class EndpointClient:
                 f"Stream ended before generation completed "
                 f"(connect to {instance.instance_id:x} failed: {exc})") from exc
         breakers.on_dispatch(iid)
+        # Worker attribution for the request's accounting record: the
+        # LAST dispatch wins, which is what migration semantics want.
+        ctx.values["worker_id"] = f"{iid:x}"
         sent_t = time.monotonic()
         first_latency: float | None = None
         failed = False

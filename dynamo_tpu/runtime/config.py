@@ -12,6 +12,7 @@ import os
 from typing import Any
 
 from dynamo_tpu.runtime.overload import OverloadConfig
+from dynamo_tpu.runtime.slo import SloConfig
 
 try:  # tomllib is stdlib from 3.11; fall back to tomli, else TOML-less.
     import tomllib
@@ -45,21 +46,24 @@ def _env_float(name: str, default: float) -> float:
     return default if raw is None else float(raw)
 
 
-def _apply_overload_env(ov: OverloadConfig) -> None:
-    """Generic DTPU_OVERLOAD_<FIELD> override: OverloadConfig is all
-    plain bool/int/float scalars, so the mapping is mechanical."""
-    for field in dataclasses.fields(OverloadConfig):
-        raw = _env("OVERLOAD_" + field.name.upper())
+def _apply_scalar_env(prefix: str, obj: Any) -> None:
+    """Generic DTPU_<PREFIX>_<FIELD> override for all-scalar config
+    dataclasses (OverloadConfig, SloConfig): the mapping is mechanical
+    because every field is a plain bool/int/float/str."""
+    for field in dataclasses.fields(type(obj)):
+        raw = _env(f"{prefix}_" + field.name.upper())
         if raw is None:
             continue
-        current = getattr(ov, field.name)
+        current = getattr(obj, field.name)
         if isinstance(current, bool):
             value: Any = raw.strip().lower() in ("1", "true", "yes", "on")
         elif isinstance(current, int):
             value = int(raw)
-        else:
+        elif isinstance(current, float):
             value = float(raw)
-        setattr(ov, field.name, value)
+        else:
+            value = raw
+        setattr(obj, field.name, value)
 
 
 @dataclasses.dataclass
@@ -115,6 +119,11 @@ class RuntimeConfig:
     overload: OverloadConfig = dataclasses.field(
         default_factory=OverloadConfig)
 
+    # SLO plane (runtime/slo.py): declarative targets, sliding-window
+    # SLIs, multi-window burn-rate alerting, per-request accounting.
+    # TOML: an [slo] table; env: DTPU_SLO_<FIELD>.
+    slo: SloConfig = dataclasses.field(default_factory=SloConfig)
+
     @classmethod
     def from_settings(cls, path: str | None = None) -> "RuntimeConfig":
         """defaults <- TOML (DTPU_CONFIG_PATH or ``path``) <- DTPU_* env."""
@@ -132,6 +141,8 @@ class RuntimeConfig:
                     value = data[field.name]
                     if field.name == "overload" and isinstance(value, dict):
                         value = OverloadConfig(**value)
+                    if field.name == "slo" and isinstance(value, dict):
+                        value = SloConfig(**value)
                     setattr(cfg, field.name, value)
         cfg.coordinator_url = _env("COORDINATOR_URL", cfg.coordinator_url)
         cfg.static_mode = _env_bool("STATIC_MODE", cfg.static_mode)
@@ -146,7 +157,8 @@ class RuntimeConfig:
         cfg.retire_drain_s = _env_float("RETIRE_DRAIN_S", cfg.retire_drain_s)
         cfg.stream_idle_timeout_s = _env_float(
             "STREAM_IDLE_TIMEOUT_S", cfg.stream_idle_timeout_s)
-        _apply_overload_env(cfg.overload)
+        _apply_scalar_env("OVERLOAD", cfg.overload)
+        _apply_scalar_env("SLO", cfg.slo)
         return cfg
 
     @property

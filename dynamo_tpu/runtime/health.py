@@ -5,34 +5,74 @@ Capability parity with reference spawn_system_status_server
 (lib.rs:90-120): per-process HTTP server exposing liveness, per-endpoint health,
 and Prometheus metrics, gated by config (DTPU_SYSTEM_ENABLED/PORT ~
 DYN_SYSTEM_*, config.rs:85-123). On top of the reference's surface it also
-serves the tracing debug API (runtime/tracing.py):
+serves the tracing/SLO/accounting/flight debug API:
 
 - ``GET /debug/traces/recent``            — newest-first trace index
 - ``GET /debug/traces?trace_id=&format=`` — one trace (chrome|otlp|spans)
 - ``POST /debug/profile``                 — on-demand jax.profiler capture
   (``{"duration_ms": 1000, "out_dir": "/tmp/prof"}``), degrading to a
   span-recorder dump when JAX profiling is unavailable.
+- ``GET /debug/slo``                      — SLO targets, windowed SLIs,
+  burn rates, alert states, pressure (runtime/slo.py)
+- ``GET /debug/requests?limit=``          — newest-first per-request
+  accounting records (llm/recorder.py RequestLedger)
+- ``GET /debug/flight``                   — flight-recorder ring +
+  meta; ``POST /debug/flight`` captures a diagnostic bundle to disk
+  (``{"out_dir": ...}`` optional; runtime/flight.py)
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
 import tempfile
 
 from aiohttp import web
 
-from dynamo_tpu.runtime import tracing
+from dynamo_tpu.runtime import flight, slo, tracing
 from dynamo_tpu.runtime.logging import get_logger
 
 log = get_logger("health")
 
 
 def add_debug_routes(app: web.Application) -> None:
-    """Attach the tracing/profiling debug routes (shared with the OpenAI
+    """Attach the observability debug routes (shared with the OpenAI
     frontend so in-process pipelines get them without a status server)."""
     app.router.add_get("/debug/traces", _debug_traces)
     app.router.add_get("/debug/traces/recent", _debug_traces_recent)
     app.router.add_post("/debug/profile", _debug_profile)
+    app.router.add_get("/debug/slo", _debug_slo)
+    app.router.add_get("/debug/requests", _debug_requests)
+    app.router.add_get("/debug/flight", _debug_flight)
+    app.router.add_post("/debug/flight", _debug_flight_capture)
+
+
+async def _debug_slo(_request: web.Request) -> web.Response:
+    return web.json_response(slo.get_plane().snapshot())
+
+
+async def _debug_requests(request: web.Request) -> web.Response:
+    from dynamo_tpu.llm.recorder import get_ledger
+    limit = int(request.query.get("limit", "100"))
+    return web.json_response(get_ledger().snapshot(limit))
+
+
+async def _debug_flight(_request: web.Request) -> web.Response:
+    rec = flight.get_recorder()
+    return web.json_response({"meta": rec.meta(), "windows": rec.dump(),
+                              "triggers_total": flight.triggers_total})
+
+
+async def _debug_flight_capture(request: web.Request) -> web.Response:
+    try:
+        body = await request.json()
+    except (json.JSONDecodeError, ValueError):
+        body = {}
+    out_dir = body.get("out_dir")
+    reason = str(body.get("reason", "manual"))
+    # The bundle serializes the whole ring + span recorder: off the loop.
+    path = await asyncio.to_thread(flight.capture_bundle, reason, out_dir)
+    return web.json_response({"bundle": path, "reason": reason})
 
 
 async def _debug_traces_recent(request: web.Request) -> web.Response:

@@ -346,6 +346,10 @@ class AdaptiveLimiter:
         self.shed_counts[(reason, priority)] += 1
         if self._m_shed is not None:
             self._m_shed.inc(reason=reason, priority=priority)
+        # The typed reason rides the exception so the accounting stream
+        # (llm/recorder.py RequestLedger) records WHY, not just that a
+        # 429/503 happened.
+        exc.shed_reason = reason
         return exc
 
     # -- release / AIMD -------------------------------------------------------

@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Offline rollups over per-request accounting JSONL.
+
+The frontend (``--request-log`` / ``DTPU_SLO_REQUEST_LOG_PATH``) appends
+one JSON object per finished or shed request (llm/recorder.py
+``RequestLedger``). This tool turns a day of that into the table an
+operator actually wants: per-tenant / per-priority counts, shed + error
+rates, TTFT/ITL percentiles, and token volumes.
+
+Usage:
+    python scripts/slo_report.py /var/log/dtpu/requests.jsonl
+    python scripts/slo_report.py requests.jsonl --by tenant --json
+    python scripts/slo_report.py requests.jsonl --by priority,route
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import sys
+
+
+def percentile(sorted_vals: list[float], q: float) -> float | None:
+    if not sorted_vals:
+        return None
+    return sorted_vals[min(len(sorted_vals) - 1,
+                           int(len(sorted_vals) * q))]
+
+
+def load_records(path: str) -> list[dict]:
+    records = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail line from a live writer
+            if isinstance(rec, dict) and rec.get("status"):
+                records.append(rec)
+    return records
+
+
+def rollup(records: list[dict], by: list[str]) -> dict[tuple, dict]:
+    """Group records by the given fields and aggregate each group."""
+    groups: dict[tuple, list[dict]] = collections.defaultdict(list)
+    for rec in records:
+        key = tuple(str(rec.get(f) or "-") for f in by)
+        groups[key].append(rec)
+    out: dict[tuple, dict] = {}
+    for key, recs in sorted(groups.items()):
+        n = len(recs)
+        counts = collections.Counter(r["status"] for r in recs)
+        reasons = collections.Counter(
+            r.get("reason") for r in recs
+            if r["status"] in ("shed", "error") and r.get("reason"))
+        ttfts = sorted(r["ttft_s"] for r in recs
+                       if r.get("ttft_s") is not None)
+        itl99 = sorted(r["itl_p99_s"] for r in recs
+                       if r.get("itl_p99_s") is not None)
+        out[key] = {
+            "requests": n,
+            "ok": counts.get("ok", 0),
+            "shed": counts.get("shed", 0),
+            "error": counts.get("error", 0),
+            "cancelled": counts.get("cancelled", 0),
+            "shed_rate": round(counts.get("shed", 0) / n, 4),
+            "error_rate": round(counts.get("error", 0) / n, 4),
+            "ttft_p50_s": percentile(ttfts, 0.50),
+            "ttft_p99_s": percentile(ttfts, 0.99),
+            "itl_p99_s": percentile(itl99, 0.99),
+            "prompt_tokens": sum(r.get("prompt_tokens") or 0 for r in recs),
+            "output_tokens": sum(r.get("output_tokens") or 0 for r in recs),
+            "migrations": sum(r.get("migrations") or 0 for r in recs),
+            "reasons": dict(reasons.most_common(5)),
+        }
+    return out
+
+
+def render(table: dict[tuple, dict], by: list[str]) -> str:
+    cols = ("requests", "ok", "shed", "error", "shed_rate", "error_rate",
+            "ttft_p50_s", "ttft_p99_s", "itl_p99_s", "output_tokens")
+    key_w = max([len(" / ".join(k)) for k in table] + [len("/".join(by)), 5])
+    lines = [f"{'/'.join(by):<{key_w}}  " +
+             "  ".join(f"{c:>12}" for c in cols)]
+    for key, row in table.items():
+        cells = []
+        for c in cols:
+            v = row[c]
+            if v is None:
+                cells.append(f"{'-':>12}")
+            elif isinstance(v, float):
+                cells.append(f"{v:>12.4f}")
+            else:
+                cells.append(f"{v:>12}")
+        lines.append(f"{' / '.join(key):<{key_w}}  " + "  ".join(cells))
+        if row["reasons"]:
+            reasons = ", ".join(f"{k}={v}" for k, v in row["reasons"].items())
+            lines.append(f"{'':<{key_w}}  reasons: {reasons}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("path", help="accounting JSONL file")
+    parser.add_argument("--by", default="tenant,priority",
+                        help="comma-separated grouping fields "
+                             "(default tenant,priority)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the rollup as JSON instead of a table")
+    args = parser.parse_args(argv)
+    by = [f.strip() for f in args.by.split(",") if f.strip()]
+    records = load_records(args.path)
+    if not records:
+        print("no accounting records found", file=sys.stderr)
+        return 1
+    table = rollup(records, by)
+    if args.json:
+        print(json.dumps({" / ".join(k): v for k, v in table.items()},
+                         indent=2))
+    else:
+        sys.stdout.write(f"{len(records)} records from {args.path}\n")
+        sys.stdout.write(render(table, by))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -10,8 +10,14 @@ Usage:
     python scripts/trace_view.py http://127.0.0.1:8000
     python scripts/trace_view.py http://127.0.0.1:8000 --trace-id <id>
     python scripts/trace_view.py /tmp/prof/spans.chrome.json
+    python scripts/trace_view.py http://127.0.0.1:8000 --flight
+    python scripts/trace_view.py /tmp/dtpu-flight/flight-*.json --flight
 
-With no --trace-id, the newest recorded trace is shown.
+With no --trace-id, the newest recorded trace is shown. ``--flight``
+renders the engine flight recorder instead (live /debug/flight ring or
+a diagnostic bundle file): one line per engine window with occupancy /
+free-page / chunk-token / stall columns — "what was the engine doing"
+next to the span waterfall's "what was this request doing".
 """
 
 from __future__ import annotations
@@ -112,13 +118,69 @@ def render_waterfall(spans: list[dict]) -> str:
     return "\n".join(lines) + "\n"
 
 
+def load_flight(source: str) -> tuple[list[dict], dict]:
+    """(windows, meta) from a live /debug/flight endpoint, a diagnostic
+    bundle (runtime/flight.py capture_bundle), or a raw GET dump."""
+    if source.startswith(("http://", "https://")):
+        data = _fetch_json(f"{source.rstrip('/')}/debug/flight")
+    else:
+        with open(source) as fh:
+            data = json.load(fh)
+    if "flight" in data:  # diagnostic bundle wrapper
+        data = data["flight"]
+    if "windows" not in data:
+        raise SystemExit(f"{source}: no flight-recorder windows "
+                         "(neither a /debug/flight dump nor a bundle)")
+    return data["windows"], data.get("meta", {})
+
+
+def render_flight(windows: list[dict], meta: dict | None = None) -> str:
+    """Per-window timeline: offset, window duration, occupancy bar, free
+    KV pages, chunk tokens dispatched, preemption count, brownout level,
+    and the decode-stall gap that preceded the window."""
+    meta = meta or {}
+    if not windows:
+        return "(empty flight ring)\n"
+    t0 = windows[0]["t_mono"]
+    max_active = max(max(w["active"] for w in windows), 1)
+    head = (f"flight ring: {len(windows)} windows"
+            + (f", frozen ({meta['frozen_reason']})"
+               if meta.get("frozen") else "")
+            + (f", {meta['skipped_idle']} idle skipped"
+               if meta.get("skipped_idle") else ""))
+    lines = [head,
+             f"{'offset':>10}  {'dur':>8}  {'act':>4} {'occupancy':<18}"
+             f"{'free_pg':>8}  {'chunk_tok':>9}  {'preempt':>7}  "
+             f"{'brown':>5}  {'stall':>9}"]
+    for w in windows:
+        bar_n = int(round(w["active"] / max_active * 16))
+        bar = "#" * bar_n + "." * (16 - bar_n)
+        stall = (f"{w['stall_s'] * 1e3:>7.1f}ms" if w.get("stall_s")
+                 else f"{'-':>9}")
+        lines.append(
+            f"{(w['t_mono'] - t0) * 1e3:>8.1f}ms  "
+            f"{w['dur_s'] * 1e3:>6.1f}ms  "
+            f"{w['active']:>4} |{bar}| "
+            f"{w['free_pages']:>8}  {w['chunk_tokens']:>9}  "
+            f"{w['preempts']:>7}  {w['brownout']:>5}  {stall}")
+    return "\n".join(lines) + "\n"
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("source",
                         help="base URL (http://host:port) or trace file")
     parser.add_argument("--trace-id", default=None,
                         help="trace to show (default: newest)")
+    parser.add_argument("--flight", action="store_true",
+                        help="render the engine flight recorder "
+                             "(/debug/flight or a diagnostic bundle) "
+                             "instead of a span waterfall")
     args = parser.parse_args(argv)
+    if args.flight:
+        windows, meta = load_flight(args.source)
+        sys.stdout.write(render_flight(windows, meta))
+        return 0
     if args.source.startswith(("http://", "https://")):
         spans = load_spans_from_url(args.source, args.trace_id)
     else:

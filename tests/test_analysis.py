@@ -522,7 +522,43 @@ def test_default_rules_catalog():
     assert ids == {"blocking-call-in-async", "fire-and-forget-task",
                    "lock-across-await", "swallowed-cancellation",
                    "unbounded-queue", "unbounded-wait",
-                   "jit-recompile-hazard", "wire-error-taxonomy"}
+                   "jit-recompile-hazard", "wire-error-taxonomy",
+                   "direct-prometheus-import"}
+
+
+# -- direct-prometheus-import -------------------------------------------------
+
+PROM_BAD = """\
+import prometheus_client
+from prometheus_client import Counter
+from prometheus_client.core import GaugeMetricFamily
+
+c = Counter("my_counter", "desc")
+"""
+
+PROM_GOOD = """\
+from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+m = MetricsRegistry().namespace("ns")
+c = m.counter("my_counter", "desc")
+"""
+
+
+def test_direct_prometheus_import_fires(tmp_path):
+    findings = run_rule(tmp_path, "direct-prometheus-import", PROM_BAD)
+    # One finding per offending import statement.
+    assert len(findings) == 3
+    assert all("runtime/metrics.py" in f.message for f in findings)
+
+
+def test_direct_prometheus_import_quiet_on_registry_use(tmp_path):
+    assert run_rule(tmp_path, "direct-prometheus-import", PROM_GOOD) == []
+
+
+def test_direct_prometheus_import_allows_metrics_module(tmp_path):
+    findings = run_rule(tmp_path, "direct-prometheus-import", PROM_BAD,
+                        name="runtime/metrics.py")
+    assert findings == []
 
 
 def test_unparseable_file_reports_parse_error(tmp_path):

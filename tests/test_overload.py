@@ -481,6 +481,8 @@ async def test_overload_matrix_5x_capacity():
     plan: every request completes or is shed typed with Retry-After;
     goodput stays within a bound of capacity; admitted p99 is bounded;
     zero silent drops."""
+    from dynamo_tpu.llm.recorder import get_ledger
+
     coord = Coordinator()
     await coord.start()
     overload = OverloadConfig(
@@ -491,6 +493,7 @@ async def test_overload_matrix_5x_capacity():
     f = await start_frontend(coord, overload=overload)
     rt, manager, watcher, service = f
     deadline_s = overload.default_deadline_ms / 1000.0
+    ledger_before = get_ledger().total
     try:
         await wait_model(manager)
         # Mild seeded response-plane latency chaos: shedding decisions
@@ -523,6 +526,28 @@ async def test_overload_matrix_5x_capacity():
         # shed_total{reason,priority} landed in the metrics registry.
         total = sum(limiter._m_shed.collect().values())
         assert total == len(shed)
+        # Accounting stream (llm/recorder.py): EVERY request — completed
+        # or shed — produced exactly one record, and every shed record
+        # carries the limiter's typed reason. Zero silent drops extends
+        # to the audit trail.
+        ledger = get_ledger()
+        assert ledger.total - ledger_before == 20
+        records = ledger.recent(limit=20)
+        assert all(r["status"] in ("ok", "shed") for r in records)
+        shed_records = [r for r in records if r["status"] == "shed"]
+        assert len(shed_records) == len(shed)
+        typed_reasons = {"queue_full", "deadline", "deadline_wait",
+                         "priority", "no_instances"}
+        assert all(r["reason"] in typed_reasons for r in shed_records), \
+            [r["reason"] for r in shed_records]
+        # ...and the reason mix matches the limiter's own shed counts.
+        import collections as _c
+        by_reason = _c.Counter(r["reason"] for r in shed_records)
+        for (reason, _prio), n in limiter.shed_counts.items():
+            assert by_reason[reason] >= min(n, 1), (reason, by_reason)
+        ok_records = [r for r in records if r["status"] == "ok"]
+        assert all(r["http_status"] == 200 and r["ttft_s"] is not None
+                   for r in ok_records)
     finally:
         await service.stop()
         await watcher.stop()

@@ -67,6 +67,62 @@ def test_waterfall_empty_and_depth_cycle_safe():
     assert "a" in out and "b" in out
 
 
+FLIGHT_WINDOWS = [
+    {"t_mono": 10.000, "dur_s": 0.011, "active": 4, "waiting": 0,
+     "free_pages": 40, "chunk_tokens": 256, "chunks_inflight": 1,
+     "preempts": 0, "brownout": 0, "stall_s": 0.0, "step": 7},
+    {"t_mono": 10.012, "dur_s": 0.010, "active": 4, "waiting": 1,
+     "free_pages": 38, "chunk_tokens": 0, "chunks_inflight": 0,
+     "preempts": 1, "brownout": 2, "stall_s": 0.0021, "step": 8},
+    {"t_mono": 12.345, "dur_s": 0.010, "active": 2, "waiting": 0,
+     "free_pages": 64, "chunk_tokens": 0, "chunks_inflight": 0,
+     "preempts": 1, "brownout": 0, "stall_s": 2.31, "step": 9},
+]
+
+
+def test_flight_rendering_columns():
+    out = trace_view.render_flight(
+        FLIGHT_WINDOWS, {"frozen": True, "frozen_reason": "decode_stall",
+                         "skipped_idle": 5})
+    lines = out.strip().splitlines()
+    assert "3 windows" in lines[0]
+    assert "frozen (decode_stall)" in lines[0]
+    assert "5 idle skipped" in lines[0]
+    body = lines[2:]
+    assert len(body) == 3
+    # Offsets are relative to the first window.
+    assert body[0].lstrip().startswith("0.0ms")
+    assert body[2].lstrip().startswith("2345.0ms")
+    # Occupancy bar scales to the max active count (4 -> full 16 cells).
+    assert "|################|" in body[0]
+    assert "|########........|" in body[2]
+    # Free pages / chunk tokens / preempts / brownout columns land.
+    assert "256" in body[0]
+    # Stall column renders ms for nonzero gaps, '-' otherwise.
+    assert "2310.0ms" in body[2]
+    assert body[0].rstrip().endswith("-")
+    assert "(empty flight ring)" in trace_view.render_flight([])
+
+
+def test_load_flight_from_bundle_and_raw_dump(tmp_path):
+    bundle = {"reason": "slo_burn_ttft", "ts": 1.0,
+              "flight": {"meta": {"frozen": True,
+                                  "frozen_reason": "slo_burn_ttft"},
+                         "windows": FLIGHT_WINDOWS},
+              "spans": {"traceEvents": []}, "metrics": "",
+              "config_fingerprint": {}}
+    p = tmp_path / "bundle.json"
+    p.write_text(json.dumps(bundle))
+    windows, meta = trace_view.load_flight(str(p))
+    assert len(windows) == 3 and meta["frozen_reason"] == "slo_burn_ttft"
+    raw = tmp_path / "dump.json"
+    raw.write_text(json.dumps({"meta": {}, "windows": FLIGHT_WINDOWS[:1]}))
+    windows, _ = trace_view.load_flight(str(raw))
+    assert len(windows) == 1
+    out = trace_view.render_flight(windows)
+    assert "1 windows" in out
+
+
 def test_load_spans_from_chrome_file(tmp_path):
     chrome = {"traceEvents": [
         {"name": "root", "ph": "X", "ts": 0.0, "dur": 1000.0, "pid": 1,
