@@ -32,8 +32,12 @@ _SERVICE_SUFFIX = "runtime/service.py"
 _CLIENT_SUFFIX = "runtime/client.py"
 _ROOT_CLASS = "EngineError"
 # Modules on the handler side of the plane: errors raised here cross the
-# wire back to the client decoder.
-_ENGINE_SIDE = ("/engine/", "/llm/")
+# wire back to the client decoder. backends/ (the worker mains) joined
+# when the SetRole control verb landed: RoleTransitionError surfaces
+# from role-manager plumbing the worker mains own (llm/reconfig.py,
+# backends/*.py), and a control-verb rejection that degrades to a
+# generic 500 remotely is exactly the drift this rule exists to catch.
+_ENGINE_SIDE = ("/engine/", "/llm/", "/backends/")
 
 
 def _norm(path: str) -> str:
@@ -43,9 +47,10 @@ def _norm(path: str) -> str:
 class WireErrorTaxonomy(ProjectRule):
     rule_id = "wire-error-taxonomy"
     description = ("every EngineError subclass raised by engine-side code "
-                   "needs a WIRE_PREFIX encoded in runtime/service.py and "
-                   "decoded in runtime/client.py, so HTTP status and retry "
-                   "semantics survive remote deployment")
+                   "(engine/, llm/, backends/) needs a WIRE_PREFIX encoded "
+                   "in runtime/service.py and decoded in runtime/client.py, "
+                   "so HTTP status and retry semantics survive remote "
+                   "deployment")
 
     def check_project(self, modules: list[Module]) -> Iterable[Finding]:
         errors_mod = self._find(modules, _ERRORS_SUFFIX)

@@ -3,6 +3,15 @@
 ``python -m dynamo_tpu.backends.mocker`` (reference parity:
 components/backends/mocker + `dynamo-run out=mocker`): exercises KV-aware
 routing, overload, and disagg logic with zero TPUs.
+
+Role-reconfigurable (llm/reconfig.py): ``--mode prefill|decode|agg``
+picks the LAUNCH role, and a ``SetRole`` directive (planner or the
+status server's POST /control/role) flips the worker live — the mocker
+is how the role-transition protocol is chaos-tested without hardware
+(tests/test_reconfig.py, scripts/check.sh reconfig smoke). The mocker's
+"prefill" role registers the same simulator under the prefill component
+(the registration/drain/rewire mechanics are real; the KV parcels are
+exercised by the TPU engine's disagg tests).
 """
 
 from __future__ import annotations
@@ -12,7 +21,9 @@ import asyncio
 
 from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, WorkerMetricsPublisher
 from dynamo_tpu.llm.mocker import MockerConfig, MockerEngine
-from dynamo_tpu.llm.model_card import ModelRuntimeConfig, register_llm
+from dynamo_tpu.llm.model_card import (ModelRuntimeConfig, deregister_llm,
+                                       register_llm)
+from dynamo_tpu.llm.reconfig import ROLES, RoleManager, ServingProfile
 from dynamo_tpu.llm.tokenizer import Tokenizer, make_test_tokenizer
 from dynamo_tpu.runtime.config import RuntimeConfig
 from dynamo_tpu.runtime.distributed import DistributedRuntime
@@ -31,7 +42,46 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument("--speedup-ratio", type=float, default=1.0)
     parser.add_argument("--migration-limit", type=int, default=0)
     parser.add_argument("--coordinator-url", default=None)
+    parser.add_argument("--mode", default="agg", choices=list(ROLES),
+                        help="launch role; runtime-reconfigurable via "
+                             "SetRole (llm/reconfig.py)")
+    parser.add_argument("--prefill-component", default="prefill",
+                        help="component the prefill role registers under")
     return parser.parse_args(argv)
+
+
+def make_profile_builder(runtime, engine, args, tokenizer):
+    """Per-role serving profiles around ONE simulator engine — the
+    mocker mirror of the TPU worker's profile builder."""
+
+    async def build(role: str) -> ServingProfile:
+        prof = ServingProfile(role)
+        if role == "prefill":
+            endpoint = (runtime.namespace(None)
+                        .component(args.prefill_component)
+                        .endpoint(args.endpoint))
+            server = await endpoint.serve_endpoint(engine.handler(),
+                                                   graceful_shutdown=True)
+            prof.add_server(server)
+            return prof
+        # decode/agg: the routable model endpoint + its model card.
+        endpoint = (runtime.namespace(None).component(args.component)
+                    .endpoint(args.endpoint))
+        server = await endpoint.serve_endpoint(engine.handler(),
+                                               graceful_shutdown=False)
+        prof.add_server(server)
+        await register_llm(
+            runtime, endpoint, args.model_name, tokenizer,
+            kv_cache_block_size=args.block_size,
+            migration_limit=args.migration_limit,
+            runtime_config=ModelRuntimeConfig(
+                total_kv_blocks=args.num_kv_blocks,
+                max_num_seqs=args.max_num_seqs))
+        prof.add_closer(
+            "model-card", lambda: deregister_llm(runtime, args.model_name))
+        return prof
+
+    return build
 
 
 async def run(args: argparse.Namespace) -> None:
@@ -53,20 +103,24 @@ async def run(args: argparse.Namespace) -> None:
         metrics_pub = WorkerMetricsPublisher(runtime, ns, args.component,
                                              runtime.instance_id)
         engine = MockerEngine(mocker_cfg, kv_pub, metrics_pub)
-        endpoint = (runtime.namespace(None).component(args.component)
-                    .endpoint(args.endpoint))
-        server = await endpoint.serve_endpoint(engine.handler(),
-                                               graceful_shutdown=False)
-        await register_llm(
-            runtime, endpoint, args.model_name, tokenizer,
-            kv_cache_block_size=args.block_size,
-            migration_limit=args.migration_limit,
-            runtime_config=ModelRuntimeConfig(
-                total_kv_blocks=args.num_kv_blocks,
-                max_num_seqs=args.max_num_seqs))
+        roles = RoleManager(runtime,
+                            make_profile_builder(runtime, engine, args,
+                                                 tokenizer),
+                            role=args.mode,
+                            status_extra={"backend": "mocker",
+                                          "model": args.model_name})
+        await roles.start()
         engine.start()
-        print(f"MOCKER_READY port={server.port} worker={runtime.instance_id:x}",
-              flush=True)
+        status_server = None
+        if cfg.system_enabled:
+            from dynamo_tpu.runtime.health import SystemStatusServer
+            status_server = SystemStatusServer(runtime, host=cfg.bind_host,
+                                               port=cfg.system_port,
+                                               role_manager=roles)
+            await status_server.start()
+        port = roles.profile.servers[0].port if roles.profile.servers else 0
+        print(f"MOCKER_READY mode={args.mode} port={port} "
+              f"worker={runtime.instance_id:x}", flush=True)
         import signal
         loop = asyncio.get_running_loop()
         for sig in (signal.SIGINT, signal.SIGTERM):
@@ -76,7 +130,9 @@ async def run(args: argparse.Namespace) -> None:
                 pass
         await runtime.wait_for_shutdown()
         await engine.stop()
-        await server.shutdown()
+        if status_server is not None:
+            await status_server.stop()
+        await roles.stop()
     finally:
         await runtime.close()
 

@@ -14,6 +14,12 @@ conditionally forwards long prompts to discovered prefill workers
 the transferred KV, and decodes. ``--mode agg`` (default) is fully local.
 Handlers live in dynamo_tpu.llm.disagg; e2e-tested in tests/test_disagg.py.
 
+``--mode`` is only the LAUNCH role: the worker is runtime-reconfigurable
+via the SetRole protocol (llm/reconfig.py) — a planner directive or the
+status server's POST /control/role drains in-flight streams through the
+retire/migration machinery and rebuilds the serving profile around the
+same engine, no weight reload (docs/RESILIENCE.md "Role transitions").
+
 Multi-node (reference engines.rs:31-44 MultiNodeConfig): ``--num-nodes N
 --node-rank R`` alone coordinates a per-host replica group over the
 leader/worker barrier. With ``JAX_COORDINATOR_ADDRESS=host:port`` it
@@ -233,6 +239,110 @@ def _chunk_arg(value) -> int | str:
     return n
 
 
+def make_profile_builder(runtime, args, engine, engine_cfg, tokenizer,
+                         model_name, plane, prefill_component):
+    """Per-role serving profiles around ONE engine (llm/reconfig.py).
+
+    The engine object — weights, KV pool, compiled programs — lives
+    outside the profile and survives role flips; a flip only swaps what
+    this worker REGISTERS and which role-specific machinery (prefill
+    queue worker, disagg client + config watch, queue dispatcher) runs
+    around it. This is the factory both launch (initial ``--mode``) and
+    the SetRole protocol build through, so a flipped-to role is
+    byte-for-byte the role it would have launched as.
+    """
+    from dynamo_tpu.llm.disagg import (
+        PREFILL_ENDPOINT, DisaggDecodeHandler, DisaggRouterConfig,
+        make_prefill_handler)
+    from dynamo_tpu.llm.model_card import deregister_llm
+    from dynamo_tpu.llm.reconfig import ServingProfile
+
+    async def build(role: str) -> ServingProfile:
+        prof = ServingProfile(role)
+        if role == "prefill":
+            # Prefill workers register under their own component so decode
+            # workers (not the frontend router) discover them; prefill
+            # drains gracefully on shutdown (reference vllm main.py:151-161).
+            endpoint = (runtime.namespace(None).component(prefill_component)
+                        .endpoint(PREFILL_ENDPOINT))
+            server = await endpoint.serve_endpoint(
+                make_prefill_handler(engine, plane=plane),
+                graceful_shutdown=True)
+            prof.add_server(server)
+            if plane is not None:
+                # Also pull from the shared prefill queue (queue dispatch
+                # needs the data plane for the reply ticket): serving both
+                # paths lets direct- and queue-mode decode workers share
+                # one prefill pool. A drain pauses the pull loop first so
+                # queued prompts go to peers.
+                from dynamo_tpu.llm.prefill_queue import QueuePrefillWorker
+                queue_worker = QueuePrefillWorker(
+                    engine, runtime.require_coordinator(), model_name,
+                    plane)
+                queue_worker.start()
+                prof.add_pausable(queue_worker)
+                prof.add_closer("prefill-queue", queue_worker.stop)
+            else:
+                log.warning(
+                    "--no-kv-plane: this prefill worker will NOT pull "
+                    "from the shared prefill queue (queue replies carry "
+                    "data-plane tickets); queue-mode decode workers need "
+                    "at least one plane-enabled prefill worker")
+            return prof
+        if role == "decode":
+            prefill_ep = (runtime.namespace(None)
+                          .component(prefill_component)
+                          .endpoint(PREFILL_ENDPOINT))
+            prefill_client = await prefill_ep.client()
+            disagg_cfg = await DisaggRouterConfig.from_coordinator_with_watch(
+                runtime.require_coordinator(), model_name,
+                default_max_local=args.max_local_prefill_length)
+            disagg_handler = DisaggDecodeHandler(engine, prefill_client,
+                                                 disagg_cfg)
+            if args.prefill_dispatch == "queue":
+                from dynamo_tpu.llm.prefill_queue import (
+                    QueuePrefillDispatcher)
+                # Share the handler's plane client: one TCP connection
+                # cache per prefill worker, one close at teardown.
+                disagg_handler.queue_dispatcher = QueuePrefillDispatcher(
+                    runtime.require_coordinator(), model_name,
+                    disagg_handler.plane_client,
+                    max_queue_depth=args.max_prefill_queue_depth)
+            handler = disagg_handler.handler()
+            prof.add_closer("prefill-client", prefill_client.close)
+            prof.add_closer("disagg-config", disagg_cfg.close)
+
+            async def _close_plane_client(h=disagg_handler):
+                h.plane_client.close()
+
+            prof.add_closer("plane-client", _close_plane_client)
+        else:
+            handler = engine.handler()
+        endpoint = (runtime.namespace(None).component(args.component)
+                    .endpoint(args.endpoint))
+        server = await endpoint.serve_endpoint(handler,
+                                               graceful_shutdown=False)
+        prof.add_server(server)
+        await register_llm(
+            runtime, endpoint, model_name, tokenizer,
+            context_length=engine_cfg.max_model_len,
+            kv_cache_block_size=engine_cfg.page_size,
+            migration_limit=args.migration_limit,
+            tool_call_parser=args.tool_call_parser,
+            reasoning_parser=args.reasoning_parser,
+            runtime_config=ModelRuntimeConfig(
+                total_kv_blocks=engine.runner.num_pages,
+                max_num_seqs=engine_cfg.max_num_seqs,
+                # The frontend's audio encoder projects to this width
+                # (mm_embeds spans must match the model hidden size).
+                extra={"hidden_size": engine_cfg.model.hidden_size}))
+        prof.add_closer("model-card",
+                        lambda: deregister_llm(runtime, model_name))
+        return prof
+
+    return build
+
+
 async def run(args: argparse.Namespace) -> None:
     cfg = RuntimeConfig.from_settings()
     if args.coordinator_url:
@@ -338,11 +448,8 @@ async def run(args: argparse.Namespace) -> None:
                  "num_pages": engine.runner.num_pages})
             log.info("multihost leader: %d followers in lockstep",
                      args.num_nodes - 1)
-        from dynamo_tpu.llm.disagg import (
-            PREFILL_COMPONENT, PREFILL_ENDPOINT, DisaggDecodeHandler,
-            DisaggRouterConfig, make_prefill_handler)
+        from dynamo_tpu.llm.disagg import PREFILL_COMPONENT
         prefill_component = args.prefill_component or PREFILL_COMPONENT
-        disagg_handler = None
         # Direct KV data plane (the NIXL role): every worker runs the
         # server side — prefill workers stage parcels on it, and any
         # worker with host tiers serves G4 remote-tier block fetches.
@@ -411,79 +518,20 @@ async def run(args: argparse.Namespace) -> None:
                                     "retry")
 
             peer_watch_task = asyncio.create_task(watch_peers())
-        queue_worker = None
-        if args.mode == "prefill":
-            # Prefill workers register under their own component so decode
-            # workers (not the frontend router) discover them; prefill
-            # drains gracefully on shutdown (reference vllm main.py:151-161).
-            endpoint = (runtime.namespace(None).component(prefill_component)
-                        .endpoint(PREFILL_ENDPOINT))
-            server = await endpoint.serve_endpoint(
-                make_prefill_handler(engine, plane=plane),
-                graceful_shutdown=True)
-            if plane is not None:
-                # Also pull from the shared prefill queue (queue dispatch
-                # needs the data plane for the reply ticket): serving both
-                # paths lets direct- and queue-mode decode workers share
-                # one prefill pool.
-                from dynamo_tpu.llm.prefill_queue import QueuePrefillWorker
-                queue_worker = QueuePrefillWorker(
-                    engine, runtime.require_coordinator(), model_name,
-                    plane)
-                queue_worker.start()
-            else:
-                log.warning(
-                    "--no-kv-plane: this prefill worker will NOT pull "
-                    "from the shared prefill queue (queue replies carry "
-                    "data-plane tickets); queue-mode decode workers need "
-                    "at least one plane-enabled prefill worker")
-        elif args.mode == "decode":
-            prefill_ep = (runtime.namespace(None)
-                          .component(prefill_component)
-                          .endpoint(PREFILL_ENDPOINT))
-            prefill_client = await prefill_ep.client()
-            disagg_cfg = await DisaggRouterConfig.from_coordinator_with_watch(
-                runtime.require_coordinator(), model_name,
-                default_max_local=args.max_local_prefill_length)
-            disagg_handler = DisaggDecodeHandler(engine, prefill_client,
-                                                 disagg_cfg)
-            if args.prefill_dispatch == "queue":
-                if args.no_kv_plane:
-                    raise SystemExit(
-                        "--prefill-dispatch queue needs the KV data plane "
-                        "(queue replies carry plane tickets); drop "
-                        "--no-kv-plane or use --prefill-dispatch direct")
-                from dynamo_tpu.llm.prefill_queue import (
-                    QueuePrefillDispatcher)
-                # Share the handler's plane client: one TCP connection
-                # cache per prefill worker, one close at shutdown.
-                disagg_handler.queue_dispatcher = QueuePrefillDispatcher(
-                    runtime.require_coordinator(), model_name,
-                    disagg_handler.plane_client,
-                    max_queue_depth=args.max_prefill_queue_depth)
-            endpoint = (runtime.namespace(None).component(args.component)
-                        .endpoint(args.endpoint))
-            server = await endpoint.serve_endpoint(disagg_handler.handler(),
-                                                   graceful_shutdown=False)
-        else:
-            endpoint = (runtime.namespace(None).component(args.component)
-                        .endpoint(args.endpoint))
-            server = await endpoint.serve_endpoint(engine.handler(),
-                                                   graceful_shutdown=False)
-        if args.mode != "prefill":
-            await register_llm(
-                runtime, endpoint, model_name, tokenizer,
-                context_length=engine_cfg.max_model_len,
-                kv_cache_block_size=engine_cfg.page_size,
-                migration_limit=args.migration_limit,
-                tool_call_parser=args.tool_call_parser,
-                reasoning_parser=args.reasoning_parser,
-                runtime_config=ModelRuntimeConfig(
-                    total_kv_blocks=engine.runner.num_pages,
-                    max_num_seqs=engine_cfg.max_num_seqs,
-                    # The frontend's audio encoder projects to this width
-                    # (mm_embeds spans must match the model hidden size).
-                    extra={"hidden_size": engine_cfg.model.hidden_size}))
+        if args.prefill_dispatch == "queue" and args.no_kv_plane:
+            raise SystemExit(
+                "--prefill-dispatch queue needs the KV data plane "
+                "(queue replies carry plane tickets); drop "
+                "--no-kv-plane or use --prefill-dispatch direct")
+        from dynamo_tpu.llm.reconfig import RoleManager
+        roles = RoleManager(
+            runtime,
+            make_profile_builder(runtime, args, engine, engine_cfg,
+                                 tokenizer, model_name, plane,
+                                 prefill_component),
+            role=args.mode,
+            status_extra={"backend": "tpu", "model": model_name})
+        await roles.start()
         engine.start()
         # Observability plane (docs/OBSERVABILITY.md): flight-recorder
         # bundle context for THIS worker, and the per-worker system
@@ -501,9 +549,11 @@ async def run(args: argparse.Namespace) -> None:
         if cfg.system_enabled:
             from dynamo_tpu.runtime.health import SystemStatusServer
             status_server = SystemStatusServer(runtime, host=cfg.bind_host,
-                                               port=cfg.system_port)
+                                               port=cfg.system_port,
+                                               role_manager=roles)
             await status_server.start()
-        print(f"TPU_WORKER_READY mode={args.mode} port={server.port} "
+        port = roles.profile.servers[0].port if roles.profile.servers else 0
+        print(f"TPU_WORKER_READY mode={args.mode} port={port} "
               f"worker={runtime.instance_id:x} pages={engine.runner.num_pages}",
               flush=True)
         import signal
@@ -531,19 +581,18 @@ async def run(args: argparse.Namespace) -> None:
                 # Coordinator already gone (whole-deployment teardown);
                 # followers exit with it.
                 pass
-        await server.shutdown()
+        # The role manager owns the serving profile: endpoint servers and
+        # role-specific machinery (queue workers, disagg clients/watches)
+        # all tear down through it, whatever role we ended up in.
+        await roles.stop()
         if status_server is not None:
             await status_server.stop()
-        if queue_worker is not None:
-            await queue_worker.stop()
         if peer_watch_task is not None:
             peer_watch_task.cancel()
         if plane is not None:
             if engine.remote_source is not None:
                 engine.remote_source.client.close()
             plane.close()
-        if disagg_handler is not None:
-            disagg_handler.plane_client.close()
     finally:
         await runtime.close()
 

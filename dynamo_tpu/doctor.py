@@ -9,6 +9,10 @@ prints OK/WARN/FAIL per check. The TPU-native equivalent probes:
 - the native extension toolchain (C++ radix index builds/loads)
 - coordinator connectivity + KV/queue/pub-sub round-trips + latency
 - registered models and live endpoint instances (with TCP reachability)
+- disaggregation roles: each worker's current role / drain state / last
+  flip outcome from the role status plane (llm/reconfig.py), WARNing on
+  workers stuck mid-transition or a fleet with zero prefill-capable
+  workers
 - an HTTP frontend, when given (``/health``, ``/v1/models``)
 - the observability plane on that frontend: ``/metrics`` exposition
   (FAIL when unreachable), ``/debug/slo`` (WARN when no SLO targets are
@@ -156,12 +160,64 @@ async def check_coordinator(rep: Report, url: str) -> None:
         if disagg:
             rep.add(OK, "disagg config",
                     "; ".join(f"{d['k']}={d['v']}" for d in disagg))
+        check_roles(rep, await client.kv_get_prefix("rolestatus/"))
     except (ConnectionError, OSError, asyncio.TimeoutError) as exc:
         # Coordinator died mid-check: report it, keep the doctor alive so
         # later checks (frontend) still run.
         rep.add(FAIL, "coordinator", f"lost mid-check: {exc}")
     finally:
         await client.close()
+
+
+#: A worker reporting draining/flipping longer than this is stuck — the
+#: drain window (retire_drain_s default 30s) is far smaller.
+ROLE_STUCK_S = 120.0
+
+
+def check_roles(rep: Report, items: list[dict]) -> None:
+    """Disaggregation role report (llm/reconfig.py fleet view): each
+    worker's current role, drain state, and last flip outcome; WARN on a
+    fleet stuck mid-transition or with zero prefill-capable workers."""
+    statuses = [it["v"] for it in items if isinstance(it.get("v"), dict)]
+    if not statuses:
+        return  # fixed-role deployment: nothing to report
+    now = time.time()
+    stuck, failed = [], []
+    for s in statuses:
+        role, state = s.get("role", "?"), s.get("state", "?")
+        detail = f"role={role} state={state} epoch={s.get('epoch', 0)}"
+        last = s.get("last_outcome") or {}
+        if last:
+            detail += (f" last_flip={last.get('from')}->{last.get('to')}"
+                       f":{last.get('outcome')}")
+        age = now - float(s.get("ts") or now)
+        if state in ("draining", "flipping") and age > ROLE_STUCK_S:
+            stuck.append(s)
+            rep.add(WARN, f"worker role {s.get('worker', '?')}",
+                    f"{detail} — stuck {state} for {age:.0f}s")
+            continue
+        if last.get("outcome") not in (None, "ok", "noop", "duplicate"):
+            failed.append(s)
+            rep.add(WARN, f"worker role {s.get('worker', '?')}",
+                    f"{detail} — last flip did not converge cleanly")
+            continue
+        rep.add(OK, f"worker role {s.get('worker', '?')}", detail)
+    prefill_capable = sum(1 for s in statuses
+                          if s.get("role") in ("prefill", "agg")
+                          and s.get("state") == "serving")
+    decode_capable = sum(1 for s in statuses
+                         if s.get("role") in ("decode", "agg")
+                         and s.get("state") == "serving")
+    if prefill_capable == 0:
+        rep.add(WARN, "role fleet", "zero prefill-capable workers serving: "
+                "remote prefill degrades to local everywhere")
+    elif decode_capable == 0:
+        rep.add(WARN, "role fleet", "zero decode-capable workers serving: "
+                "no registered model endpoint can answer")
+    else:
+        rep.add(OK, "role fleet",
+                f"{prefill_capable} prefill-capable / {decode_capable} "
+                f"decode-capable of {len(statuses)} workers")
 
 
 async def check_frontend(rep: Report, url: str) -> None:
@@ -237,6 +293,15 @@ async def check_observability(rep: Report, url: str) -> None:
                             if meta.get("enabled")
                             else "flight recorder disabled "
                             "(DTPU_FLIGHT_CAPACITY=0)")
+            async with session.get(f"{url}/control/role",
+                                   timeout=aiohttp.ClientTimeout(5)) as r:
+                if r.status == 200:
+                    role = await r.json()
+                    rep.add(OK, "/control/role",
+                            f"role={role.get('role')} "
+                            f"state={role.get('state')} "
+                            f"epoch={role.get('epoch')}")
+                # 404 = a frontend or a fixed-role worker: not an error.
             async with session.get(f"{url}/debug/traces/recent",
                                    timeout=aiohttp.ClientTimeout(5)) as r:
                 if r.status != 200:

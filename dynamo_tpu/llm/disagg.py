@@ -59,7 +59,12 @@ class DisaggRouterConfig:
     def __init__(self, max_local_prefill_length: int = 512):
         self.max_local_prefill_length = max_local_prefill_length
         self._watch = None
+        self._client = None
+        self._key: str | None = None
         self._task: asyncio.Task | None = None
+        # Observable recovery count (tests + debugging): how many times
+        # the watch loop survived a failure and re-established itself.
+        self.watch_restarts = 0
 
     def prefill_remote(self, prompt_len: int) -> bool:
         return prompt_len > self.max_local_prefill_length
@@ -69,8 +74,9 @@ class DisaggRouterConfig:
             cls, client, model_name: str,
             default_max_local: int = 512) -> "DisaggRouterConfig":
         cfg = cls(default_max_local)
-        key = disagg_config_key(model_name)
-        watch = await client.watch_prefix(key)
+        cfg._client = client
+        cfg._key = disagg_config_key(model_name)
+        watch = await client.watch_prefix(cfg._key)
         for item in watch.snapshot:
             cfg._apply(item["v"])
         cfg._watch = watch
@@ -85,9 +91,41 @@ class DisaggRouterConfig:
                      self.max_local_prefill_length)
 
     async def _watch_loop(self) -> None:
-        async for event in self._watch:
-            if event["event"] == "put":
-                self._apply(event["value"])
+        """Apply config puts until cancelled. Must never die silently: a
+        dead watch freezes the conditional-disagg threshold at its last
+        value for the life of the worker — so any failure (a malformed
+        value raising in _apply, a watch lost to a coordinator restart
+        the client could not replay) re-establishes the watch under the
+        unified retry policy (runtime/retry.py) instead of returning."""
+        from dynamo_tpu.runtime.retry import Backoff, policies
+        backoff = Backoff(policies.COORD_RECONNECT)
+        while True:
+            try:
+                async for event in self._watch:
+                    if event["event"] != "put":
+                        continue
+                    try:
+                        self._apply(event["value"])
+                    except (TypeError, ValueError):
+                        log.warning("malformed disagg config ignored: %r",
+                                    event["value"])
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — survive, re-watch
+                log.exception("disagg config watch failed; re-watching")
+            await backoff.sleep()
+            try:
+                self._watch = await self._client.watch_prefix(self._key)
+                for item in self._watch.snapshot:
+                    try:
+                        self._apply(item["v"])
+                    except (TypeError, ValueError):
+                        log.warning("malformed disagg config ignored: %r",
+                                    item["v"])
+                self.watch_restarts += 1
+                backoff.reset()
+            except (ConnectionError, OSError, RuntimeError):
+                log.warning("disagg config re-watch failed; will retry")
 
     async def close(self) -> None:
         if self._task:

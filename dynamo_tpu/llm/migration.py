@@ -79,6 +79,14 @@ class Migration(Operator):
                 # Per-request attribution: the accounting record
                 # (llm/recorder.py) reads this off the frontend-side ctx.
                 context.values["migrations"] = attempt
+                # The worker may have declared WHY the stream ended (a
+                # role-flip drain sends "incomplete:role_flip"): a typed
+                # reason beats the generic disconnect, and the strongest
+                # reason seen wins across repeated migrations so a
+                # follow-up plain disconnect can't erase the attribution.
+                if exc.reason or "migration_reason" not in context.values:
+                    context.values["migration_reason"] = (exc.reason
+                                                          or "disconnect")
                 if self._m_migrations is not None:
                     self._m_migrations.inc()
                 log.warning(

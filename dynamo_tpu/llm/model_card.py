@@ -147,12 +147,36 @@ async def register_llm(
     # process stalls past the TTL during engine compilation) the coordinator
     # deletes it — re-put on re-grant so the model doesn't silently vanish
     # from discovery (the endpoint instance re-registers the same way,
-    # runtime/service.py).
+    # runtime/service.py). The _active guard lets deregister_llm retire
+    # the replay: a worker that role-flipped away from decode must not
+    # resurrect its model card on the next lease regrant.
+    _active_cards.add(key)
+
     async def _reput(_new_lease_id: int) -> None:
-        await client.kv_put(key, entry.to_wire(), use_primary_lease=True)
+        if key in _active_cards:
+            await client.kv_put(key, entry.to_wire(), use_primary_lease=True)
 
     client.on_lease_recreated(_reput)
     return entry
+
+
+#: Model-card keys this process still serves; deregister_llm removes a
+#: key so lease-recreated replays stop re-putting it.
+_active_cards: set = set()
+
+
+async def deregister_llm(runtime, model_name: str) -> None:
+    """Remove this worker's model-card registration (role flips away from
+    decode/agg: the frontend must drop this instance from the model's
+    set instead of routing into a prefill-only worker)."""
+    key = f"{MODEL_ROOT}{model_slug(model_name)}/{runtime.instance_id:x}"
+    _active_cards.discard(key)
+    try:
+        await runtime.require_coordinator().kv_delete(key)
+    except (ConnectionError, OSError, RuntimeError):
+        # Coordinator down: the key rides our lease and the replay guard
+        # above is already cleared, so it cannot come back.
+        pass
 
 
 async def fetch_tokenizer(client, card: ModelDeploymentCard) -> Tokenizer:

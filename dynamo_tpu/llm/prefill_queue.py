@@ -54,10 +54,20 @@ class QueuePrefillWorker:
         self.poll_timeout = poll_timeout
         self.pulled = 0
         self.failed = 0
+        self._paused = False
         self._task: asyncio.Task | None = None
 
     def start(self) -> None:
         self._task = asyncio.create_task(self._loop())
+
+    def pause(self) -> None:
+        """Stop pulling NEW queue work (a draining worker — role flip or
+        retire — must leave queued prompts to its peers; the item being
+        served finishes normally). Idempotent."""
+        self._paused = True
+
+    def resume(self) -> None:
+        self._paused = False
 
     async def stop(self) -> None:
         if self._task is not None:
@@ -76,6 +86,9 @@ class QueuePrefillWorker:
     async def _loop(self) -> None:
         backoff = Backoff(policies.QUEUE_POP)
         while True:
+            if self._paused:
+                await asyncio.sleep(self.poll_timeout)
+                continue
             try:
                 item = await self.client.queue_pop(
                     self.queue, timeout=self.poll_timeout)

@@ -302,6 +302,7 @@ def make_account(route: str, model: str, ctx=None) -> dict:
         "duration_s": None,
         "worker_id": None,
         "migrations": 0,
+        "migration_reason": None,
         "brownout_level": 0,
         "_t0": time.monotonic(),   # stripped at finish
         "_itls": [],               # raw gaps; folded to p50/p99 at finish
@@ -323,8 +324,8 @@ def finish_account(acct: dict, status: str, reason: str | None = None,
     acct["itl_p99_s"] = _percentile(gaps, 0.99)
     if ctx is not None:
         values = getattr(ctx, "values", {})
-        for key in ("worker_id", "migrations", "reuse_tokens",
-                    "kv_hit_ratio", "queue_wait_s"):
+        for key in ("worker_id", "migrations", "migration_reason",
+                    "reuse_tokens", "kv_hit_ratio", "queue_wait_s"):
             if values.get(key) is not None:
                 acct[key] = values[key]
     (ledger or get_ledger()).record(acct)

@@ -19,9 +19,15 @@ from dynamo_tpu.planner.profiler import (
     choose_capacity,
     profile_sweep,
 )
+from dynamo_tpu.planner.reconfig import (
+    ReconfigConfig,
+    RoleReconfigurator,
+    apply_reconfig_env,
+)
 
 __all__ = [
     "Connector", "FakeConnector", "Planner", "PlannerConfig", "PoolState",
     "ConstantPredictor", "LinearTrendPredictor", "MovingAveragePredictor",
     "make_predictor", "choose_capacity", "profile_sweep",
+    "ReconfigConfig", "RoleReconfigurator", "apply_reconfig_env",
 ]

@@ -18,6 +18,7 @@ import asyncio
 
 from dynamo_tpu.planner.connector import FakeConnector
 from dynamo_tpu.planner.core import Planner, PlannerConfig
+from dynamo_tpu.planner.reconfig import ReconfigConfig, apply_reconfig_env
 from dynamo_tpu.runtime.config import RuntimeConfig
 from dynamo_tpu.runtime.distributed import DistributedRuntime
 from dynamo_tpu.runtime.logging import get_logger
@@ -47,6 +48,13 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="kube connector: API server base URL override "
                         "(default: in-cluster env)")
     p.add_argument("--coordinator-url", default=None)
+    p.add_argument("--model-name", default=None,
+                   help="served model name: enables the prefill-queue "
+                        "depth signal for --reconfig")
+    p.add_argument("--reconfig", action="store_true",
+                   help="drive live prefill/decode role flips from SLO "
+                        "pressure + prefill-queue depth (knobs via "
+                        "DTPU_PLANNER_RECONFIG_*; llm/reconfig.py)")
     return p.parse_args(argv)
 
 
@@ -79,6 +87,9 @@ async def run(args: argparse.Namespace) -> None:
             prefill_capacity_tok_s=args.prefill_capacity_tok_s,
             min_replicas=args.min_replicas,
             max_replicas=args.max_replicas,
+            model_name=args.model_name,
+            reconfig=apply_reconfig_env(
+                ReconfigConfig(enabled=args.reconfig)),
         ), connector, runtime=runtime)
         await planner.start()
         print(f"PLANNER_READY connector={args.connector} "

@@ -12,6 +12,12 @@ interval ahead, and asks the connector for replica counts:
 
 Guard rails mirror the reference: min/max replica bounds, scale-down
 hysteresis, and an adjustment cooldown so decisions don't flap.
+
+Beyond replica counts, the planner can also re-partition a FIXED pool:
+with ``reconfig.enabled`` it drives live prefill/decode role flips from
+the SLO plane's pressure signal and the prefill-queue depth
+(planner/reconfig.py; worker protocol in llm/reconfig.py) — the
+runtime-reconfigurable xPyD story (PAPER.md §0 capability #1).
 """
 
 from __future__ import annotations
@@ -25,6 +31,7 @@ from dynamo_tpu.llm.kv_router.protocols import (ForwardPassMetrics,
                                                 load_metrics_subject)
 from dynamo_tpu.planner.connector import Connector
 from dynamo_tpu.planner.predictors import make_predictor
+from dynamo_tpu.planner.reconfig import ReconfigConfig, RoleReconfigurator
 from dynamo_tpu.runtime.logging import get_logger
 
 log = get_logger("planner")
@@ -48,6 +55,13 @@ class PlannerConfig:
     max_replicas: int = 8
     # Consecutive under-loaded intervals required before scaling down.
     scale_down_patience: int = 3
+    # Served model name: enables the prefill-queue depth signal for role
+    # reconfiguration (queue_name(model_name) on the coordinator).
+    model_name: str | None = None
+    # Live role-flip decisions (planner/reconfig.py); knobs overridable
+    # via DTPU_PLANNER_RECONFIG_<FIELD>.
+    reconfig: ReconfigConfig = dataclasses.field(
+        default_factory=ReconfigConfig)
 
 
 class PoolState:
@@ -86,6 +100,9 @@ class Planner:
         self._subs: list = []
         self._tasks: list[asyncio.Task] = []
         self.decisions: list[dict] = []
+        # Role-flip loop: constructed in start() (needs the coordinator),
+        # or injected directly by tests / embedded deployments.
+        self.reconfigurator: RoleReconfigurator | None = None
 
     # -- metrics intake -------------------------------------------------------
     async def start(self) -> None:
@@ -101,7 +118,27 @@ class Planner:
                 load_metrics_subject(cfg.namespace, comp))
             self._subs.append(sub)
             self._tasks.append(asyncio.create_task(self._intake(sub, pool)))
+        if cfg.reconfig.enabled and self.reconfigurator is None:
+            self.reconfigurator = RoleReconfigurator(
+                client, cfg.namespace, cfg.reconfig,
+                pressure_fn=self._slo_pressure,
+                queue_depth_fn=(self._queue_depth
+                                if cfg.model_name else None))
         self._tasks.append(asyncio.create_task(self._loop()))
+
+    @staticmethod
+    def _slo_pressure():
+        """Default pressure source: the process-global SLO plane (level 0
+        when no targets are configured — reconfig then rides the queue
+        signal alone)."""
+        from dynamo_tpu.runtime import slo
+        plane = slo.get_plane()
+        return plane.pressure() if plane.enabled else None
+
+    async def _queue_depth(self) -> int:
+        from dynamo_tpu.llm.prefill_queue import queue_name
+        client = self._runtime.require_coordinator()
+        return await client.queue_len(queue_name(self.config.model_name))
 
     async def stop(self) -> None:
         for t in self._tasks:
@@ -171,8 +208,20 @@ class Planner:
             "decode", cfg.decode_component, snap,
             snap["active"] + snap["waiting"], self.decode.load_pred,
             cfg.max_num_seqs_per_worker * cfg.target_utilization)
+        reconfig_record = None
+        if self.reconfigurator is not None and self.config.reconfig.enabled:
+            try:
+                reconfig_record = await self.reconfigurator.step()
+                self.decisions.append(reconfig_record)
+            except (ConnectionError, OSError, RuntimeError):
+                # The scaling half of the step must survive a flaky
+                # control plane; the next interval retries.
+                log.warning("role reconfig step failed", exc_info=True)
         if self.prefill is None:
-            return {"decode": record}
+            out = {"decode": record}
+            if reconfig_record is not None:
+                out["reconfig"] = reconfig_record
+            return out
         psnap = self.prefill.snapshot()
         # Prefill demand proxy: queued-request pressure (LIVE workers only
         # — dead workers' last metrics must not inflate demand forever)
@@ -182,4 +231,7 @@ class Planner:
         precord = await self._decide(
             "prefill", cfg.prefill_component, psnap, ptok,
             self.prefill.tok_pred, cfg.prefill_capacity_tok_s)
-        return {"decode": record, "prefill": precord}
+        out = {"decode": record, "prefill": precord}
+        if reconfig_record is not None:
+            out["reconfig"] = reconfig_record
+        return out

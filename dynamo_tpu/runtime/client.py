@@ -359,12 +359,20 @@ class EndpointClient:
                             f"(final after #{expected_seq} of {seq} frames)")
                     return
                 elif kind == "err":
-                    if payload == "incomplete":
+                    if isinstance(payload, str) and (
+                            payload == "incomplete"
+                            or payload.startswith("incomplete:")):
+                        # "incomplete[:reason]": the worker declared the
+                        # stream dead (drain kill, handler GeneratorExit).
+                        # The optional reason ("role_flip") rides the
+                        # typed error into migration attribution.
+                        _, _, why = payload.partition(":")
                         failed = True
                         breakers.record_failure(iid)
-                        raise StreamIncompleteError()
+                        raise StreamIncompleteError(reason=why or None)
                     from dynamo_tpu.runtime.errors import (
-                        InvalidRequestError, RateLimitedError)
+                        InvalidRequestError, RateLimitedError,
+                        RoleTransitionError)
                     # Wire-typed errors: decode every class that carries
                     # a WIRE_PREFIX so HTTP status / retry semantics
                     # survive remote deployment. One explicit branch per
@@ -379,6 +387,11 @@ class EndpointClient:
                         if payload.startswith(RateLimitedError.WIRE_PREFIX):
                             raise RateLimitedError(
                                 payload[len(RateLimitedError.WIRE_PREFIX):])
+                        if payload.startswith(RoleTransitionError.WIRE_PREFIX):
+                            # Control-verb fencing rejection: the caller's
+                            # fault (stale epoch), not worker health.
+                            raise RoleTransitionError(
+                                payload[len(RoleTransitionError.WIRE_PREFIX):])
                         if payload.startswith(OverloadedError.WIRE_PREFIX):
                             # Saturated worker: a breaker failure signal
                             # so selection steers away while it drains.

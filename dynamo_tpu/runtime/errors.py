@@ -24,8 +24,14 @@ class StreamIncompleteError(EngineError):
 
     retryable = True
 
-    def __init__(self, message: str = "Stream ended before generation completed"):
+    def __init__(self, message: str = "Stream ended before generation completed",
+                 reason: str | None = None):
         super().__init__(message)
+        #: Why the stream ended early, when the worker said so before
+        #: dying — e.g. "role_flip" from a drain (llm/reconfig.py). The
+        #: Migration operator copies it into the request context so the
+        #: accounting ledger can attribute the migration cost.
+        self.reason = reason
 
 
 class NoInstancesError(EngineError):
@@ -68,6 +74,19 @@ class RateLimitedError(EngineError):
                  retry_after_s: float | None = None):
         super().__init__(message)
         self.retry_after_s = retry_after_s
+
+
+class RoleTransitionError(EngineError):
+    """A ``SetRole`` control verb was rejected by the worker's role state
+    machine (llm/reconfig.py): stale/duplicate epoch (a reordered or
+    replayed directive fenced out), an unknown role, or a flip already in
+    flight. NOT retryable as-is — the caller must re-read the worker's
+    role status and issue a fresh, higher-epoch directive. Wire-prefixed
+    so the typed rejection survives the request plane (the planner or an
+    operator may drive flips through a remote control path)."""
+
+    WIRE_PREFIX = "role_transition: "
+    retryable = False
 
 
 class InvalidRequestError(EngineError):

@@ -113,11 +113,15 @@ async def _debug_profile(request: web.Request) -> web.Response:
 
 
 class SystemStatusServer:
-    def __init__(self, runtime, host: str = "0.0.0.0", port: int = 0):
+    def __init__(self, runtime, host: str = "0.0.0.0", port: int = 0,
+                 role_manager=None):
         self._runtime = runtime
         self.host, self.port = host, port
         self._endpoint_health: dict[str, bool] = {}
         self._runner: web.AppRunner | None = None
+        # llm/reconfig.RoleManager: enables the SetRole control verb on
+        # this worker's status path (GET/POST /control/role).
+        self.role_manager = role_manager
 
     def set_endpoint_health(self, endpoint_path: str, healthy: bool) -> None:
         self._endpoint_health[endpoint_path] = healthy
@@ -127,6 +131,8 @@ class SystemStatusServer:
         app.router.add_get("/health", self._health)
         app.router.add_get("/live", self._live)
         app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/control/role", self._role_status)
+        app.router.add_post("/control/role", self._role_set)
         add_debug_routes(app)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
@@ -153,3 +159,40 @@ class SystemStatusServer:
     async def _metrics(self, _request: web.Request) -> web.Response:
         return web.Response(body=self._runtime.metrics.expose(),
                             content_type="text/plain")
+
+    # -- SetRole control verb (llm/reconfig.py; docs/RESILIENCE.md) -----------
+    async def _role_status(self, _request: web.Request) -> web.Response:
+        if self.role_manager is None:
+            return web.json_response(
+                {"error": "no role manager on this worker"}, status=404)
+        return web.json_response(self.role_manager.status())
+
+    async def _role_set(self, request: web.Request) -> web.Response:
+        """POST /control/role {"role": "prefill", "epoch": 7} — the
+        operator-facing SetRole verb. Fencing rejections (stale epoch,
+        flip in flight) answer 409 with the typed error; the epoch is
+        REQUIRED so a replayed curl can't accidentally re-flip."""
+        from dynamo_tpu.runtime.errors import RoleTransitionError
+        if self.role_manager is None:
+            return web.json_response(
+                {"error": "no role manager on this worker"}, status=404)
+        try:
+            body = await request.json()
+        except (json.JSONDecodeError, ValueError):
+            return web.json_response({"error": "invalid JSON body"},
+                                     status=400)
+        role = body.get("role")
+        epoch = body.get("epoch")
+        if not isinstance(role, str) or not isinstance(epoch, int):
+            return web.json_response(
+                {"error": "body must carry role:str and epoch:int "
+                 "(epoch must be above the applied epoch in "
+                 "GET /control/role)"}, status=400)
+        try:
+            outcome = await self.role_manager.set_role(
+                role, epoch, issued_by=str(body.get("issued_by", "http")),
+                drain_s=body.get("drain_s"))
+        except RoleTransitionError as exc:
+            return web.json_response(
+                {"error": str(exc), "type": "role_transition"}, status=409)
+        return web.json_response(outcome)

@@ -19,7 +19,7 @@ from typing import Any, AsyncIterator, Callable
 from dynamo_tpu.runtime.component import Endpoint, Instance
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.errors import (InvalidRequestError, OverloadedError,
-                                       RateLimitedError)
+                                       RateLimitedError, RoleTransitionError)
 from dynamo_tpu.runtime.frame import read_frame, write_frame
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.runtime.tracing import span
@@ -40,6 +40,10 @@ class EndpointServer:
         self._conn_writers: set[asyncio.StreamWriter] = set()
         self._inflight: dict[str, tuple[asyncio.Task, Context]] = {}
         self._stopping = asyncio.Event()
+        # Why this server is draining ("role_flip", ...): rides the
+        # typed incomplete frames so the client's migration layer can
+        # attribute the retry cost (llm/recorder.py migration_reason).
+        self._drain_reason: str | None = None
         self.metrics_labels = metrics_labels or {}
         self.instance: Instance | None = None
         comp = endpoint.component
@@ -73,7 +77,14 @@ class EndpointServer:
             # Registration rides the primary lease: process death => lease
             # expiry => delete event => clients drop us (SURVEY.md §5.3).
             # metrics_labels travel with the registration for scrapers/planner.
-            await self._register()
+            try:
+                await self._register()
+            except BaseException:
+                # Registration failed (coordinator down mid-role-flip):
+                # release the listening socket so the caller's retry
+                # doesn't leak one bound server per attempt.
+                self._server.close()
+                raise
             self._runtime.coordinator_client.on_lease_recreated(
                 self._on_lease_recreated)
         log.info("endpoint %s serving as instance %x on %s:%d",
@@ -116,7 +127,8 @@ class EndpointServer:
                     rid = msg["rid"]
                     if self._stopping.is_set():
                         # Draining: refuse new work so callers retry elsewhere.
-                        await send({"t": "err", "rid": rid, "e": "incomplete"})
+                        await send({"t": "err", "rid": rid,
+                                    "e": self._incomplete_wire()})
                         continue
                     ctx = Context.from_wire(msg.get("ctx"))
                     ctx.values["request_id"] = rid
@@ -142,6 +154,14 @@ class EndpointServer:
                 task.cancel()
             self._conn_writers.discard(writer)
             writer.close()
+
+    def _incomplete_wire(self) -> str:
+        """The incomplete-stream wire token, carrying the drain reason
+        when one is set ("incomplete:role_flip"). The client splits on
+        ':' and surfaces the suffix as StreamIncompleteError.reason."""
+        if self._drain_reason:
+            return f"incomplete:{self._drain_reason}"
+        return "incomplete"
 
     async def _run_request(self, rid: str, request: Any, ctx: Context,
                            send) -> None:
@@ -169,10 +189,26 @@ class EndpointServer:
                                 "s": seq})
                     seq += 1
             if ctx.is_killed:
-                await send({"t": "err", "rid": rid, "e": "killed"})
+                # A kill issued by our own drain (shutdown) is an
+                # incomplete stream — the caller should migrate it — not
+                # a client-initiated kill echo.
+                await send({"t": "err", "rid": rid,
+                            "e": (self._incomplete_wire()
+                                  if self._stopping.is_set() else "killed")})
             else:
                 await send({"t": "final", "rid": rid, "s": seq})
         except asyncio.CancelledError:
+            if self._stopping.is_set():
+                # Drain deadline hit (shutdown cancelled us): send the
+                # typed incomplete frame — with the drain reason — so the
+                # caller's migration layer re-issues immediately and can
+                # attribute the retry, instead of waiting for TCP close.
+                self._m_errors.inc()
+                try:
+                    await send({"t": "err", "rid": rid,
+                                "e": self._incomplete_wire()})
+                except (ConnectionError, OSError):
+                    pass
             raise
         except (ValueError, InvalidRequestError) as exc:
             # Engine request validation (raised as ValueError by the
@@ -203,6 +239,16 @@ class EndpointServer:
                             "e": f"{RateLimitedError.WIRE_PREFIX}{exc}"})
             except (ConnectionError, OSError):
                 pass
+        except RoleTransitionError as exc:
+            # SetRole control-verb rejection (stale epoch, flip already
+            # in flight): typed so a remote planner/operator sees the
+            # fencing decision, not a generic 500.
+            self._m_errors.inc()
+            try:
+                await send({"t": "err", "rid": rid,
+                            "e": f"{RoleTransitionError.WIRE_PREFIX}{exc}"})
+            except (ConnectionError, OSError):
+                pass
         except GeneratorExit:
             # Handler signals an incomplete stream (migration trigger;
             # reference docs/guides/backend.md §Migrate).
@@ -224,23 +270,49 @@ class EndpointServer:
             self._m_duration.observe(time.monotonic() - started)
             self._inflight.pop(rid, None)
 
-    async def shutdown(self) -> None:
+    async def shutdown(self, drain_s: float | None = None,
+                       reason: str | None = None) -> None:
         """Deregister, then drain (graceful) or cancel (fast) in-flight work.
         Reference: serve_endpoint(graceful_shutdown=...) — decode workers exit
-        fast so streams migrate (vllm main.py:151-161)."""
+        fast so streams migrate (vllm main.py:151-161).
+
+        ``drain_s`` overrides the constructed graceful/fast choice for
+        this call: a positive value drains in-flight streams up to that
+        deadline even on a fast-shutdown server (role flips reuse the
+        retire/migration drain window); streams still running at the
+        deadline are killed with a typed incomplete frame. ``reason``
+        tags those frames ("incomplete:<reason>") so the caller's
+        migration layer can attribute the retry."""
+        self._drain_reason = reason or self._drain_reason
         self._stopping.set()
         if self._runtime.has_discovery and self.instance is not None:
             try:
                 await self._runtime.coordinator_client.kv_delete(self.instance.path)
             except (ConnectionError, RuntimeError):
                 pass
-        if self._graceful:
-            deadline = time.monotonic() + self._runtime.config.shutdown_timeout_s
+        if drain_s is not None:
+            graceful, budget = drain_s > 0, drain_s
+        else:
+            graceful = self._graceful
+            budget = self._runtime.config.shutdown_timeout_s
+        if graceful:
+            deadline = time.monotonic() + budget
             while self._inflight and time.monotonic() < deadline:
                 await asyncio.sleep(0.05)
-        for task, ctx in list(self._inflight.values()):
+        victims = list(self._inflight.values())
+        for task, ctx in victims:
             ctx.kill()
             task.cancel()
+        if victims:
+            # Let the killed handlers flush their typed incomplete frames
+            # (the migration trigger) before the sockets close under
+            # them; bounded so a wedged handler can't stall shutdown.
+            try:
+                await asyncio.wait_for(
+                    asyncio.gather(*(t for t, _ in victims),
+                                   return_exceptions=True), 2.0)
+            except asyncio.TimeoutError:
+                pass
         if self._server:
             self._server.close()
             # Python 3.12 wait_closed() blocks until every connection handler

@@ -22,6 +22,16 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest tests/test_overload.py 
     -q -m 'not slow' -k 'unit' -p no:cacheprovider -p no:xdist \
     -p no:randomly || exit 1
 
+echo "== reconfig smoke (live role flip, zero dropped requests) =="
+# Mocker fleet + one scripted prefill/decode flip under load: asserts
+# every accepted request completes exactly or fails typed, the ledger
+# records zero silent drops, and the fleet converges. The heavier chaos
+# matrix (crash mid-drain, coordinator restart mid-flip) is tier-1;
+# the 5x-overload flip is -m slow.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_reconfig.py -q -m 'not slow' -k 'smoke' \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+
 echo "== chunked-prefill smoke (stall-free scheduling) =="
 # Tiny CPU model: one long prompt prefilling in chunks with concurrent
 # short decoders — asserts completion, decode windows interleaved between
@@ -33,6 +43,8 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
     -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
 
 echo "== tier-1 tests =="
+# (reconfig smoke above covers the scripted role flip; heavier role
+# chaos scenarios run inside tier-1, the 5x-overload flip is -m slow)
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist \

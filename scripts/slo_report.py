@@ -75,6 +75,15 @@ def rollup(records: list[dict], by: list[str]) -> dict[tuple, dict]:
             "prompt_tokens": sum(r.get("prompt_tokens") or 0 for r in recs),
             "output_tokens": sum(r.get("output_tokens") or 0 for r in recs),
             "migrations": sum(r.get("migrations") or 0 for r in recs),
+            # Cost attribution for migrated requests: how many retries
+            # each cause forced (e.g. role_flip drains vs plain worker
+            # disconnects — llm/reconfig.py role transitions).
+            "migration_reasons": dict(sum(
+                (collections.Counter(
+                    {r.get("migration_reason") or "disconnect":
+                     r["migrations"]})
+                 for r in recs if r.get("migrations")),
+                collections.Counter())),
             "reasons": dict(reasons.most_common(5)),
         }
     return out
@@ -100,6 +109,10 @@ def render(table: dict[tuple, dict], by: list[str]) -> str:
         if row["reasons"]:
             reasons = ", ".join(f"{k}={v}" for k, v in row["reasons"].items())
             lines.append(f"{'':<{key_w}}  reasons: {reasons}")
+        if row.get("migration_reasons"):
+            mig = ", ".join(f"{k}={v}"
+                            for k, v in row["migration_reasons"].items())
+            lines.append(f"{'':<{key_w}}  migrations: {mig}")
     return "\n".join(lines) + "\n"
 
 

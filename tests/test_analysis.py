@@ -426,6 +426,25 @@ def test_wire_taxonomy_quiet_when_fully_wired(tmp_path):
     assert found == []
 
 
+def test_wire_taxonomy_covers_backends_raises(tmp_path):
+    """Worker mains (backends/) are engine-side too: an unprefixed
+    EngineError subclass raised there — the SetRole control-verb
+    scenario — must be flagged."""
+    engine = ENGINE_SRC.replace("        raise QuotaError(\"over quota\")\n",
+                                "        pass\n")
+    root = wire_tree(tmp_path, engine_src=engine)
+    backends = tmp_path / "myapp" / "backends"
+    backends.mkdir()
+    (backends / "worker.py").write_text(
+        "from myapp.runtime.errors import QuotaError\n"
+        "def set_role(role):\n"
+        "    raise QuotaError('bad role verb')\n")
+    found = analyze_paths([root], select=["wire-error-taxonomy"])
+    assert len(found) == 1
+    assert "QuotaError" in found[0].message
+    assert found[0].path.endswith("worker.py")
+
+
 def test_wire_taxonomy_flags_missing_decode(tmp_path):
     """Reverting only the client-side decode (the OverloadedError fix
     scenario) must fail the rule."""

@@ -1,0 +1,917 @@
+"""Live xPyD role reconfiguration: protocol, planner decisions, chaos.
+
+Covers the role-transition tentpole (docs/RESILIENCE.md "Role
+transitions"): the worker-side SetRole state machine with epoch/lease
+fencing (llm/reconfig.py), drain semantics that migrate in-flight
+streams with a typed ``role_flip`` reason, planner-driven flip
+decisions with hysteresis/cooldown/at-most-one-in-flight guard rails
+(planner/reconfig.py), and the crash matrix: worker crash mid-drain,
+coordinator restart mid-flip, duplicate/reordered directives — every
+scenario converging to a consistent fleet with zero silent drops.
+
+The ``smoke``-named e2e is the scripts/check.sh reconfig stage; the
+5x-overload flip is ``-m slow``. Everything else is mocker/fake-clock
+near-free.
+"""
+
+import asyncio
+import socket
+import time
+from types import SimpleNamespace
+
+import pytest
+from conftest import async_test
+
+from dynamo_tpu.llm.migration import Migration
+from dynamo_tpu.llm.mocker import MockerConfig, MockerEngine
+from dynamo_tpu.llm.discovery import RouterEngine
+from dynamo_tpu.llm.protocols import PreprocessedRequest
+from dynamo_tpu.llm.recorder import RequestLedger, finish_account, make_account
+from dynamo_tpu.llm.reconfig import (
+    ROLES, RoleManager, RoleState, ServingProfile, role_key, role_status_key)
+from dynamo_tpu.planner.reconfig import (
+    ReconfigConfig, RoleReconfigurator, apply_reconfig_env)
+from dynamo_tpu.runtime import chaos
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.coordinator import Coordinator
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.errors import (
+    NoInstancesError, OverloadedError, RoleTransitionError,
+    StreamIncompleteError)
+from dynamo_tpu.runtime.slo import SloPressure
+
+NS = "reconfig"
+FAST = dict(prefill_tokens_per_s=1e7, decode_step_s=0.0005)
+TYPED = (StreamIncompleteError, NoInstancesError, OverloadedError,
+         RoleTransitionError)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ---------------------------------------------------------------------------
+# harness: in-process role-managed mocker workers
+# ---------------------------------------------------------------------------
+
+async def start_worker(coord, role="decode", drain_s=2.0, lease_ttl=1.0,
+                       **mocker_kwargs):
+    rt = await DistributedRuntime.from_settings(RuntimeConfig(
+        coordinator_url=coord.url, lease_ttl_s=lease_ttl, namespace=NS))
+    engine = MockerEngine(MockerConfig(**{**FAST, **mocker_kwargs}))
+    w = SimpleNamespace(rt=rt, engine=engine, mgr=None,
+                        hex=f"{rt.instance_id:x}", served=0)
+
+    def counting_handler():
+        inner = engine.handler()
+
+        async def handle(request, context):
+            w.served += 1
+            async for out in inner(request, context):
+                yield out
+
+        return handle
+
+    async def build(r: str) -> ServingProfile:
+        prof = ServingProfile(r)
+        comp = "prefill" if r == "prefill" else "mocker"
+        ep = rt.namespace(NS).component(comp).endpoint("generate")
+        prof.add_server(await ep.serve_endpoint(counting_handler(),
+                                                graceful_shutdown=False))
+        return prof
+
+    w.mgr = RoleManager(rt, build, role=role, drain_s=drain_s)
+    await w.mgr.start()
+    engine.start()
+    return w
+
+
+async def stop_worker(w) -> None:
+    await w.engine.stop()
+    await w.mgr.stop()
+    await w.rt.close()
+
+
+async def crash_worker(w) -> None:
+    """Simulate a process crash: sockets die, the lease is NOT revoked
+    (expiry is the death signal), nothing drains gracefully."""
+    await w.engine.stop()
+    if w.mgr._watch_task:
+        w.mgr._watch_task.cancel()
+    for server in (w.mgr.profile.servers if w.mgr.profile else []):
+        for task, _ctx in list(server._inflight.values()):
+            task.cancel()
+        if server._server:
+            server._server.close()
+        for wr in list(server._conn_writers):
+            wr.close()
+    await w.rt.coordinator_client.close(revoke_lease=False)
+    w.rt.coordinator_client = None
+
+
+async def start_pipeline(coord, migration_limit=8, idle_timeout_s=2.0,
+                         n_instances=1):
+    rt = await DistributedRuntime.from_settings(RuntimeConfig(
+        coordinator_url=coord.url, lease_ttl_s=1.0, namespace=NS,
+        stream_idle_timeout_s=idle_timeout_s))
+    client = await rt.namespace(NS).component("mocker").endpoint(
+        "generate").client()
+    await client.wait_for_instances(timeout=10)
+    while len(client.instance_ids()) < n_instances:
+        await asyncio.sleep(0.02)
+    migration = Migration(migration_limit, inner=RouterEngine(client),
+                          metrics=rt.metrics)
+    return rt, client, migration
+
+
+def _make_req(max_tokens=24):
+    req = PreprocessedRequest(model="mock-model",
+                              token_ids=list(range(1, 9)))
+    req.stop_conditions.max_tokens = max_tokens
+    req.stop_conditions.ignore_eos = True
+    return req
+
+
+async def _run_one(migration, max_tokens, deadline_s, ledger=None):
+    """One request under the invariant, accounted into ``ledger`` (zero
+    silent drops: every accepted request lands a terminal record)."""
+    tokens = []
+    ctx = Context()
+    acct = make_account("test", "mock-model", ctx) if ledger is not None \
+        else None
+
+    async def consume():
+        async for out in migration.generate(_make_req(max_tokens), ctx):
+            tokens.extend(out.token_ids)
+            if out.finish_reason:
+                return
+
+    try:
+        await asyncio.wait_for(consume(), deadline_s)
+    except TYPED as exc:
+        if acct is not None:
+            finish_account(acct, "error", reason=type(exc).__name__,
+                           ctx=ctx, ledger=ledger)
+        return ("typed", type(exc).__name__)
+    except asyncio.TimeoutError:
+        return ("hang", len(tokens))
+    except Exception as exc:  # noqa: BLE001 — the invariant check itself
+        return ("untyped", f"{type(exc).__name__}: {exc}")
+    if acct is not None:
+        finish_account(acct, "ok", ctx=ctx, ledger=ledger)
+    return ("ok", len(tokens))
+
+
+def _assert_invariant(results, max_tokens):
+    for r in results:
+        assert r[0] in ("ok", "typed"), f"invariant violated: {results}"
+        if r[0] == "ok":
+            assert r[1] == max_tokens, \
+                f"token count drifted (want {max_tokens}): {results}"
+
+
+async def wait_for(predicate, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        await asyncio.sleep(interval)
+    raise AssertionError(f"condition not reached in {timeout}s: {predicate}")
+
+
+# ---------------------------------------------------------------------------
+# state machine + fencing units
+# ---------------------------------------------------------------------------
+
+@async_test
+async def test_flip_reregisters_endpoints_and_publishes_status():
+    coord = Coordinator()
+    await coord.start()
+    w = await start_worker(coord, role="decode")
+    client = w.rt.require_coordinator()
+    try:
+        insts = await client.kv_get_prefix("instances/")
+        assert [i["k"] for i in insts] == \
+            [f"instances/{NS}/mocker/generate/{w.hex}"]
+        out = await w.mgr.set_role("prefill", 1)
+        assert out["outcome"] == "ok" and w.mgr.role == "prefill"
+        insts = await client.kv_get_prefix("instances/")
+        assert [i["k"] for i in insts] == \
+            [f"instances/{NS}/prefill/generate/{w.hex}"]
+        status = await client.kv_get(role_status_key(NS, w.rt.instance_id))
+        assert (status["role"], status["state"], status["epoch"]) == \
+            ("prefill", "serving", 1)
+        assert status["last_outcome"]["outcome"] == "ok"
+        # worker_role gauge flipped with it.
+        assert w.rt.metrics.gauge(
+            "worker_role", "Current serving role (1 on exactly one "
+            "role label per worker)", ["role"]).get(role="prefill") == 1.0
+    finally:
+        await stop_worker(w)
+        await coord.stop()
+
+
+@async_test
+async def test_epoch_fencing_duplicate_stale_noop():
+    coord = Coordinator()
+    await coord.start()
+    w = await start_worker(coord, role="agg")
+    try:
+        await w.mgr.set_role("decode", 3)
+        # Duplicate of the applied directive: idempotent ack, no flip.
+        out = await w.mgr.set_role("decode", 3)
+        assert out["outcome"] == "duplicate" and w.mgr.flips == 1
+        # Reordered/stale frame: typed rejection, role unchanged.
+        with pytest.raises(RoleTransitionError):
+            await w.mgr.set_role("prefill", 2)
+        assert w.mgr.role == "decode"
+        assert w.mgr.last_outcome["outcome"] == "rejected_stale"
+        # Same role at a higher epoch: fence forward, no transition.
+        out = await w.mgr.set_role("decode", 7)
+        assert out["outcome"] == "noop"
+        assert (w.mgr.applied_epoch, w.mgr.flips) == (7, 1)
+        # Unknown role: typed.
+        with pytest.raises(RoleTransitionError):
+            await w.mgr.set_role("training", 8)
+    finally:
+        await stop_worker(w)
+        await coord.stop()
+
+
+@async_test
+async def test_conflicting_verb_during_flip_rejected_busy():
+    coord = Coordinator()
+    await coord.start()
+    # Slow decode so the drain has a genuinely in-flight stream.
+    w = await start_worker(coord, role="decode", drain_s=1.0,
+                           decode_step_s=0.02)
+    rt, client, migration = await start_pipeline(coord)
+    try:
+        task = asyncio.ensure_future(_run_one(migration, 100, 20))
+        await wait_for(lambda: w.engine.decoding)
+        flip = asyncio.ensure_future(w.mgr.set_role("prefill", 1))
+        await wait_for(lambda: w.mgr.state != RoleState.SERVING)
+        # A CONFLICTING verb while the flip runs: rejected typed.
+        with pytest.raises(RoleTransitionError):
+            await w.mgr.set_role("agg", 2)
+        # The DUPLICATE of the running flip: acknowledged, not queued.
+        out = await w.mgr.set_role("prefill", 1)
+        assert out["outcome"] == "duplicate"
+        assert (await flip)["outcome"] == "ok"
+        result = await task
+        assert result[0] in ("ok", "typed"), result
+    finally:
+        await client.close()
+        await rt.close()
+        await stop_worker(w)
+        await coord.stop()
+
+
+@async_test
+async def test_directive_watch_flips_and_replay_is_fenced():
+    """The planner path: a directive PUT flips the worker; the watch
+    snapshot replayed by a coordinator reconnect cannot re-run it."""
+    coord = Coordinator()
+    await coord.start()
+    w = await start_worker(coord, role="decode")
+    client = w.rt.require_coordinator()
+    try:
+        await client.kv_put(role_key(NS, w.rt.instance_id),
+                            {"role": "prefill", "epoch": 1,
+                             "issued_by": "test"})
+        await wait_for(lambda: w.mgr.role == "prefill"
+                       and w.mgr.state == RoleState.SERVING)
+        assert w.mgr.flips == 1
+        # Duplicate PUT of the same directive (watch replay shape).
+        await client.kv_put(role_key(NS, w.rt.instance_id),
+                            {"role": "prefill", "epoch": 1,
+                             "issued_by": "test"})
+        await asyncio.sleep(0.3)
+        assert w.mgr.flips == 1  # fenced: no second transition
+        assert w.mgr.role == "prefill"
+    finally:
+        await stop_worker(w)
+        await coord.stop()
+
+
+@async_test
+async def test_flip_drains_and_migrates_inflight_with_typed_reason():
+    """A stream caught by the drain deadline migrates with
+    migration_reason="role_flip" and still delivers EXACT tokens."""
+    coord = Coordinator()
+    await coord.start()
+    a = await start_worker(coord, role="decode", drain_s=0.3,
+                           decode_step_s=0.01)
+    rt, client, migration = await start_pipeline(coord, n_instances=1)
+    b = None
+    try:
+        ctx = Context()
+        tokens = []
+
+        async def consume():
+            async for out in migration.generate(_make_req(60), ctx):
+                tokens.extend(out.token_ids)
+                if out.finish_reason:
+                    return
+
+        task = asyncio.ensure_future(consume())
+        await wait_for(lambda: a.engine.decoding)
+        b = await start_worker(coord, role="decode", decode_step_s=0.01)
+        while len(client.instance_ids()) < 2:
+            await asyncio.sleep(0.02)
+        out = await a.mgr.set_role("prefill", 1)
+        assert out["outcome"] == "ok"
+        await asyncio.wait_for(task, 30)
+        assert len(tokens) == 60
+        assert ctx.values["migrations"] >= 1
+        assert ctx.values["migration_reason"] == "role_flip"
+        # The drained worker no longer serves the decode component.
+        await wait_for(lambda: client.instance_ids()
+                       == [b.rt.instance_id])
+    finally:
+        await client.close()
+        await rt.close()
+        await stop_worker(a)
+        if b is not None:
+            await stop_worker(b)
+        await coord.stop()
+
+
+# ---------------------------------------------------------------------------
+# planner decision units (fake coordinator, fake clock, fake pressure)
+# ---------------------------------------------------------------------------
+
+class FakeCoord:
+    def __init__(self):
+        self.kv = {}
+
+    async def kv_get_prefix(self, prefix):
+        return [{"k": k, "v": v} for k, v in sorted(self.kv.items())
+                if k.startswith(prefix)]
+
+    async def kv_put(self, key, value, lease_id=None,
+                     use_primary_lease=False):
+        self.kv[key] = value
+
+    async def kv_delete(self, key):
+        return self.kv.pop(key, None) is not None
+
+
+def S(worker, role, state="serving", epoch=0, inflight=0, ts=None):
+    return {"worker": worker, "role": role, "state": state, "epoch": epoch,
+            "inflight": inflight, "ts": ts if ts is not None else time.time()}
+
+
+def P(level=2, failing=("ttft",)):
+    return SloPressure(level=level, worst_burn=14.5, failing=tuple(failing))
+
+
+def make_reconf(fake, pressure=None, depth=None, clock=None, **cfg_kw):
+    cfg_kw.setdefault("hysteresis_intervals", 2)
+    cfg_kw.setdefault("cooldown_s", 60.0)
+    cfg = ReconfigConfig(enabled=True, **cfg_kw)
+
+    async def depth_fn():
+        return depth
+
+    return RoleReconfigurator(
+        fake, NS, cfg,
+        pressure_fn=(lambda: pressure),
+        queue_depth_fn=depth_fn if depth is not None else None,
+        clock=clock or time.monotonic)
+
+
+def seed_fleet(fake, *statuses):
+    for s in statuses:
+        fake.kv[f"rolestatus/{NS}/{s['worker']}"] = s
+
+
+@async_test
+async def test_planner_hysteresis_then_flip_least_loaded():
+    fake = FakeCoord()
+    seed_fleet(fake, S("aa", "decode", inflight=9),
+               S("bb", "decode", inflight=2), S("cc", "decode", inflight=5))
+    r = make_reconf(fake, pressure=P(failing=("ttft",)))
+    first = await r.step()
+    assert (first["signal"], first["action"]) == ("to_prefill", "hysteresis")
+    assert not [k for k in fake.kv if k.startswith("role/")]
+    second = await r.step()
+    assert second["action"] == "flip"
+    # Least-loaded decode worker got the directive, epoch above fleet max.
+    directive = fake.kv[f"role/{NS}/bb"]
+    assert (directive["role"], directive["epoch"]) == ("prefill", 1)
+    assert second["directive"]["worker"] == "bb"
+
+
+@async_test
+async def test_planner_cooldown_blocks_back_to_back_flips():
+    fake = FakeCoord()
+    seed_fleet(fake, S("aa", "decode"), S("bb", "decode"),
+               S("cc", "decode"))
+    now = [1000.0]
+    r = make_reconf(fake, pressure=P(), clock=lambda: now[0],
+                    hysteresis_intervals=1, cooldown_s=30.0)
+    assert (await r.step())["action"] == "flip"
+    # Pretend the flip applied so at-most-one doesn't mask the cooldown.
+    fake.kv[f"rolestatus/{NS}/aa"] = S("aa", "prefill", epoch=1)
+    del fake.kv[f"role/{NS}/aa"]
+    now[0] += 10.0
+    assert (await r.step())["action"] == "cooldown"
+    now[0] += 25.0
+    step = await r.step()
+    assert step["action"] in ("flip", "bounded")  # cooldown has passed
+
+
+@async_test
+async def test_planner_at_most_one_flip_in_flight():
+    fake = FakeCoord()
+    seed_fleet(fake, S("aa", "decode", state="draining"),
+               S("bb", "decode"), S("cc", "decode"))
+    r = make_reconf(fake, pressure=P(), hysteresis_intervals=1)
+    assert (await r.step())["action"] == "flip_in_flight"
+    # An unapplied directive also counts as in-flight.
+    fake.kv[f"rolestatus/{NS}/aa"] = S("aa", "decode")
+    fake.kv[f"role/{NS}/bb"] = {"role": "prefill", "epoch": 5}
+    r2 = make_reconf(fake, pressure=P(), hysteresis_intervals=1)
+    assert (await r2.step())["action"] == "flip_in_flight"
+
+
+@async_test
+async def test_planner_respects_role_mix_floors():
+    fake = FakeCoord()
+    seed_fleet(fake, S("aa", "decode"), S("bb", "prefill"))
+    # min_decode=1: flipping the only decode worker away is forbidden.
+    r = make_reconf(fake, pressure=P(failing=("ttft",)),
+                    hysteresis_intervals=1)
+    assert (await r.step())["action"] == "bounded"
+    # And the reverse floor for prefill.
+    r2 = make_reconf(fake, pressure=P(failing=("itl",)),
+                     hysteresis_intervals=1)
+    assert (await r2.step())["action"] == "bounded"
+
+
+@async_test
+async def test_planner_itl_pressure_flips_prefill_back():
+    fake = FakeCoord()
+    seed_fleet(fake, S("aa", "decode"), S("bb", "prefill", epoch=4),
+               S("cc", "prefill"))
+    r = make_reconf(fake, pressure=P(failing=("itl",)), depth=0,
+                    hysteresis_intervals=1)
+    step = await r.step()
+    assert step["action"] == "flip"
+    worker = step["directive"]["worker"]
+    assert fake.kv[f"role/{NS}/{worker}"]["role"] == "decode"
+    assert step["directive"]["epoch"] == 5  # above the fleet max epoch
+
+
+@async_test
+async def test_planner_queue_depth_alone_requests_prefill():
+    fake = FakeCoord()
+    seed_fleet(fake, S("aa", "decode"), S("bb", "decode"))
+    r = make_reconf(fake, pressure=None, depth=9, hysteresis_intervals=1)
+    step = await r.step()
+    assert (step["signal"], step["action"]) == ("to_prefill", "flip")
+
+
+@async_test
+async def test_planner_gc_reaps_applied_and_orphaned_directives():
+    fake = FakeCoord()
+    seed_fleet(fake, S("aa", "decode", epoch=6), S("bb", "decode"))
+    fake.kv[f"role/{NS}/aa"] = {"role": "decode", "epoch": 6}  # applied
+    fake.kv[f"role/{NS}/zz"] = {"role": "prefill", "epoch": 2}  # orphan
+    r = make_reconf(fake, pressure=None)
+    await r.step()
+    assert not [k for k in fake.kv if k.startswith("role/")]
+
+
+def test_reconfig_env_knobs(monkeypatch):
+    monkeypatch.setenv("DTPU_PLANNER_RECONFIG_COOLDOWN_S", "7.5")
+    monkeypatch.setenv("DTPU_PLANNER_RECONFIG_MIN_PREFILL", "3")
+    monkeypatch.setenv("DTPU_PLANNER_RECONFIG_ENABLED", "1")
+    cfg = apply_reconfig_env(ReconfigConfig())
+    assert (cfg.cooldown_s, cfg.min_prefill, cfg.enabled) == (7.5, 3, True)
+
+
+# ---------------------------------------------------------------------------
+# satellites: doctor roles, slo_report attribution, HTTP control verb
+# ---------------------------------------------------------------------------
+
+def test_doctor_role_section_warns_on_stuck_and_zero_prefill():
+    from dynamo_tpu.doctor import OK, WARN, Report, check_roles
+    rep = Report()
+    check_roles(rep, [
+        {"k": "rolestatus/d/aa", "v": S("aa", "agg")},
+        {"k": "rolestatus/d/bb",
+         "v": S("bb", "decode", state="draining", ts=time.time() - 600)},
+    ])
+    by = {c: s for s, c, _ in rep.rows}
+    assert by["worker role aa"] == OK
+    assert by["worker role bb"] == WARN  # stuck draining
+    # Zero prefill-capable fleet WARNs.
+    rep2 = Report()
+    check_roles(rep2, [{"k": "x", "v": S("aa", "decode")},
+                       {"k": "y", "v": S("bb", "decode")}])
+    assert {c: s for s, c, _ in rep2.rows}["role fleet"] == WARN
+    # A failed last flip WARNs.
+    bad = S("cc", "agg")
+    bad["last_outcome"] = {"from": "agg", "to": "prefill",
+                           "outcome": "failed"}
+    rep3 = Report()
+    check_roles(rep3, [{"k": "z", "v": bad}])
+    assert {c: s for s, c, _ in rep3.rows}["worker role cc"] == WARN
+
+
+def test_slo_report_attributes_role_flip_migrations(tmp_path):
+    import json as _json
+    import sys
+    sys.path.insert(0, "scripts")
+    try:
+        import slo_report
+    finally:
+        sys.path.pop(0)
+    path = tmp_path / "requests.jsonl"
+    recs = [
+        {"status": "ok", "tenant": "t1", "priority": "interactive",
+         "migrations": 2, "migration_reason": "role_flip"},
+        {"status": "ok", "tenant": "t1", "priority": "interactive",
+         "migrations": 1},
+        {"status": "ok", "tenant": "t1", "priority": "interactive"},
+    ]
+    path.write_text("".join(_json.dumps(r) + "\n" for r in recs))
+    table = slo_report.rollup(slo_report.load_records(str(path)),
+                              ["tenant"])
+    row = table[("t1",)]
+    assert row["migrations"] == 3
+    assert row["migration_reasons"] == {"role_flip": 2, "disconnect": 1}
+    rendered = slo_report.render(table, ["tenant"])
+    assert "role_flip=2" in rendered
+
+
+@async_test
+async def test_status_server_set_role_verb():
+    import aiohttp
+
+    from dynamo_tpu.runtime.health import SystemStatusServer
+    coord = Coordinator()
+    await coord.start()
+    w = await start_worker(coord, role="agg")
+    server = SystemStatusServer(w.rt, host="127.0.0.1", port=0,
+                                role_manager=w.mgr)
+    await server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}/control/role"
+        async with aiohttp.ClientSession() as session:
+            async with session.get(base) as r:
+                body = await r.json()
+                assert (r.status, body["role"], body["state"]) == \
+                    (200, "agg", "serving")
+            async with session.post(base, json={"role": "prefill",
+                                                "epoch": 1}) as r:
+                body = await r.json()
+                assert r.status == 200 and body["outcome"] == "ok"
+            assert w.mgr.role == "prefill"
+            # Stale epoch: typed 409 with the fencing decision.
+            async with session.post(base, json={"role": "decode",
+                                                "epoch": 1}) as r:
+                body = await r.json()
+                assert r.status == 409 and body["type"] == "role_transition"
+            # Missing epoch: 400 (a replayed curl must not re-flip).
+            async with session.post(base, json={"role": "decode"}) as r:
+                assert r.status == 400
+    finally:
+        await server.stop()
+        await stop_worker(w)
+        await coord.stop()
+
+
+@async_test
+async def test_disagg_config_watch_survives_poison_chaos_and_restart():
+    """Satellite regression: DisaggRouterConfig._watch_loop must survive
+    (1) a malformed config value (used to kill the task silently),
+    (2) a chaos-injected coordinator-connection reset, and
+    (3) a full coordinator restart — and still apply later updates."""
+    from dynamo_tpu.llm.disagg import DisaggRouterConfig, disagg_config_key
+    port = _free_port()
+    coord = Coordinator("127.0.0.1", port)
+    await coord.start()
+    url = f"tcp://127.0.0.1:{port}"
+    rt = await DistributedRuntime.from_settings(
+        RuntimeConfig(coordinator_url=url, lease_ttl_s=1.0, namespace=NS))
+    client = rt.require_coordinator()
+    cfg = await DisaggRouterConfig.from_coordinator_with_watch(
+        client, "mock-model", default_max_local=512)
+    key = disagg_config_key("mock-model")
+    try:
+        # (1) poison value: the watch loop must shrug it off.
+        await client.kv_put(key, {"max_local_prefill_length": "garbage"})
+        await client.kv_put(key, {"max_local_prefill_length": 100})
+        await wait_for(lambda: cfg.max_local_prefill_length == 100)
+        assert not cfg._task.done()
+        # (2) chaos: sever the coordinator client connection once.
+        with chaos.active("seed=3;conn.reset@coord_client=x1"):
+            try:
+                await client.kv_get("poke")  # trips the injected reset
+            except ConnectionError:
+                pass
+
+        async def put(value):
+            try:
+                await client.kv_put(key,
+                                    {"max_local_prefill_length": value})
+                return True
+            except ConnectionError:
+                return False  # mid-reconnect; retry
+
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if await put(200) and cfg.max_local_prefill_length == 200:
+                break
+            await asyncio.sleep(0.1)
+        assert cfg.max_local_prefill_length == 200
+        assert not cfg._task.done()
+        # (3) coordinator restart: client replays the watch; updates on
+        # the NEW coordinator still apply.
+        await coord.stop()
+        await asyncio.sleep(0.3)
+        coord = Coordinator("127.0.0.1", port)
+        await coord.start()
+
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if await put(300) and cfg.max_local_prefill_length == 300:
+                break
+            await asyncio.sleep(0.2)
+        assert cfg.max_local_prefill_length == 300
+        assert not cfg._task.done()
+    finally:
+        chaos.uninstall()
+        await cfg.close()
+        await rt.close()
+        await coord.stop()
+
+
+# ---------------------------------------------------------------------------
+# e2e: scripted flips under load + the crash matrix
+# ---------------------------------------------------------------------------
+
+@async_test(timeout=120)
+async def test_reconfig_smoke_scripted_flip_zero_drops():
+    """The check.sh reconfig smoke + the acceptance e2e: under
+    continuous load, flip a live worker prefill->decode, then another
+    decode->prefill (draining real in-flight streams), with seeded
+    frame-drop chaos. Every accepted request completes with exact
+    tokens or fails typed, the ledger records a terminal status for
+    every request (zero silent drops), the drained worker leaves the
+    decode instance set, and the fleet converges to the planner's
+    target mix."""
+    coord = Coordinator()
+    await coord.start()
+    a = await start_worker(coord, role="prefill", drain_s=1.0)
+    b = await start_worker(coord, role="decode", drain_s=1.0)
+    c = await start_worker(coord, role="decode", drain_s=1.0)
+    rt, client, migration = await start_pipeline(coord, n_instances=2)
+    ledger = RequestLedger(capacity=4096)
+    coordc = rt.require_coordinator()
+    results = []
+    try:
+        with chaos.active("seed=21;frame.drop@service=0.02"):
+            results += await asyncio.gather(
+                *(_run_one(migration, 24, 30, ledger) for _ in range(6)))
+            # Flip A prefill -> decode under load (epoch from the fleet).
+            await coordc.kv_put(role_key(NS, a.rt.instance_id),
+                                {"role": "decode", "epoch": 1,
+                                 "issued_by": "planner"})
+            load = asyncio.ensure_future(asyncio.gather(
+                *(_run_one(migration, 24, 30, ledger) for _ in range(8))))
+            await wait_for(lambda: a.mgr.role == "decode"
+                           and a.mgr.state == RoleState.SERVING, timeout=20)
+            await wait_for(lambda: len(client.instance_ids()) == 3,
+                           timeout=10)
+            results += await load
+            # Flip B decode -> prefill while it is serving streams.
+            load = asyncio.ensure_future(asyncio.gather(
+                *(_run_one(migration, 24, 30, ledger) for _ in range(8))))
+            await coordc.kv_put(role_key(NS, b.rt.instance_id),
+                                {"role": "prefill", "epoch": 2,
+                                 "issued_by": "planner"})
+            await wait_for(lambda: b.mgr.role == "prefill"
+                           and b.mgr.state == RoleState.SERVING, timeout=20)
+            results += await load
+            results += await asyncio.gather(
+                *(_run_one(migration, 24, 30, ledger) for _ in range(6)))
+        _assert_invariant(results, 24)
+        assert any(r[0] == "ok" for r in results), results
+        # Zero silent drops: every request has a terminal ledger record.
+        assert ledger.total == len(results)
+        assert set(ledger.counts) <= {"ok", "error"}
+        # The drained worker left the decode set; the flipped-in one
+        # joined: fleet converged to the target 1 prefill / 2 decode.
+        ids = client.instance_ids()
+        assert b.rt.instance_id not in ids
+        assert sorted(ids) == sorted([a.rt.instance_id, c.rt.instance_id])
+        statuses = await coordc.kv_get_prefix(f"rolestatus/{NS}/")
+        roles = sorted(s["v"]["role"] for s in statuses)
+        assert roles == ["decode", "decode", "prefill"]
+        assert all(s["v"]["state"] == "serving" for s in statuses)
+    finally:
+        chaos.uninstall()
+        await client.close()
+        await rt.close()
+        for w in (a, b, c):
+            await stop_worker(w)
+        await coord.stop()
+
+
+@async_test(timeout=120)
+async def test_worker_crash_mid_drain_converges():
+    """SetRole lands, the worker starts draining with live streams, then
+    the process dies. Streams migrate via the normal death signals and
+    the fleet view converges (status key gone with the lease)."""
+    coord = Coordinator()
+    await coord.start()
+    a = await start_worker(coord, role="decode", drain_s=10.0,
+                           decode_step_s=0.01)
+    b = await start_worker(coord, role="decode", decode_step_s=0.01)
+    rt, client, migration = await start_pipeline(coord, n_instances=2)
+    coordc = rt.require_coordinator()
+    try:
+        load = asyncio.ensure_future(asyncio.gather(
+            *(_run_one(migration, 80, 40) for _ in range(6))))
+        await wait_for(lambda: a.engine.decoding or b.engine.decoding)
+        await coordc.kv_put(role_key(NS, a.rt.instance_id),
+                            {"role": "prefill", "epoch": 1,
+                             "issued_by": "planner"})
+        # The long drain holds while streams run... and then A "crashes".
+        await wait_for(lambda: a.mgr.state == RoleState.DRAINING
+                       or not a.engine.decoding, timeout=15)
+        await crash_worker(a)
+        results = await load
+        _assert_invariant(results, 80)
+        assert any(r[0] == "ok" for r in results), results
+        # Fleet converges: A's lease-bound status/instances vanish.
+        await wait_for(lambda: client.instance_ids()
+                       == [b.rt.instance_id], timeout=15)
+
+        async def statuses():
+            return await coordc.kv_get_prefix(f"rolestatus/{NS}/")
+
+        deadline = time.monotonic() + 15
+        left = None
+        while time.monotonic() < deadline:
+            left = [s["v"]["worker"] for s in await statuses()]
+            if left == [b.hex]:
+                break
+            await asyncio.sleep(0.2)
+        assert left == [b.hex], left
+    finally:
+        await client.close()
+        await rt.close()
+        await stop_worker(b)
+        await coord.stop()
+
+
+@async_test(timeout=120)
+async def test_coordinator_restart_mid_flip_converges():
+    """The coordinator dies between drain and re-register: the flip
+    rides the client's reconnect replay, registration retries under the
+    unified policy, and the fleet converges on the NEW coordinator."""
+    port = _free_port()
+    coord = Coordinator("127.0.0.1", port)
+    await coord.start()
+    a = await start_worker(coord, role="decode", drain_s=1.0,
+                           decode_step_s=0.02)
+    try:
+        # An in-flight stream makes the drain take its full budget.
+        rt, client, migration = await start_pipeline(coord)
+        task = asyncio.ensure_future(_run_one(migration, 100, 60))
+        await wait_for(lambda: a.engine.decoding)
+        flip = asyncio.ensure_future(a.mgr.set_role("prefill", 1))
+        await wait_for(lambda: a.mgr.state != RoleState.SERVING)
+        await coord.stop()
+        await asyncio.sleep(0.5)
+        coord = Coordinator("127.0.0.1", port)
+        await coord.start()
+        out = await asyncio.wait_for(flip, 60)
+        assert out["outcome"] == "ok"
+        assert (a.mgr.role, a.mgr.state) == ("prefill", RoleState.SERVING)
+        # The new serving profile registered on the NEW coordinator, and
+        # the status key came back with it.
+        probe = await DistributedRuntime.from_settings(RuntimeConfig(
+            coordinator_url=f"tcp://127.0.0.1:{port}", namespace=NS))
+        try:
+            pc = probe.require_coordinator()
+
+            async def registered():
+                insts = await pc.kv_get_prefix(
+                    f"instances/{NS}/prefill/generate/")
+                status = await pc.kv_get(
+                    role_status_key(NS, a.rt.instance_id))
+                return bool(insts) and status \
+                    and status["role"] == "prefill"
+
+            deadline = time.monotonic() + 30
+            ok = False
+            while time.monotonic() < deadline:
+                if await registered():
+                    ok = True
+                    break
+                await asyncio.sleep(0.2)
+            assert ok, "flip did not converge on the new coordinator"
+        finally:
+            await probe.close()
+        # The stream that straddled the restart fails typed or finishes.
+        result = await task
+        assert result[0] in ("ok", "typed"), result
+        await client.close()
+        await rt.close()
+    finally:
+        await stop_worker(a)
+        await coord.stop()
+
+
+@async_test(timeout=120)
+async def test_planner_closed_loop_flip_converges_to_target_ratio():
+    """End to end through the planner: pressure says TTFT is burning,
+    the reconfigurator issues a fenced directive, the worker flips, and
+    the next steps hold the fleet at the target mix (at-most-one +
+    floors), reaping the applied directive."""
+    from dynamo_tpu.planner import FakeConnector, Planner, PlannerConfig
+    coord = Coordinator()
+    await coord.start()
+    workers = [await start_worker(coord, role="decode") for _ in range(3)]
+    prt = await DistributedRuntime.from_settings(RuntimeConfig(
+        coordinator_url=coord.url, lease_ttl_s=1.0, namespace=NS))
+    try:
+        client = prt.require_coordinator()
+        cfg = PlannerConfig(
+            namespace=NS, predictor="constant",
+            reconfig=ReconfigConfig(enabled=True, hysteresis_intervals=1,
+                                    cooldown_s=0.0, min_decode=2,
+                                    min_prefill=0))
+        planner = Planner(cfg, FakeConnector({"tpu": 3}), runtime=prt)
+        planner.reconfigurator = RoleReconfigurator(
+            client, NS, cfg.reconfig,
+            pressure_fn=lambda: P(failing=("ttft",)))
+        out = await planner.step()
+        assert out["reconfig"]["action"] == "flip"
+        flipped_hex = out["reconfig"]["directive"]["worker"]
+        flipped = next(w for w in workers if w.hex == flipped_hex)
+        await wait_for(lambda: flipped.mgr.role == "prefill"
+                       and flipped.mgr.state == RoleState.SERVING)
+        # Converged: later steps keep the 1P/2D mix (floor) and GC the
+        # applied directive rather than re-issuing.
+        for _ in range(3):
+            out = await planner.step()
+            assert out["reconfig"]["action"] in ("bounded",
+                                                 "flip_in_flight")
+        assert not await client.kv_get_prefix(f"role/{NS}/")
+        statuses = await client.kv_get_prefix(f"rolestatus/{NS}/")
+        assert sorted(s["v"]["role"] for s in statuses) == \
+            ["decode", "decode", "prefill"]
+    finally:
+        await prt.close()
+        for w in workers:
+            await stop_worker(w)
+        await coord.stop()
+
+
+@pytest.mark.slow
+@async_test(timeout=300)
+async def test_role_flip_under_5x_overload():
+    """The heavy matrix: flip a decode worker away while the fleet is
+    driven well past capacity with seeded chaos. Accepted requests
+    complete exactly or fail typed; nothing hangs; the fleet converges."""
+    coord = Coordinator()
+    await coord.start()
+    workers = [await start_worker(coord, role="decode", drain_s=1.0,
+                                  max_num_seqs=8, decode_step_s=0.002)
+               for _ in range(3)]
+    rt, client, migration = await start_pipeline(coord, n_instances=3)
+    coordc = rt.require_coordinator()
+    try:
+        with chaos.active("seed=31;frame.drop@service=0.02"):
+            load = asyncio.ensure_future(asyncio.gather(
+                *(_run_one(migration, 24, 90) for _ in range(120))))
+            await asyncio.sleep(0.3)
+            await coordc.kv_put(role_key(NS, workers[0].rt.instance_id),
+                                {"role": "prefill", "epoch": 1,
+                                 "issued_by": "planner"})
+            await wait_for(lambda: workers[0].mgr.role == "prefill"
+                           and workers[0].mgr.state == RoleState.SERVING,
+                           timeout=60)
+            results = await load
+        _assert_invariant(results, 24)
+        ok = sum(1 for r in results if r[0] == "ok")
+        assert ok >= len(results) * 0.6, f"goodput collapsed: {ok}"
+        await wait_for(lambda: len(client.instance_ids()) == 2, timeout=15)
+    finally:
+        chaos.uninstall()
+        await client.close()
+        await rt.close()
+        for w in workers:
+            await stop_worker(w)
+        await coord.stop()

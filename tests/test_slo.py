@@ -619,6 +619,7 @@ def test_docs_drift_new_series_are_documented():
         "breaker_open", "breaker_opens_total",
         "prefill_chunk_tokens_total", "prefill_chunks_inflight",
         "decode_stall_seconds",
+        "role_flips_total", "worker_role",
     }
     missing = required - documented
     assert not missing, f"undocumented series: {sorted(missing)}"
