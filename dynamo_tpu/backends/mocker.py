@@ -19,7 +19,9 @@ from __future__ import annotations
 import argparse
 import asyncio
 
-from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, WorkerMetricsPublisher
+from dynamo_tpu.llm.kv_router.publisher import (KvEventPublisher,
+                                                KvInventoryPublisher,
+                                                WorkerMetricsPublisher)
 from dynamo_tpu.llm.mocker import MockerConfig, MockerEngine
 from dynamo_tpu.llm.model_card import (ModelRuntimeConfig, deregister_llm,
                                        register_llm)
@@ -102,7 +104,11 @@ async def run(args: argparse.Namespace) -> None:
                                   runtime.instance_id)
         metrics_pub = WorkerMetricsPublisher(runtime, ns, args.component,
                                              runtime.instance_id)
-        engine = MockerEngine(mocker_cfg, kv_pub, metrics_pub)
+        inventory_pub = KvInventoryPublisher(runtime, ns, args.component,
+                                             runtime.instance_id)
+        engine = MockerEngine(mocker_cfg, kv_pub, metrics_pub,
+                              inventory_publisher=inventory_pub)
+        inventory_pub.start_periodic(engine.inventory_digest)
         roles = RoleManager(runtime,
                             make_profile_builder(runtime, engine, args,
                                                  tokenizer),
@@ -113,11 +119,17 @@ async def run(args: argparse.Namespace) -> None:
         engine.start()
         status_server = None
         if cfg.system_enabled:
+            from dynamo_tpu.llm.fleet import register_status_server
             from dynamo_tpu.runtime.health import SystemStatusServer
             status_server = SystemStatusServer(runtime, host=cfg.bind_host,
                                                port=cfg.system_port,
-                                               role_manager=roles)
+                                               role_manager=roles,
+                                               kv_provider=engine.kv_status)
             await status_server.start()
+            await register_status_server(
+                runtime, status_server.port,
+                extra={"backend": "mocker", "component": args.component,
+                       "model": args.model_name})
         port = roles.profile.servers[0].port if roles.profile.servers else 0
         print(f"MOCKER_READY mode={args.mode} port={port} "
               f"worker={runtime.instance_id:x}", flush=True)
@@ -129,6 +141,7 @@ async def run(args: argparse.Namespace) -> None:
             except NotImplementedError:
                 pass
         await runtime.wait_for_shutdown()
+        inventory_pub.stop_periodic()
         await engine.stop()
         if status_server is not None:
             await status_server.stop()

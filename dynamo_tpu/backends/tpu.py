@@ -37,7 +37,9 @@ import os
 
 from dynamo_tpu.engine.config import EngineConfig, PRESETS, ModelSpec
 from dynamo_tpu.engine.engine import TPUEngine
-from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, WorkerMetricsPublisher
+from dynamo_tpu.llm.kv_router.publisher import (KvEventPublisher,
+                                                KvInventoryPublisher,
+                                                WorkerMetricsPublisher)
 from dynamo_tpu.llm.model_card import ModelRuntimeConfig, register_llm
 from dynamo_tpu.llm.tokenizer import Tokenizer, make_test_tokenizer
 from dynamo_tpu.runtime.config import RuntimeConfig
@@ -373,6 +375,8 @@ async def run(args: argparse.Namespace) -> None:
                                   runtime.instance_id)
         metrics_pub = WorkerMetricsPublisher(runtime, ns, args.component,
                                              runtime.instance_id)
+        inventory_pub = KvInventoryPublisher(runtime, ns, args.component,
+                                             runtime.instance_id)
         def build_engine() -> TPUEngine:
             params = None
             if ckpt is not None:
@@ -463,6 +467,7 @@ async def run(args: argparse.Namespace) -> None:
                 block_provider=(engine.host_cache.get
                                 if engine.host_cache is not None else None))
             plane.start()
+            engine.plane = plane  # /debug/kv + dynamo_tpu_kv_plane_* stats
             coordinator = runtime.require_coordinator()
             await coordinator.kv_put(
                 f"kvplane/{cfg.namespace}/{runtime.instance_id:x}",
@@ -532,11 +537,16 @@ async def run(args: argparse.Namespace) -> None:
             role=args.mode,
             status_extra={"backend": "tpu", "model": model_name})
         await roles.start()
+        # Fleet inventory digests (KV & capacity plane): published from
+        # the engine loop alongside KV events + ForwardPassMetrics, with
+        # a periodic republish so an idle worker still shows up.
+        engine.inventory_publisher = inventory_pub
         engine.start()
+        inventory_pub.start_periodic(engine.inventory_digest)
         # Observability plane (docs/OBSERVABILITY.md): flight-recorder
         # bundle context for THIS worker, and the per-worker system
         # status server (DTPU_SYSTEM_ENABLED=1) serving /metrics +
-        # /debug/{traces,slo,requests,flight} next to the engine.
+        # /debug/{traces,slo,requests,flight,kv} next to the engine.
         import dataclasses as _dc
 
         from dynamo_tpu.runtime import flight as _flight
@@ -547,11 +557,19 @@ async def run(args: argparse.Namespace) -> None:
             _flight.on_slo_page)
         status_server = None
         if cfg.system_enabled:
+            from dynamo_tpu.llm.fleet import register_status_server
             from dynamo_tpu.runtime.health import SystemStatusServer
             status_server = SystemStatusServer(runtime, host=cfg.bind_host,
                                                port=cfg.system_port,
-                                               role_manager=roles)
+                                               role_manager=roles,
+                                               kv_provider=engine.kv_status)
             await status_server.start()
+            # Advertise for the frontend's /debug/fleet fan-out
+            # (lease-bound: the entry dies with this worker).
+            await register_status_server(
+                runtime, status_server.port,
+                extra={"backend": "tpu", "component": args.component,
+                       "model": model_name})
         port = roles.profile.servers[0].port if roles.profile.servers else 0
         print(f"TPU_WORKER_READY mode={args.mode} port={port} "
               f"worker={runtime.instance_id:x} pages={engine.runner.num_pages}",
@@ -564,6 +582,7 @@ async def run(args: argparse.Namespace) -> None:
             except NotImplementedError:
                 pass
         await runtime.wait_for_shutdown()
+        inventory_pub.stop_periodic()
         engine.stop()
         if multihost_engine:
             # Engine loop is drained — no more dispatches can race this.

@@ -17,6 +17,10 @@ prints OK/WARN/FAIL per check. The TPU-native equivalent probes:
 - the observability plane on that frontend: ``/metrics`` exposition
   (FAIL when unreachable), ``/debug/slo`` (WARN when no SLO targets are
   configured), ``/debug/flight``, and tracing (WARN when disabled)
+- the KV & capacity pane: registered worker status servers on the
+  coordinator, ``/debug/fleet`` (WARN on partial results — some workers
+  unreachable — or an empty fleet), and the KV router's decision
+  telemetry (cache-aware rate / regret) when KV routing is on
 
 Exit code 0 = no FAIL. Run: ``python -m dynamo_tpu.doctor
 [--coordinator-url tcp://...] [--frontend-url http://...]``.
@@ -161,6 +165,15 @@ async def check_coordinator(rep: Report, url: str) -> None:
             rep.add(OK, "disagg config",
                     "; ".join(f"{d['k']}={d['v']}" for d in disagg))
         check_roles(rep, await client.kv_get_prefix("rolestatus/"))
+        system = await client.kv_get_prefix("system/")
+        if system:
+            rep.add(OK, "status servers",
+                    f"{len(system)} registered for the fleet pane "
+                    "(/debug/fleet)")
+        else:
+            rep.add(WARN, "status servers",
+                    "none registered: /debug/fleet will be empty (set "
+                    "DTPU_SYSTEM_ENABLED=1 on workers)")
     except (ConnectionError, OSError, asyncio.TimeoutError) as exc:
         # Coordinator died mid-check: report it, keep the doctor alive so
         # later checks (frontend) still run.
@@ -318,6 +331,59 @@ async def check_observability(rep: Report, url: str) -> None:
         rep.add(FAIL, "observability", f"{url}: {exc}")
 
 
+async def check_fleet_kv(rep: Report, url: str) -> None:
+    """KV & capacity pane (docs/OBSERVABILITY.md "KV & capacity"): the
+    frontend's /debug/fleet merged per-worker view. WARNs on partial
+    results (some workers unreachable) and on a fleet with zero
+    reachable status servers; FAILs only when the pane itself is
+    broken."""
+    import aiohttp
+    url = url.rstrip("/")
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"{url}/debug/fleet",
+                                   timeout=aiohttp.ClientTimeout(15)) as r:
+                if r.status != 200:
+                    rep.add(FAIL, "/debug/fleet", f"HTTP {r.status}")
+                    return
+                fleet = await r.json()
+            workers = fleet.get("workers") or {}
+            agg = fleet.get("aggregate") or {}
+            if not workers:
+                rep.add(WARN, "/debug/fleet",
+                        "no worker status servers registered "
+                        "(DTPU_SYSTEM_ENABLED=1 enables the pane)")
+            elif fleet.get("partial"):
+                down = [w for w, res in workers.items()
+                        if not res.get("ok")]
+                rep.add(WARN, "/debug/fleet",
+                        f"{agg.get('workers_ok', 0)}/{len(workers)} "
+                        f"workers reachable; down: {', '.join(down)}")
+            else:
+                rep.add(OK, "/debug/fleet",
+                        f"{agg.get('workers_ok', 0)} workers, occupancy "
+                        f"{agg.get('occupancy', 0.0):.2f}, "
+                        f"{agg.get('cached_blocks', 0)} cached blocks, "
+                        f"hit rate {agg.get('hit_rate', 0.0):.2f}")
+            router = ((fleet.get("router") or {}).get("routers") or {})
+            for model, view in router.items():
+                dec = view.get("decisions") or {}
+                if dec.get("decisions"):
+                    rate = dec.get("cache_aware_rate")
+                    rep.add(OK, f"kv routing {model}",
+                            f"{dec['decisions']} decisions, "
+                            f"cache-aware {rate:.2f}, regret p99 "
+                            f"{dec.get('regret_p99')}")
+            async with session.get(f"{url}/debug/kv",
+                                   timeout=aiohttp.ClientTimeout(5)) as r:
+                # 404 = a round_robin/random frontend with no provider:
+                # not an error, just no KV-aware routing to report.
+                if r.status not in (200, 404):
+                    rep.add(FAIL, "/debug/kv", f"HTTP {r.status}")
+    except (aiohttp.ClientError, OSError, asyncio.TimeoutError) as exc:
+        rep.add(FAIL, "fleet kv pane", f"{url}: {exc}")
+
+
 async def run(args) -> int:
     rep = Report()
     check_imports(rep)
@@ -331,6 +397,7 @@ async def run(args) -> int:
     if args.frontend_url:
         await check_frontend(rep, args.frontend_url)
         await check_observability(rep, args.frontend_url)
+        await check_fleet_kv(rep, args.frontend_url)
     n_fail = sum(1 for s, _, _ in rep.rows if s == FAIL)
     print(f"doctor: {len(rep.rows)} checks, {n_fail} failures", flush=True)
     return 1 if rep.failed else 0

@@ -155,6 +155,17 @@ class TPUEngine(AsyncEngine):
         self.g4_blocks = 0
         self.kv_publisher = kv_publisher
         self.metrics_publisher = metrics_publisher
+        # Set by the worker main when the KV data plane runs: the plane
+        # server (outbound stats) and the periodic inventory-digest
+        # publisher (docs/OBSERVABILITY.md "KV & capacity").
+        self.plane = None
+        self.inventory_publisher = None
+        # dynamo_tpu_kv_* exporter (engine/kv_metrics.py): allocator /
+        # tier / plane telemetry onto /metrics, throttled internally.
+        self.kv_metrics = None
+        if metrics_registry is not None:
+            from dynamo_tpu.engine.kv_metrics import KvMetricsUpdater
+            self.kv_metrics = KvMetricsUpdater(metrics_registry)
         b = config.max_num_seqs
         # Slot state (host view; tokens chain on-device between windows).
         self.slot_req: list[_Request | None] = [None] * b
@@ -619,6 +630,57 @@ class TPUEngine(AsyncEngine):
             return n
         return await self.run_job(job)
 
+    # -- KV observability (docs/OBSERVABILITY.md "KV & capacity") -------------
+    def inventory_digest(self):
+        """Compact what-KV-lives-here summary for the event plane
+        (KvInventoryDigest): block counts per tier, capacity headroom,
+        and a k-min sketch over every hash this worker can serve."""
+        from dynamo_tpu.llm.kv_router.protocols import (KvInventoryDigest,
+                                                        kmin_sketch)
+        hashes = list(self.allocator.cached.keys())
+        tier_blocks = {"g1": len(hashes)}
+        if self.host_cache is not None:
+            host_hashes = self.host_cache.block_hashes()
+            tier_blocks["g2"] = len(host_hashes)
+            hashes.extend(host_hashes)
+            disk = self.host_cache.disk
+            if disk is not None:
+                with disk._lock:
+                    disk_hashes = list(disk._index.keys())
+                tier_blocks["g3"] = len(disk_hashes)
+                hashes.extend(disk_hashes)
+        return KvInventoryDigest(
+            blocks=len(self.allocator.cached),
+            tier_blocks=tier_blocks,
+            pages_total=self.allocator.num_pages,
+            pages_free=self.allocator.num_free,
+            pages_active=self.allocator.num_active,
+            sketch=kmin_sketch(hashes))
+
+    def kv_status(self) -> dict:
+        """The /debug/kv body for this worker (runtime/health.py):
+        allocator occupancy/lifecycle counters, offload-tier stats, KV
+        data plane + G4 remote-source telemetry, reuse attribution, and
+        the current inventory digest."""
+        onboard = self.onboard_blocks
+        status = {
+            "role": "engine",
+            "allocator": self.allocator.stats(),
+            "tiers": (self.host_cache.stats()
+                      if self.host_cache is not None else {}),
+            "reuse": {
+                "prefix_hit_blocks": self.prefix_hit_blocks,
+                "prefix_lookup_blocks": self.prefix_lookup_blocks,
+                "onboard_blocks_host": onboard - self.g4_blocks,
+                "onboard_blocks_peer": self.g4_blocks,
+            },
+            "plane": self.plane.stats() if self.plane is not None else None,
+            "remote": (self.remote_source.stats()
+                       if self.remote_source is not None else None),
+            "digest": self.inventory_digest().to_wire(),
+        }
+        return status
+
     def handler(self):
         async def handle(request, context):
             if isinstance(request, dict) and request.get("clear_kv_blocks"):
@@ -859,14 +921,16 @@ class TPUEngine(AsyncEngine):
                 self.host_cache.put(h, kv[:, :, :, i])
 
     def _try_onboard(self, r: _Request, hashes: list[int],
-                     cached_pages: list[int]) -> tuple[list[int], int]:
+                     cached_pages: list[int]) -> tuple[list[int], int, int]:
         """Extend the G1 prefix hit with consecutive G2/G3 blocks — and
         past those, G4 blocks fetched from peer workers' host tiers —
         uploading them into fresh pages (re-registered for sharing)
-        instead of recomputing. Returns (extra_pages, extra_tokens)."""
+        instead of recomputing. Returns (extra_pages, extra_tokens,
+        peer_tokens) — peer_tokens is the G4 share of extra_tokens, for
+        per-request tier attribution."""
         page = self.config.page_size
         if self.host_cache is None and self.remote_source is None:
-            return [], 0
+            return [], 0, 0
         # Never reuse past the second-to-last block (the last token must
         # always be recomputed for logits), matching the G1 rule.
         allowed = (len(r.tokens_all) - 1) // page - len(cached_pages)
@@ -879,6 +943,7 @@ class TPUEngine(AsyncEngine):
                 if kv is None:
                     break
                 blocks.append((h, kv))
+        n_peer = 0
         if self.remote_source is not None and len(blocks) < allowed:
             # G4: one bounded peer round trip for the rest of the run.
             start = len(cached_pages) + len(blocks)
@@ -895,12 +960,13 @@ class TPUEngine(AsyncEngine):
                         # Promote into the local G2 so the next hit is
                         # one NIC hop shorter.
                         self.host_cache.put(h, kv, promotion=True)
-                self.g4_blocks += len(remote)
+                n_peer = len(remote)
+                self.g4_blocks += n_peer
         if not blocks:
-            return [], 0
+            return [], 0, 0
         pages = self.allocator.allocate(len(blocks))
         if pages is None:
-            return [], 0
+            return [], 0, 0
         self._flush_spills()  # the allocation may itself have evicted
         stacked = np.stack([kv for _, kv in blocks], axis=3)
         try:
@@ -908,11 +974,11 @@ class TPUEngine(AsyncEngine):
         except Exception:  # noqa: BLE001
             log.exception("onboard upload failed; recomputing instead")
             self.allocator.release(pages)
-            return [], 0
+            return [], 0, 0
         for (h, _), p in zip(blocks, pages):
             self.allocator.register(p, h)
         self.onboard_blocks += len(blocks)
-        return pages, len(blocks) * page
+        return pages, len(blocks) * page, n_peer * page
 
     def _release_ready_pages(self) -> None:
         """Release deferred pages whose potential writers are done. An
@@ -1230,16 +1296,24 @@ class TPUEngine(AsyncEngine):
             reuse_tokens = len(cached_pages) * page
         self.prefix_lookup_blocks += max(1, len(hashes))
         self.prefix_hit_blocks += len(cached_pages)
+        hbm_tokens = reuse_tokens
         # Extend the prefix from the host tiers (G2/G3) before recomputing.
-        extra_pages, extra_tokens = self._try_onboard(r, hashes, cached_pages)
+        extra_pages, extra_tokens, peer_tokens = self._try_onboard(
+            r, hashes, cached_pages)
         cached_pages = cached_pages + extra_pages
         reuse_tokens += extra_tokens
         r.reuse_tokens = reuse_tokens
         # Accounting attribution (in-process pipelines: the frontend's
-        # ctx IS this ctx, so the ledger record picks these up).
+        # ctx IS this ctx, so the ledger record picks these up), incl.
+        # which tier served the reuse — the "was the cache cold, and
+        # where" signal scripts/slo_report.py rolls up per tenant.
         r.ctx.values["reuse_tokens"] = reuse_tokens
         r.ctx.values["kv_hit_ratio"] = (
             round(reuse_tokens / len(prompt), 4) if prompt else 0.0)
+        r.ctx.values["kv_tiers"] = {
+            "hbm": hbm_tokens,
+            "host": extra_tokens - peer_tokens,
+            "peer": peer_tokens}
         total_prompt_pages = -(-len(prompt) // page)
         need = total_prompt_pages - len(cached_pages)
         new_pages = self.allocator.allocate(need)
@@ -2057,11 +2131,22 @@ class TPUEngine(AsyncEngine):
             self._flight_stall_last = 0.0
 
     def _publish(self) -> None:
+        if self.kv_metrics is not None:
+            # /metrics export is loop-independent (in-process pipelines
+            # without a coordinator still get dynamo_tpu_kv_* series).
+            self.kv_metrics.update(self)
         loop = self._publish_loop
         if loop is None or loop.is_closed():
             self.allocator.drain_events()
             return
         stored, removed = self.allocator.drain_events()
+        # Inventory digest: built on the engine thread only when the
+        # publisher's cadence is due (a k-min sketch over the registered
+        # hashes — bounded work, every ~2s).
+        digest = None
+        if self.inventory_publisher is not None \
+                and self.inventory_publisher.due(time.monotonic()):
+            digest = self.inventory_digest()
         active = sum(1 for r in self.slot_req if r is not None)
         hit = (self.prefix_hit_blocks / self.prefix_lookup_blocks
                if self.prefix_lookup_blocks else 0.0)
@@ -2092,8 +2177,11 @@ class TPUEngine(AsyncEngine):
                 if self.metrics_publisher is not None:
                     force = active == 0 and self.num_waiting == 0
                     await self.metrics_publisher.publish(metrics, force=force)
+                if digest is not None:
+                    await self.inventory_publisher.publish(digest)
             except Exception:  # noqa: BLE001
                 log.exception("publish failed")
 
-        if (self.kv_publisher is not None or self.metrics_publisher is not None):
+        if (self.kv_publisher is not None or self.metrics_publisher is not None
+                or digest is not None):
             asyncio.run_coroutine_threadsafe(do_publish(), loop)

@@ -46,6 +46,14 @@ class PageAllocator:
         # Router event buffers.
         self.stored_events: list[int] = []
         self.removed_events: list[int] = []
+        # Telemetry (plain ints: engine-thread hot path; exported as
+        # dynamo_tpu_kv_* by engine/kv_metrics.py, docs/OBSERVABILITY.md
+        # "KV & capacity").
+        self.reuse_hit_blocks = 0      # cached pages pinned on prefix hits
+        self.reuse_lookup_blocks = 0   # blocks probed by acquire_cached
+        self.evicted_blocks = 0        # LRU evictions under allocation
+        self.cleared_blocks = 0        # pages reclaimed by clear_inactive
+        self.clear_inactive_calls = 0
         # Offload hook (G2 tiering): called as hook(block_hash, page) when
         # an inactive registered page is evicted, BEFORE the page can be
         # handed out — the engine schedules a device->host extract so the
@@ -88,6 +96,7 @@ class PageAllocator:
                 del self.cached[h]
                 del self.cached_by_page[page]
                 self.removed_events.append(h)
+                self.evicted_blocks += 1
                 if self.evict_hook is not None:
                     self.evict_hook(h, page)
             assert page not in self.refs, \
@@ -99,10 +108,12 @@ class PageAllocator:
     def acquire_cached(self, block_hashes: list[int]) -> list[int]:
         """Pin the cached prefix pages for reuse; returns their page ids."""
         pages = []
+        self.reuse_lookup_blocks += len(block_hashes)
         for h in block_hashes:
             page = self.cached.get(h)
             if page is None:
                 break
+            self.reuse_hit_blocks += 1
             # Inactive -> active (stays registered so other sequences can
             # share — refcount tracks active users).
             self.inactive.pop(h, None)
@@ -179,7 +190,27 @@ class PageAllocator:
             self.removed_events.append(h)
             self.free.append(page)
             n += 1
+        self.clear_inactive_calls += 1
+        self.cleared_blocks += n
         return n
+
+    def stats(self) -> dict:
+        """Occupancy + lifecycle counters for /debug/kv and the
+        dynamo_tpu_kv_* exporters (engine/kv_metrics.py)."""
+        return {
+            "pages_total": self.num_pages,
+            "pages_free": len(self.free),
+            "pages_active": len(self.refs),
+            "pages_inactive": len(self.inactive),
+            "cached_blocks": len(self.cached),
+            "occupancy": (len(self.refs) / self.num_pages
+                          if self.num_pages else 0.0),
+            "reuse_hit_blocks": self.reuse_hit_blocks,
+            "reuse_lookup_blocks": self.reuse_lookup_blocks,
+            "evicted_blocks": self.evicted_blocks,
+            "cleared_blocks": self.cleared_blocks,
+            "clear_inactive_calls": self.clear_inactive_calls,
+        }
 
     def drain_events(self) -> tuple[list[int], list[int]]:
         stored, self.stored_events = self.stored_events, []

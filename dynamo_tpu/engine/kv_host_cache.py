@@ -54,6 +54,8 @@ class DiskKVCache:
                     continue
         self.hits = 0
         self.misses = 0
+        self.puts = 0
+        self.block_nbytes = 0  # last stored block's size (uniform per model)
 
     def __contains__(self, block_hash: int) -> bool:
         with self._lock:
@@ -65,6 +67,8 @@ class DiskKVCache:
                 self._index.move_to_end(block_hash)
                 return
         path = os.path.join(self.dir, f"{block_hash & (2**64 - 1):016x}.npy")
+        self.puts += 1
+        self.block_nbytes = kv.nbytes
         # View bf16 as uint16 for npy portability.
         np.save(path, kv.view(np.uint16))
         evicted: list[str] = []
@@ -112,8 +116,10 @@ class HostKVCache:
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        self.puts = 0
         self.spills_in = 0       # blocks offloaded into this tier
         self.demotions = 0       # G2 -> G3 capacity evictions
+        self.block_nbytes = 0    # last stored block's size (uniform)
 
     def __len__(self) -> int:
         with self._lock:
@@ -130,6 +136,8 @@ class HostKVCache:
             # buffers — storing the view would pin the whole base array and
             # blow the capacity bound by the padding/replication factor.
             self._blocks[block_hash] = np.ascontiguousarray(kv)
+            self.puts += 1
+            self.block_nbytes = int(kv.nbytes)
             if not promotion:
                 self.spills_in += 1
             while len(self._blocks) > self.capacity:
@@ -173,11 +181,26 @@ class HostKVCache:
                     pass
 
     def stats(self) -> dict:
-        out = {"g2_blocks": len(self._blocks), "g2_hits": self.hits,
-               "g2_misses": self.misses, "g2_spills_in": self.spills_in,
-               "g2_demotions": self.demotions}
+        n_g2 = len(self._blocks)
+        out = {"g2_blocks": n_g2, "g2_hits": self.hits,
+               "g2_misses": self.misses, "g2_puts": self.puts,
+               "g2_spills_in": self.spills_in,
+               "g2_demotions": self.demotions,
+               "g2_capacity": self.capacity,
+               "g2_bytes": n_g2 * self.block_nbytes}
         if self.disk is not None:
-            out.update({"g3_blocks": len(self.disk._index),
+            n_g3 = len(self.disk._index)
+            out.update({"g3_blocks": n_g3,
                         "g3_hits": self.disk.hits,
-                        "g3_misses": self.disk.misses})
+                        "g3_misses": self.disk.misses,
+                        "g3_puts": self.disk.puts,
+                        "g3_capacity": self.disk.capacity,
+                        "g3_bytes": n_g3 * self.disk.block_nbytes})
         return out
+
+    def block_hashes(self, limit: int = 0) -> list[int]:
+        """Snapshot of resident G2 block hashes (inventory digests);
+        ``limit`` > 0 caps the copy."""
+        with self._lock:
+            keys = list(self._blocks.keys())
+        return keys[:limit] if limit else keys

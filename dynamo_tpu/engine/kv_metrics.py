@@ -1,0 +1,142 @@
+"""dynamo_tpu_kv_* exporter: engine KV state -> Prometheus.
+
+The engine's KV structures (PageAllocator, Host/Disk tiers, the KV data
+plane, the G4 remote source) keep plain-int telemetry so the engine
+thread never takes a Prometheus lock per operation. This updater turns
+those into registered series on a throttle: gauges are set directly,
+monotonic ints become counter *deltas* so restarts of the structures
+(clear_kv_blocks) can't make counters go backwards. Every series here is
+documented in docs/OBSERVABILITY.md "KV & capacity" (tier-1 docs-drift
+guard, tests/test_slo.py).
+"""
+
+from __future__ import annotations
+
+import time
+
+_LAT_BUCKETS = [.001, .005, .01, .025, .05, .1, .25, .5, 1, 2.5]
+
+
+class KvMetricsUpdater:
+    def __init__(self, registry, min_interval_s: float = 0.5):
+        self.min_interval_s = min_interval_s
+        self._next = 0.0
+        self._last: dict[tuple, float] = {}
+        self.g_pages = registry.gauge(
+            "kv_pages", "HBM KV pages by lifecycle state", ["state"])
+        self.g_occupancy = registry.gauge(
+            "kv_occupancy", "Fraction of HBM KV pages held by live "
+            "sequences")
+        self.g_cached_blocks = registry.gauge(
+            "kv_cached_blocks", "Registered (reusable) KV blocks in HBM")
+        self.c_reuse = registry.counter(
+            "kv_reuse_blocks_total", "Prefix blocks reused instead of "
+            "recomputed, by serving tier", ["tier"])
+        self.c_reuse_lookup = registry.counter(
+            "kv_reuse_lookup_blocks_total", "Prefix blocks probed against "
+            "the HBM cache")
+        self.c_evicted = registry.counter(
+            "kv_evicted_blocks_total", "Inactive HBM blocks LRU-evicted "
+            "under allocation pressure")
+        self.c_cleared = registry.counter(
+            "kv_cleared_blocks_total", "HBM blocks dropped by "
+            "clear_inactive (admin clear_kv_blocks)")
+        self.g_tier_blocks = registry.gauge(
+            "kv_tier_blocks", "Resident KV blocks per offload tier",
+            ["tier"])
+        self.g_tier_bytes = registry.gauge(
+            "kv_tier_bytes", "Approximate bytes per offload tier", ["tier"])
+        self.c_tier_hits = registry.counter(
+            "kv_tier_hits_total", "Block gets served by an offload tier",
+            ["tier"])
+        self.c_tier_misses = registry.counter(
+            "kv_tier_misses_total", "Block gets that missed an offload "
+            "tier", ["tier"])
+        self.c_tier_spills = registry.counter(
+            "kv_tier_spills_total", "Blocks offloaded into a tier (g2: "
+            "HBM evictions; g3: g2 capacity demotions)", ["tier"])
+        self.c_plane_pulls = registry.counter(
+            "kv_plane_pulls_total", "KV-plane parcel pulls completed by "
+            "this worker")
+        self.c_plane_pull_seconds = registry.counter(
+            "kv_plane_pull_seconds_total", "Wall-clock seconds spent in "
+            "KV-plane pulls (rate / pulls rate = mean latency)")
+        self.c_plane_bytes = registry.counter(
+            "kv_plane_bytes_total", "KV-plane bulk bytes by direction",
+            ["direction"])
+        self.c_plane_blocks_served = registry.counter(
+            "kv_plane_blocks_served_total", "G4 blocks served to peers "
+            "from this worker's host tiers")
+        for tier in ("hbm", "host", "peer"):
+            self.c_reuse.ensure(tier=tier)
+        for bound in (self.g_occupancy, self.g_cached_blocks,
+                      self.c_reuse_lookup, self.c_evicted, self.c_cleared,
+                      self.c_plane_pulls, self.c_plane_pull_seconds,
+                      self.c_plane_blocks_served):
+            bound.ensure()
+
+    def _delta(self, bound, key: tuple, current: float, **labels) -> None:
+        prev = self._last.get(key, 0.0)
+        if current > prev:
+            bound.inc(current - prev, **labels)
+        self._last[key] = current
+
+    def update(self, engine, force: bool = False) -> None:
+        """Engine-thread safe (Prometheus child ops take a lock, but only
+        every ``min_interval_s``). ``engine`` duck-types TPUEngine: needs
+        .allocator, .host_cache, .onboard_blocks, .g4_blocks, and
+        optionally .plane / .remote_source set by the worker main."""
+        now = time.monotonic()
+        if not force and now < self._next:
+            return
+        self._next = now + self.min_interval_s
+        alloc = engine.allocator.stats()
+        self.g_pages.set(alloc["pages_free"], state="free")
+        self.g_pages.set(alloc["pages_active"], state="active")
+        self.g_pages.set(alloc["pages_inactive"], state="inactive")
+        self.g_occupancy.set(alloc["occupancy"])
+        self.g_cached_blocks.set(alloc["cached_blocks"])
+        self._delta(self.c_reuse_lookup, ("lookup",),
+                    alloc["reuse_lookup_blocks"])
+        self._delta(self.c_evicted, ("evicted",), alloc["evicted_blocks"])
+        self._delta(self.c_cleared, ("cleared",), alloc["cleared_blocks"])
+        # Reuse attribution by tier: HBM hits from the allocator, host
+        # (G2/G3) vs peer (G4) from the engine's onboard counters.
+        g4 = getattr(engine, "g4_blocks", 0)
+        onboard = getattr(engine, "onboard_blocks", 0)
+        self._delta(self.c_reuse, ("reuse", "hbm"),
+                    alloc["reuse_hit_blocks"], tier="hbm")
+        self._delta(self.c_reuse, ("reuse", "host"), onboard - g4,
+                    tier="host")
+        self._delta(self.c_reuse, ("reuse", "peer"), g4, tier="peer")
+        host = getattr(engine, "host_cache", None)
+        if host is not None:
+            tiers = host.stats()
+            for tier in ("g2", "g3"):
+                if f"{tier}_blocks" not in tiers:
+                    continue
+                self.g_tier_blocks.set(tiers[f"{tier}_blocks"], tier=tier)
+                self.g_tier_bytes.set(tiers.get(f"{tier}_bytes", 0),
+                                      tier=tier)
+                self._delta(self.c_tier_hits, ("hits", tier),
+                            tiers[f"{tier}_hits"], tier=tier)
+                self._delta(self.c_tier_misses, ("misses", tier),
+                            tiers[f"{tier}_misses"], tier=tier)
+            self._delta(self.c_tier_spills, ("spills", "g2"),
+                        tiers.get("g2_spills_in", 0), tier="g2")
+            self._delta(self.c_tier_spills, ("spills", "g3"),
+                        tiers.get("g2_demotions", 0), tier="g3")
+        remote = getattr(engine, "remote_source", None)
+        if remote is not None:
+            client = remote.client
+            self._delta(self.c_plane_pulls, ("pulls",), client.transfers)
+            self._delta(self.c_plane_pull_seconds, ("pull_s",),
+                        client.pull_seconds_total)
+            self._delta(self.c_plane_bytes, ("bytes", "in"),
+                        client.bytes_in, direction="in")
+        plane = getattr(engine, "plane", None)
+        if plane is not None:
+            self._delta(self.c_plane_bytes, ("bytes", "out"),
+                        plane.bytes_out, direction="out")
+            self._delta(self.c_plane_blocks_served, ("served",),
+                        plane.blocks_served)

@@ -131,11 +131,16 @@ class HttpService:
         app.router.add_get("/health", self._health)
         app.router.add_get("/live", self._live)
         app.router.add_get("/metrics", self._metrics)
+        # Fleet KV/capacity pane (llm/fleet.py): fans out over every
+        # registered worker status server; typed partial results.
+        app.router.add_get("/debug/fleet", self._debug_fleet)
         # Tracing/profiling debug API (runtime/health.py): in-process
         # pipelines get /debug/traces + /debug/profile on the frontend
-        # port too, not only on the per-worker status server.
+        # port too, not only on the per-worker status server. The
+        # frontend's /debug/kv serves the KV routers' fleet view +
+        # decision telemetry.
         from dynamo_tpu.runtime.health import add_debug_routes
-        add_debug_routes(app)
+        add_debug_routes(app, kv_provider=self._kv_router_status)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         ssl_ctx = None
@@ -903,6 +908,49 @@ class HttpService:
                                     iid, exc)
             results[name] = per_model
         return web.json_response({"cleared": results})
+
+    # -- KV & capacity pane (docs/OBSERVABILITY.md "KV & capacity") -----------
+    def _kv_router_status(self) -> dict:
+        """This frontend's /debug/kv: per-model KV-router fleet view +
+        decision telemetry, plus in-process engines' KV state for the
+        unified launcher (no worker status server to ask)."""
+        routers = {}
+        engines = {}
+        for name, served in self.manager.models.items():
+            status = getattr(served.router, "kv_status", None)
+            if status is not None:
+                routers[name] = status()
+            if served.client is None:
+                engine = getattr(
+                    getattr(served.preprocessor, "inner", None), "inner",
+                    None)
+                engine_status = getattr(engine, "kv_status", None)
+                if engine_status is not None:
+                    engines[name] = engine_status()
+        return {"role": "frontend", "routers": routers, "engines": engines}
+
+    async def _debug_fleet(self, request: web.Request) -> web.Response:
+        """GET /debug/fleet: merged per-worker KV/capacity view from
+        every registered worker status server (bounded fan-out, typed
+        partial results — one down worker never breaks the pane)."""
+        from dynamo_tpu.llm.fleet import (DEFAULT_CONCURRENCY,
+                                          DEFAULT_TIMEOUT_S,
+                                          fleet_kv_snapshot)
+        if not self._runtime.has_discovery:
+            return web.json_response(
+                {"error": "static runtime: no discovery plane to "
+                 "enumerate worker status servers"}, status=503)
+        try:
+            timeout_s = float(request.query.get("timeout_s",
+                                                DEFAULT_TIMEOUT_S))
+            concurrency = int(request.query.get("concurrency",
+                                                DEFAULT_CONCURRENCY))
+        except ValueError:
+            return _error_body("timeout_s/concurrency must be numeric")
+        snapshot = await fleet_kv_snapshot(
+            self._runtime, timeout_s=timeout_s, concurrency=concurrency,
+            router_view=self._kv_router_status)
+        return web.json_response(snapshot)
 
     async def _models(self, _request: web.Request) -> web.Response:
         return web.json_response({"object": "list",

@@ -229,6 +229,14 @@ class KvPlaneServer:
         self.block_requests = 0
         self.blocks_served = 0
 
+    def stats(self) -> dict:
+        with self._lock:
+            staged = len(self._staged)
+        return {"transfers": self.transfers, "bytes_out": self.bytes_out,
+                "block_requests": self.block_requests,
+                "blocks_served": self.blocks_served, "staged": staged,
+                "addr": self.address}
+
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -510,7 +518,17 @@ class KvPlaneClient:
         self.transfers = 0
         self.bytes_in = 0
         self.jax_pulls = 0
+        # Pull-latency aggregates (count + wall-clock sum): rate(sum)/
+        # rate(count) is the fleet's mean pull latency on /metrics.
+        self.pull_seconds_total = 0.0
+        self.pull_failures = 0
         self._use_jax = None  # probed on first jax-path ticket
+
+    def stats(self) -> dict:
+        return {"transfers": self.transfers, "bytes_in": self.bytes_in,
+                "jax_pulls": self.jax_pulls,
+                "pull_seconds_total": self.pull_seconds_total,
+                "pull_failures": self.pull_failures}
 
     # -- sync core (executor) ------------------------------------------------
     def _conn_for(self, addr: str) -> tuple[socket.socket, threading.Lock]:
@@ -563,6 +581,17 @@ class KvPlaneClient:
             return None
 
     def pull_sync(self, ticket: dict) -> np.ndarray:
+        t0 = time.monotonic()
+        try:
+            out = self._pull_sync_inner(ticket)
+        except (ConnectionError, OSError):
+            self.pull_failures += 1
+            raise
+        finally:
+            self.pull_seconds_total += time.monotonic() - t0
+        return out
+
+    def _pull_sync_inner(self, ticket: dict) -> np.ndarray:
         out = self._pull_jax(ticket)
         if out is not None:
             self.transfers += 1
@@ -719,6 +748,13 @@ class RemoteBlockSource:
         self.fetched_blocks = 0
         self.fetch_failures = 0
         self.slow_peer_cooldowns = 0
+
+    def stats(self) -> dict:
+        return {"peers": len(self.peers),
+                "fetched_blocks": self.fetched_blocks,
+                "fetch_failures": self.fetch_failures,
+                "slow_peer_cooldowns": self.slow_peer_cooldowns,
+                **{f"client_{k}": v for k, v in self.client.stats().items()}}
 
     def fetch(self, hashes: list[int], max_blocks: int
               ) -> list[tuple[int, np.ndarray]]:

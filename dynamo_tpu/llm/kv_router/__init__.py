@@ -11,10 +11,12 @@ sync. The TPU engine and the mocker both emit the same event format.
 from dynamo_tpu.llm.kv_router.protocols import (
     ForwardPassMetrics,
     KvCacheEvent,
+    KvInventoryDigest,
     KvStats,
     RouterEvent,
     WorkerStats,
 )
+from dynamo_tpu.llm.kv_router.fleet import DecisionLog, FleetInventory
 from dynamo_tpu.llm.kv_router.indexer import KvIndexer, OverlapScores, RadixTree
 from dynamo_tpu.llm.kv_router.scheduler import KvRouterConfig, KvScheduler
 from dynamo_tpu.llm.kv_router.router import (
@@ -23,14 +25,19 @@ from dynamo_tpu.llm.kv_router.router import (
 )
 from dynamo_tpu.llm.kv_router.publisher import (
     KvEventPublisher,
+    KvInventoryPublisher,
     WorkerMetricsPublisher,
 )
 
 __all__ = [
+    "DecisionLog",
+    "FleetInventory",
     "ForwardPassMetrics",
     "KvCacheEvent",
     "KvEventPublisher",
     "KvIndexer",
+    "KvInventoryDigest",
+    "KvInventoryPublisher",
     "KvPushRouter",
     "KvRouterConfig",
     "KvScheduler",

@@ -141,6 +141,12 @@ class ApproxKvIndexer:
     def touch(self, worker_id: int, token_ids: list[int]) -> None:
         from dynamo_tpu.llm.tokens import compute_block_hashes
 
+        # Amortized purge: expiry used to run only inside
+        # find_matches_for_tokens, so a caller that only touch()es (or a
+        # router that stopped matching a quiet worker) let stale entries
+        # pin routing decisions past ttl_s. Every mutation now sweeps the
+        # expiry heap head first — O(expired) per call, not O(index).
+        self.purge()
         hashes = compute_block_hashes(token_ids, self.block_size)
         if not hashes:
             return

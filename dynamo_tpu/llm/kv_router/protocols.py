@@ -1,14 +1,44 @@
-"""KV-router wire protocols: cache events and worker load metrics.
+"""KV-router wire protocols: cache events, worker load metrics, and
+fleet inventory digests.
 
 Capability parity with reference kv_router/protocols.rs: KvCacheEvent
 (stored/removed/cleared, :KvCacheEventData), RouterEvent (worker_id + event),
 and ForwardPassMetrics{WorkerStats, KvStats, SpecDecodeStats} (:32-56) that
-workers publish each engine iteration.
+workers publish each engine iteration. On top of those,
+``KvInventoryDigest``: a compact periodic summary of *what KV lives where*
+(block counts per tier, capacity headroom, a k-min sketch of the block hash
+space) that rides the same event plane — the measured ground the fleet-wide
+KV federation round (ROADMAP item 4) builds on, and the source of the
+router's `/debug/kv` fleet view (docs/OBSERVABILITY.md "KV & capacity").
 """
 
 from __future__ import annotations
 
+import heapq
+
 from pydantic import BaseModel, Field
+
+#: k-min sketch size: 64 minima of the 64-bit hash space estimate overlap
+#: between two workers' inventories to ~±12% — plenty for an operator pane.
+SKETCH_K = 64
+_HASH_MASK = (1 << 64) - 1
+
+
+def kmin_sketch(hashes, k: int = SKETCH_K) -> list[int]:
+    """The k smallest 64-bit-normalized block hashes: a fixed-size,
+    mergeable summary of a hash set (k-minimum-values sketch)."""
+    return heapq.nsmallest(k, (h & _HASH_MASK for h in hashes))
+
+
+def sketch_overlap(a: list[int], b: list[int], k: int = SKETCH_K) -> float:
+    """Estimated Jaccard overlap of the two sketched hash sets: the
+    fraction of the merged k smallest values present in both sketches."""
+    if not a or not b:
+        return 0.0
+    merged = heapq.nsmallest(min(k, len(a) + len(b)), set(a) | set(b))
+    sa, sb = set(a), set(b)
+    inter = sum(1 for h in merged if h in sa and h in sb)
+    return inter / len(merged)
 
 
 class KvStoredBlock(BaseModel):
@@ -91,6 +121,37 @@ class ForwardPassMetrics(BaseModel):
         return cls.model_validate(data)
 
 
+class KvInventoryDigest(BaseModel):
+    """Periodic per-worker KV inventory summary (worker -> router/planner).
+
+    Deliberately compact: counts + a fixed-size sketch, never the full
+    hash list — a 100k-block worker digests to ~1 KB. ``seq`` is a
+    per-worker monotonic counter so consumers can drop reordered
+    digests; ``ts`` is the publisher's wall clock for staleness."""
+
+    worker_id: int = 0
+    seq: int = 0
+    ts: float = 0.0
+    # Resident registered blocks in HBM (G1) and blocks per offload tier.
+    blocks: int = 0
+    tier_blocks: dict[str, int] = Field(default_factory=dict)
+    # Capacity picture: the router/planner's headroom signal.
+    pages_total: int = 0
+    pages_free: int = 0
+    pages_active: int = 0
+    # k-min sketch over every block hash this worker can serve (HBM +
+    # host tiers) — overlap between workers is estimable without
+    # shipping inventories.
+    sketch: list[int] = Field(default_factory=list)
+
+    def to_wire(self) -> dict:
+        return self.model_dump()
+
+    @classmethod
+    def from_wire(cls, data: dict) -> "KvInventoryDigest":
+        return cls.model_validate(data)
+
+
 # Subjects on the coordinator pub/sub plane (reference kv_router.rs:56-65).
 def kv_events_subject(namespace: str, component: str) -> str:
     return f"ns.{namespace}.cp.{component}.kv_events"
@@ -103,3 +164,9 @@ def load_metrics_subject(namespace: str, component: str) -> str:
 def router_sync_subject(namespace: str, component: str) -> str:
     """Inter-replica router state sync (reference kv_router.rs:64-65)."""
     return f"ns.{namespace}.cp.{component}.router_sync"
+
+
+def kv_inventory_subject(namespace: str, component: str) -> str:
+    """Fleet inventory digests (KvInventoryDigest), alongside kv_events
+    and load_metrics on the event plane."""
+    return f"ns.{namespace}.cp.{component}.kv_inventory"

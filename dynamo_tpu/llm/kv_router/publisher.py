@@ -11,12 +11,15 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import time
 
 from dynamo_tpu.llm.kv_router.protocols import (
     ForwardPassMetrics,
     KvCacheEvent,
+    KvInventoryDigest,
     RouterEvent,
     kv_events_subject,
+    kv_inventory_subject,
     load_metrics_subject,
 )
 from dynamo_tpu.runtime.logging import get_logger
@@ -70,3 +73,65 @@ class WorkerMetricsPublisher:
             return
         self._last = now
         await self._client.publish(self.subject, metrics.to_wire())
+
+
+class KvInventoryPublisher:
+    """Publishes KvInventoryDigest snapshots on the event plane; the
+    digest is a *summary* (counts + sketch) so the default cadence is
+    coarser than load metrics — inventories change at block granularity,
+    not per token."""
+
+    def __init__(self, runtime, namespace: str, component: str, worker_id: int,
+                 min_interval_s: float = 2.0):
+        self._client = runtime.require_coordinator()
+        self.subject = kv_inventory_subject(namespace, component)
+        self.worker_id = worker_id
+        self.min_interval_s = min_interval_s
+        self._last = 0.0
+        self._seq = 0
+        self.published = 0
+        self._periodic: asyncio.Task | None = None
+
+    def due(self, now: float) -> bool:
+        """Cheap engine-loop gate: is the next digest worth building?"""
+        return now - self._last >= self.min_interval_s
+
+    async def publish(self, digest: KvInventoryDigest,
+                      force: bool = False) -> None:
+        loop = asyncio.get_running_loop()
+        now = loop.time()
+        if not force and now - self._last < self.min_interval_s:
+            return
+        self._last = now
+        self._seq += 1
+        digest.worker_id = self.worker_id
+        digest.seq = self._seq
+        digest.ts = time.time()
+        await self._client.publish(self.subject, digest.to_wire())
+        self.published += 1
+
+    def start_periodic(self, digest_fn) -> None:
+        """Background republish so IDLE workers still advertise inventory:
+        the engine loops only publish while processing, but the fleet
+        pane must include workers that received no traffic. The throttle
+        in publish() dedups against engine-loop publishes."""
+
+        async def loop() -> None:
+            while True:
+                await asyncio.sleep(self.min_interval_s)
+                try:
+                    await self.publish(digest_fn())
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 — telemetry, keep going
+                    # (Includes "dict changed size" races against the
+                    # engine thread: the next tick just retries.)
+                    log.exception("periodic inventory publish failed")
+
+        if self._periodic is None:
+            self._periodic = asyncio.create_task(loop())
+
+    def stop_periodic(self) -> None:
+        if self._periodic is not None:
+            self._periodic.cancel()
+            self._periodic = None

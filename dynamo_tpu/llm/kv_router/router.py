@@ -12,14 +12,18 @@ re-publishing their add/free decisions on the router_sync subject
 from __future__ import annotations
 
 import asyncio
+import time
 import uuid
 from typing import AsyncIterator
 
+from dynamo_tpu.llm.kv_router.fleet import DecisionLog, FleetInventory
 from dynamo_tpu.llm.kv_router.indexer import KvIndexer
 from dynamo_tpu.llm.kv_router.protocols import (
     ForwardPassMetrics,
+    KvInventoryDigest,
     RouterEvent,
     kv_events_subject,
+    kv_inventory_subject,
     load_metrics_subject,
     router_sync_subject,
 )
@@ -52,6 +56,42 @@ class KvPushRouter(AsyncEngine):
         self._tasks: list[asyncio.Task] = []
         self._bg_tasks: set[asyncio.Task] = set()
         self._subs = []
+        # Fleet KV observability (docs/OBSERVABILITY.md "KV & capacity"):
+        # inventory digests per worker + per-decision chosen-vs-best
+        # overlap telemetry, served on /debug/kv in this process.
+        self.fleet = FleetInventory()
+        self.decisions = DecisionLog()
+        # Satellite: KvStats already flow over the load-metrics subject —
+        # surface them as labeled gauges on THIS process's /metrics so
+        # dashboards can chart fleet KV utilization from the frontend.
+        m = runtime.metrics.namespace(namespace).component(component)
+        self._g_usage = m.gauge(
+            "kv_worker_usage", "Per-worker KV pool usage fraction "
+            "(router view of published KvStats)", ["worker"])
+        self._g_active_blocks = m.gauge(
+            "kv_worker_active_blocks", "Per-worker active KV blocks",
+            ["worker"])
+        self._g_total_blocks = m.gauge(
+            "kv_worker_total_blocks", "Per-worker total KV blocks",
+            ["worker"])
+        self._g_hit_rate = m.gauge(
+            "kv_worker_prefix_hit_rate", "Per-worker prefix-cache hit rate",
+            ["worker"])
+        self._g_inventory = m.gauge(
+            "kv_fleet_inventory_blocks", "Registered KV blocks per worker "
+            "from inventory digests", ["worker"])
+        self._g_digest_age = m.gauge(
+            "kv_fleet_digest_age_seconds", "Age of the newest inventory "
+            "digest per worker", ["worker"])
+        self._h_overlap = m.histogram(
+            "kv_router_overlap_blocks", "Routing-decision overlap scores "
+            "in blocks", ["kind"],
+            buckets=[0, 1, 2, 4, 8, 16, 32, 64, 128, 256])
+        self._c_decisions = m.counter(
+            "kv_router_decisions_total", "Routing decisions by cache "
+            "awareness", ["outcome"])
+        for outcome in ("best", "suboptimal"):
+            self._c_decisions.ensure(outcome=outcome)
 
     async def start(self) -> None:
         coord = self._runtime.require_coordinator()
@@ -61,11 +101,14 @@ class KvPushRouter(AsyncEngine):
             load_metrics_subject(self.namespace, self.component))
         sync_sub = await coord.subscribe(
             router_sync_subject(self.namespace, self.component))
-        self._subs = [ev_sub, load_sub, sync_sub]
+        inv_sub = await coord.subscribe(
+            kv_inventory_subject(self.namespace, self.component))
+        self._subs = [ev_sub, load_sub, sync_sub, inv_sub]
         self._tasks = [
             asyncio.create_task(self._event_loop(ev_sub)),
             asyncio.create_task(self._load_loop(load_sub)),
             asyncio.create_task(self._sync_loop(sync_sub)),
+            asyncio.create_task(self._inventory_loop(inv_sub)),
             asyncio.create_task(self._prune_loop()),
         ]
 
@@ -87,10 +130,28 @@ class KvPushRouter(AsyncEngine):
     async def _load_loop(self, sub) -> None:
         async for msg in sub:
             try:
-                self.scheduler.update_metrics(
-                    ForwardPassMetrics.from_wire(msg["payload"]))
+                metrics = ForwardPassMetrics.from_wire(msg["payload"])
+                self.scheduler.update_metrics(metrics)
+                worker = f"{metrics.worker_id:x}"
+                ks = metrics.kv_stats
+                self._g_usage.set(ks.gpu_cache_usage_perc, worker=worker)
+                self._g_active_blocks.set(ks.kv_active_blocks, worker=worker)
+                self._g_total_blocks.set(ks.kv_total_blocks, worker=worker)
+                self._g_hit_rate.set(ks.gpu_prefix_cache_hit_rate,
+                                     worker=worker)
             except Exception:  # noqa: BLE001
                 log.exception("bad load metrics")
+
+    async def _inventory_loop(self, sub) -> None:
+        async for msg in sub:
+            try:
+                digest = KvInventoryDigest.from_wire(msg["payload"])
+                if self.fleet.apply(digest):
+                    worker = f"{digest.worker_id:x}"
+                    self._g_inventory.set(digest.blocks, worker=worker)
+                    self._g_digest_age.set(0.0, worker=worker)
+            except Exception:  # noqa: BLE001
+                log.exception("bad kv inventory digest")
 
     async def _sync_loop(self, sub) -> None:
         """Apply other replicas' optimistic add/free events."""
@@ -118,17 +179,46 @@ class KvPushRouter(AsyncEngine):
         while True:
             await asyncio.sleep(1.0)
             live = set(self.client.instance_ids())
-            for worker in self.indexer.tree.workers() - live:
+            gone = ((self.indexer.tree.workers()
+                     | self.fleet.workers()) - live)
+            for worker in gone:
                 absent_ticks[worker] = absent_ticks.get(worker, 0) + 1
                 if absent_ticks[worker] >= 3:
                     log.info("worker %x gone; dropping its indexed blocks",
                              worker)
                     self.indexer.tree.remove_worker(worker)
                     self.scheduler.remove_worker(worker)
+                    self.fleet.remove_worker(worker)
+                    hexid = f"{worker:x}"
+                    for gauge in (self._g_usage, self._g_active_blocks,
+                                  self._g_total_blocks, self._g_hit_rate,
+                                  self._g_inventory):
+                        gauge.set(0, worker=hexid)
                     absent_ticks.pop(worker, None)
             for worker in list(absent_ticks):
                 if worker in live:
                     absent_ticks.pop(worker)
+            # Digest staleness: the gauge ages between digests so the
+            # dashboard sees a wedged publisher climb, not flatline.
+            now = time.monotonic()
+            for worker in self.fleet.workers():
+                entry = self.fleet._digests.get(worker)
+                if entry is not None:
+                    self._g_digest_age.set(now - entry[0],
+                                           worker=f"{worker:x}")
+
+    def kv_status(self) -> dict:
+        """This router's /debug/kv block: index size, fleet inventory
+        view, and decision telemetry (runtime/health.py _debug_kv)."""
+        return {
+            "role": "kv_router",
+            "component": self.component,
+            "index": {"blocks": self.indexer.tree.num_blocks,
+                      "workers": sorted(f"{w:x}" for w in
+                                        self.indexer.tree.workers())},
+            "fleet": self.fleet.snapshot(),
+            "decisions": self.decisions.snapshot(),
+        }
 
     async def _publish_sync(self, payload: dict) -> None:
         payload["replica"] = self.replica_id
@@ -152,7 +242,19 @@ class KvPushRouter(AsyncEngine):
             workers = self.client.instance_ids()
             worker_id, overlap = self.scheduler.select(
                 workers, request_blocks, overlaps)
+            # Decision telemetry: chosen-vs-best overlap — how
+            # cache-aware this decision actually was. "Best" is over the
+            # candidates that COULD have been chosen, so breaker/busy
+            # exclusions count as (visible) regret, not noise.
+            best_overlap = max(overlaps.values(), default=0)
+            self.decisions.note(worker_id, overlap, best_overlap,
+                                request_blocks)
+            self._h_overlap.observe(overlap, kind="chosen")
+            self._h_overlap.observe(best_overlap, kind="best")
+            self._c_decisions.inc(outcome=("best" if overlap >= best_overlap
+                                           else "suboptimal"))
             sp.set(worker_id=f"{worker_id:x}", overlap_blocks=overlap,
+                   best_overlap_blocks=best_overlap,
                    request_blocks=request_blocks)
             new_blocks = request_blocks - overlap
             request_id = context.id

@@ -144,11 +144,13 @@ class _Seq:
 
 class MockerEngine(AsyncEngine):
     def __init__(self, config: MockerConfig | None = None,
-                 kv_publisher=None, metrics_publisher=None):
+                 kv_publisher=None, metrics_publisher=None,
+                 inventory_publisher=None):
         self.config = config or MockerConfig()
         self.kv = KvCacheSim(self.config.num_kv_blocks)
         self.kv_publisher = kv_publisher
         self.metrics_publisher = metrics_publisher
+        self.inventory_publisher = inventory_publisher
         self.waiting: list[_Seq] = []
         self.prefilling: list[_Seq] = []
         self.decoding: list[_Seq] = []
@@ -229,6 +231,7 @@ class MockerEngine(AsyncEngine):
             try:
                 await self._flush_events()
                 await self._publish_metrics()
+                await self._publish_inventory()
             except asyncio.CancelledError:
                 raise
             except Exception as exc:  # noqa: BLE001 — publishing must not
@@ -332,3 +335,50 @@ class MockerEngine(AsyncEngine):
                 kv_total_blocks=cfg.num_kv_blocks,
                 gpu_cache_usage_perc=self.kv.active_blocks / cfg.num_kv_blocks,
                 gpu_prefix_cache_hit_rate=hit_rate)), force=force)
+
+    # -- KV observability (docs/OBSERVABILITY.md "KV & capacity") -------------
+    def inventory_digest(self):
+        """Same digest shape the TPU engine publishes, from the
+        simulated block pool (fleet-pane tests without hardware)."""
+        from dynamo_tpu.llm.kv_router.protocols import (KvInventoryDigest,
+                                                        kmin_sketch)
+        cfg = self.config
+        hashes = list(self.kv._blocks.keys())
+        return KvInventoryDigest(
+            blocks=len(hashes),
+            tier_blocks={"g1": len(hashes)},
+            pages_total=cfg.num_kv_blocks,
+            pages_free=cfg.num_kv_blocks - self.kv.active_blocks,
+            pages_active=self.kv.active_blocks,
+            sketch=kmin_sketch(hashes))
+
+    async def _publish_inventory(self) -> None:
+        if self.inventory_publisher is None:
+            return
+        loop = asyncio.get_running_loop()
+        if self.inventory_publisher.due(loop.time()):
+            await self.inventory_publisher.publish(self.inventory_digest())
+
+    def kv_status(self) -> dict:
+        """The /debug/kv body for a mocker worker."""
+        cfg = self.config
+        return {
+            "role": "mocker",
+            "allocator": {
+                "pages_total": cfg.num_kv_blocks,
+                "pages_free": cfg.num_kv_blocks - self.kv.active_blocks,
+                "pages_active": self.kv.active_blocks,
+                "pages_inactive": self.kv.cached_blocks
+                - self.kv.active_blocks,
+                "cached_blocks": self.kv.cached_blocks,
+                "occupancy": self.kv.active_blocks / cfg.num_kv_blocks,
+                "reuse_hit_blocks": self.prefix_hits,
+                "reuse_lookup_blocks": self.prefix_lookups,
+            },
+            "tiers": {},
+            "reuse": {"prefix_hit_blocks": self.prefix_hits,
+                      "prefix_lookup_blocks": self.prefix_lookups},
+            "plane": None,
+            "remote": None,
+            "digest": self.inventory_digest().to_wire(),
+        }

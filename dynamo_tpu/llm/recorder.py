@@ -295,6 +295,9 @@ def make_account(route: str, model: str, ctx=None) -> dict:
         "output_tokens": None,
         "reuse_tokens": None,
         "kv_hit_ratio": None,
+        # Which tier served the reuse ({"hbm": n, "host": n, "peer": n}
+        # prompt tokens): the "was the cache cold, and where" signal.
+        "kv_tiers": None,
         "queue_wait_s": None,
         "ttft_s": None,
         "itl_p50_s": None,
@@ -325,7 +328,8 @@ def finish_account(acct: dict, status: str, reason: str | None = None,
     if ctx is not None:
         values = getattr(ctx, "values", {})
         for key in ("worker_id", "migrations", "migration_reason",
-                    "reuse_tokens", "kv_hit_ratio", "queue_wait_s"):
+                    "reuse_tokens", "kv_hit_ratio", "kv_tiers",
+                    "queue_wait_s"):
             if values.get(key) is not None:
                 acct[key] = values[key]
     (ledger or get_ledger()).record(acct)

@@ -19,6 +19,12 @@ serves the tracing/SLO/accounting/flight debug API:
 - ``GET /debug/flight``                   — flight-recorder ring +
   meta; ``POST /debug/flight`` captures a diagnostic bundle to disk
   (``{"out_dir": ...}`` optional; runtime/flight.py)
+- ``GET /debug/kv``                       — this process's KV/capacity
+  view (docs/OBSERVABILITY.md "KV & capacity"): on a worker, the
+  engine's allocator/tier/plane stats + inventory digest; on a
+  frontend, the KV router's fleet view + decision telemetry. The
+  provider is per-app (``app[KV_PROVIDER]``), NOT process-global, so
+  in-process multi-worker tests keep distinct panes.
 """
 
 from __future__ import annotations
@@ -35,7 +41,17 @@ from dynamo_tpu.runtime.logging import get_logger
 log = get_logger("health")
 
 
-def add_debug_routes(app: web.Application) -> None:
+#: App key under which a process registers its /debug/kv provider — a
+#: zero-arg callable returning the JSON-able KV status dict (e.g.
+#: TPUEngine.kv_status, MockerEngine.kv_status, KvPushRouter.kv_status).
+try:
+    KV_PROVIDER = web.AppKey("dtpu_kv_provider", object)
+except AttributeError:  # older aiohttp: plain string keys
+    KV_PROVIDER = "dtpu_kv_provider"
+
+
+def add_debug_routes(app: web.Application,
+                     kv_provider=None) -> None:
     """Attach the observability debug routes (shared with the OpenAI
     frontend so in-process pipelines get them without a status server)."""
     app.router.add_get("/debug/traces", _debug_traces)
@@ -45,6 +61,25 @@ def add_debug_routes(app: web.Application) -> None:
     app.router.add_get("/debug/requests", _debug_requests)
     app.router.add_get("/debug/flight", _debug_flight)
     app.router.add_post("/debug/flight", _debug_flight_capture)
+    app.router.add_get("/debug/kv", _debug_kv)
+    if kv_provider is not None:
+        app[KV_PROVIDER] = kv_provider
+
+
+async def _debug_kv(request: web.Request) -> web.Response:
+    provider = request.app.get(KV_PROVIDER)
+    if provider is None:
+        return web.json_response(
+            {"error": "no KV status provider on this process (a worker "
+             "registers its engine, a KV-mode frontend its router)"},
+            status=404)
+    try:
+        body = provider()
+    except Exception as exc:  # noqa: BLE001 — a pane, not a crash vector
+        log.exception("kv status provider failed")
+        return web.json_response({"error": f"kv provider failed: {exc}"},
+                                 status=500)
+    return web.json_response(body)
 
 
 async def _debug_slo(_request: web.Request) -> web.Response:
@@ -114,7 +149,7 @@ async def _debug_profile(request: web.Request) -> web.Response:
 
 class SystemStatusServer:
     def __init__(self, runtime, host: str = "0.0.0.0", port: int = 0,
-                 role_manager=None):
+                 role_manager=None, kv_provider=None):
         self._runtime = runtime
         self.host, self.port = host, port
         self._endpoint_health: dict[str, bool] = {}
@@ -122,6 +157,8 @@ class SystemStatusServer:
         # llm/reconfig.RoleManager: enables the SetRole control verb on
         # this worker's status path (GET/POST /control/role).
         self.role_manager = role_manager
+        # /debug/kv provider for THIS worker (engine.kv_status).
+        self.kv_provider = kv_provider
 
     def set_endpoint_health(self, endpoint_path: str, healthy: bool) -> None:
         self._endpoint_health[endpoint_path] = healthy
@@ -133,7 +170,7 @@ class SystemStatusServer:
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/control/role", self._role_status)
         app.router.add_post("/control/role", self._role_set)
-        add_debug_routes(app)
+        add_debug_routes(app, kv_provider=self.kv_provider)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)

@@ -32,6 +32,14 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_reconfig.py -q -m 'not slow' -k 'smoke' \
     -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
 
+echo "== fleet-pane smoke (KV & capacity observability) =="
+# 2 mocker workers + frontend: /debug/fleet aggregates both, tolerates
+# one worker's status server down (typed partial result), digests reach
+# the router's fleet view, doctor reads the pane. All mocker-backed.
+timeout -k 10 180 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_fleet_pane.py -q -k 'smoke' \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+
 echo "== chunked-prefill smoke (stall-free scheduling) =="
 # Tiny CPU model: one long prompt prefilling in chunks with concurrent
 # short decoders — asserts completion, decode windows interleaved between

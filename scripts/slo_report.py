@@ -5,7 +5,8 @@ The frontend (``--request-log`` / ``DTPU_SLO_REQUEST_LOG_PATH``) appends
 one JSON object per finished or shed request (llm/recorder.py
 ``RequestLedger``). This tool turns a day of that into the table an
 operator actually wants: per-tenant / per-priority counts, shed + error
-rates, TTFT/ITL percentiles, and token volumes.
+rates, TTFT/ITL percentiles, token volumes, and KV-cache economics
+(token-weighted hit rate + which tier served the reuse).
 
 Usage:
     python scripts/slo_report.py /var/log/dtpu/requests.jsonl
@@ -61,6 +62,19 @@ def rollup(records: list[dict], by: list[str]) -> dict[tuple, dict]:
                        if r.get("ttft_s") is not None)
         itl99 = sorted(r["itl_p99_s"] for r in recs
                        if r.get("itl_p99_s") is not None)
+        # KV cache economics per group: token-weighted hit rate (reused
+        # prompt tokens / prompt tokens, over records that carried
+        # attribution) and which tier served the reuse — the "tenant's
+        # TTFT regressed: was the cache cold?" answer.
+        attributed = [r for r in recs if r.get("reuse_tokens") is not None
+                      and r.get("prompt_tokens")]
+        reuse_tok = sum(r["reuse_tokens"] for r in attributed)
+        prompt_tok_attr = sum(r["prompt_tokens"] for r in attributed)
+        tier_tokens = collections.Counter()
+        for r in attributed:
+            for tier, tok in (r.get("kv_tiers") or {}).items():
+                if tok:
+                    tier_tokens[tier] += tok
         out[key] = {
             "requests": n,
             "ok": counts.get("ok", 0),
@@ -74,6 +88,10 @@ def rollup(records: list[dict], by: list[str]) -> dict[tuple, dict]:
             "itl_p99_s": percentile(itl99, 0.99),
             "prompt_tokens": sum(r.get("prompt_tokens") or 0 for r in recs),
             "output_tokens": sum(r.get("output_tokens") or 0 for r in recs),
+            "kv_hit_rate": (round(reuse_tok / prompt_tok_attr, 4)
+                            if prompt_tok_attr else None),
+            "kv_reuse_tokens": reuse_tok,
+            "kv_tier_tokens": dict(tier_tokens),
             "migrations": sum(r.get("migrations") or 0 for r in recs),
             # Cost attribution for migrated requests: how many retries
             # each cause forced (e.g. role_flip drains vs plain worker
@@ -91,7 +109,8 @@ def rollup(records: list[dict], by: list[str]) -> dict[tuple, dict]:
 
 def render(table: dict[tuple, dict], by: list[str]) -> str:
     cols = ("requests", "ok", "shed", "error", "shed_rate", "error_rate",
-            "ttft_p50_s", "ttft_p99_s", "itl_p99_s", "output_tokens")
+            "ttft_p50_s", "ttft_p99_s", "itl_p99_s", "output_tokens",
+            "kv_hit_rate")
     key_w = max([len(" / ".join(k)) for k in table] + [len("/".join(by)), 5])
     lines = [f"{'/'.join(by):<{key_w}}  " +
              "  ".join(f"{c:>12}" for c in cols)]
@@ -113,6 +132,10 @@ def render(table: dict[tuple, dict], by: list[str]) -> str:
             mig = ", ".join(f"{k}={v}"
                             for k, v in row["migration_reasons"].items())
             lines.append(f"{'':<{key_w}}  migrations: {mig}")
+        if row.get("kv_tier_tokens"):
+            tiers = ", ".join(f"{k}={v}"
+                              for k, v in row["kv_tier_tokens"].items())
+            lines.append(f"{'':<{key_w}}  kv reuse by tier: {tiers}")
     return "\n".join(lines) + "\n"
 
 

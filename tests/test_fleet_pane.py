@@ -1,0 +1,384 @@
+"""Fleet KV & capacity pane e2e (PR 8): /debug/kv on workers,
+/debug/fleet aggregation on the frontend, inventory digests over the
+event plane, router decision telemetry, and the slo_report KV rollups.
+
+All mocker-backed (no engine spin-up): the smoke test is the
+scripts/check.sh fleet-pane stage.
+"""
+
+import asyncio
+
+import aiohttp
+from conftest import async_test
+
+from dynamo_tpu.engine.kv_metrics import KvMetricsUpdater
+from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+from dynamo_tpu.llm.fleet import fleet_kv_snapshot, register_status_server
+from dynamo_tpu.llm.http_service import HttpService
+from dynamo_tpu.llm.kv_router import make_kv_router_factory
+from dynamo_tpu.llm.kv_router.publisher import (
+    KvEventPublisher,
+    KvInventoryPublisher,
+    WorkerMetricsPublisher,
+)
+from dynamo_tpu.llm.mocker import MockerConfig, MockerEngine
+from dynamo_tpu.llm.model_card import register_llm
+from dynamo_tpu.llm.tokenizer import make_test_tokenizer
+from dynamo_tpu.runtime import chaos
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.coordinator import Coordinator
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.health import SystemStatusServer
+from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+NS = "fleettest"
+MODEL = "mock-model"
+FAST = dict(prefill_tokens_per_s=1e7, decode_step_s=0.0005)
+
+
+async def start_worker(coord):
+    """One mocker worker with the full KV observability surface: event +
+    metrics + inventory publishers, a status server with /debug/kv, and
+    a lease-bound system/ registration for the fleet pane."""
+    rt = await DistributedRuntime.from_settings(
+        RuntimeConfig(coordinator_url=coord.url, lease_ttl_s=1.0,
+                      namespace=NS))
+    config = MockerConfig(**FAST)
+    kv_pub = KvEventPublisher(rt, NS, "mocker", rt.instance_id)
+    m_pub = WorkerMetricsPublisher(rt, NS, "mocker", rt.instance_id,
+                                   min_interval_s=0.01)
+    inv_pub = KvInventoryPublisher(rt, NS, "mocker", rt.instance_id,
+                                   min_interval_s=0.02)
+    engine = MockerEngine(config, kv_pub, m_pub,
+                          inventory_publisher=inv_pub)
+    endpoint = rt.namespace(NS).component("mocker").endpoint("generate")
+    server = await endpoint.serve_endpoint(engine.handler(),
+                                           graceful_shutdown=False)
+    await register_llm(rt, endpoint, MODEL, make_test_tokenizer(),
+                       kv_cache_block_size=config.block_size)
+    engine.start()
+    inv_pub.start_periodic(engine.inventory_digest)
+    status = SystemStatusServer(rt, host="127.0.0.1", port=0,
+                                kv_provider=engine.kv_status)
+    await status.start()
+    await register_status_server(rt, status.port,
+                                 extra={"backend": "mocker"})
+    return rt, engine, server, status
+
+
+async def start_frontend(coord):
+    rt = await DistributedRuntime.from_settings(
+        RuntimeConfig(coordinator_url=coord.url, lease_ttl_s=1.0,
+                      namespace=NS))
+    manager = ModelManager()
+    watcher = ModelWatcher(rt, manager, router_mode="kv",
+                           kv_router_factory=make_kv_router_factory())
+    await watcher.start()
+    service = HttpService(rt, manager, host="127.0.0.1", port=0)
+    await service.start()
+    return rt, manager, watcher, service
+
+
+async def wait_model(manager, n_instances=1, timeout=10.0):
+    for _ in range(int(timeout / 0.02)):
+        served = manager.get(MODEL)
+        if served and len(served.client.instance_ids()) >= n_instances:
+            return served
+        await asyncio.sleep(0.02)
+    raise AssertionError(f"{MODEL} never discovered with "
+                         f"{n_instances} instances")
+
+
+async def post_chat(session, port, content, max_tokens=8):
+    async with session.post(
+            f"http://127.0.0.1:{port}/v1/chat/completions",
+            json={"model": MODEL, "max_tokens": max_tokens,
+                  "messages": [{"role": "user", "content": content}]}) as r:
+        return r.status, await r.json()
+
+
+async def get_json(session, port, path):
+    async with session.get(f"http://127.0.0.1:{port}{path}") as r:
+        return r.status, await r.json()
+
+
+@async_test(timeout=120)
+async def test_fleet_pane_smoke_two_workers_and_partial_path():
+    """check.sh fleet-pane smoke: /debug/fleet merges a 2-worker mocker
+    fleet, and when one worker's status server dies the pane degrades to
+    a TYPED partial result instead of an exception."""
+    coord = Coordinator()
+    await coord.start()
+    w1 = await start_worker(coord)
+    w2 = await start_worker(coord)
+    f_rt, manager, watcher, service = await start_frontend(coord)
+    try:
+        await wait_model(manager, n_instances=2)
+        async with aiohttp.ClientSession() as session:
+            # Traffic so the mockers register blocks, publish digests,
+            # and the router makes (and logs) decisions.
+            for i in range(6):
+                status, _ = await post_chat(
+                    session, service.port,
+                    f"shared prefix number {i % 2} " * 20)
+                assert status == 200
+            # -- the merged fleet view -----------------------------------
+            status, fleet = await get_json(session, service.port,
+                                           "/debug/fleet")
+            assert status == 200
+            assert len(fleet["workers"]) == 2
+            assert fleet["partial"] is False and fleet["errors"] == 0
+            agg = fleet["aggregate"]
+            assert agg["workers_ok"] == 2
+            assert agg["pages_total"] == 2 * 1024  # MockerConfig default
+            for res in fleet["workers"].values():
+                assert res["ok"] is True
+                assert res["kv"]["role"] == "mocker"
+                assert "digest" in res["kv"]
+            # -- worker-local pane ---------------------------------------
+            status, kv = await get_json(session, w1[3].port, "/debug/kv")
+            assert status == 200
+            assert kv["allocator"]["pages_total"] == 1024
+            assert kv["digest"]["tier_blocks"]["g1"] >= 1
+            # -- router decision telemetry on the frontend ---------------
+            status, front_kv = await get_json(session, service.port,
+                                              "/debug/kv")
+            assert status == 200
+            decisions = front_kv["routers"][MODEL]["decisions"]
+            assert decisions["decisions"] >= 6
+            assert decisions["cache_aware_rate"] is not None
+            # -- inventory digests reached the router over the event
+            #    plane (poll: pub/sub is async) -------------------------
+            for _ in range(100):
+                status, front_kv = await get_json(session, service.port,
+                                                  "/debug/kv")
+                if front_kv["routers"][MODEL]["fleet"]["totals"][
+                        "workers"] >= 2:
+                    break
+                await post_chat(session, service.port, "keep publishing")
+                await asyncio.sleep(0.05)
+            fleet_view = front_kv["routers"][MODEL]["fleet"]
+            assert fleet_view["totals"]["workers"] >= 2
+            assert fleet_view["totals"]["blocks"] >= 1
+            # -- satellite: KvStats reach the router's /metrics ----------
+            async with session.get(
+                    f"http://127.0.0.1:{service.port}/metrics") as r:
+                body = await r.text()
+            assert "dynamo_tpu_kv_worker_usage" in body
+            assert "dynamo_tpu_kv_router_decisions_total" in body
+            assert "dynamo_tpu_kv_fleet_inventory_blocks" in body
+            # -- partial-result path: one status server down -------------
+            await w2[3].stop()
+            status, fleet = await get_json(session, service.port,
+                                           "/debug/fleet")
+            assert status == 200  # typed, not an exception
+            assert fleet["partial"] is True and fleet["errors"] == 1
+            down = [r for r in fleet["workers"].values() if not r["ok"]]
+            assert len(down) == 1 and "error" in down[0]
+            assert fleet["aggregate"]["workers_ok"] == 1
+            assert fleet["aggregate"]["workers_down"] == 1
+            # -- doctor reads the same pane ------------------------------
+            from dynamo_tpu.doctor import WARN, Report, check_fleet_kv
+            rep = Report()
+            await check_fleet_kv(rep,
+                                 f"http://127.0.0.1:{service.port}")
+            statuses = {c: s for s, c, _ in rep.rows}
+            assert statuses["/debug/fleet"] == WARN  # partial fleet
+    finally:
+        await service.stop()
+        await watcher.stop()
+        await f_rt.close()
+        for rt, engine, server, status in (w1, w2):
+            engine.inventory_publisher.stop_periodic()
+            await engine.stop()
+            await status.stop()
+            await rt.close()
+        await coord.stop()
+
+
+@async_test(timeout=120)
+async def test_inventory_digests_survive_chaos_without_breaking_routing():
+    """Acceptance: digests round-trip over the event plane under the
+    chaos plane (coordinator frame drops) while routing keeps serving —
+    the observability plane must not become a new failure mode."""
+    coord = Coordinator()
+    await coord.start()
+    chaos.uninstall()
+    try:
+        with chaos.active("seed=21;frame.drop@coord=0.02"):
+            w1 = await start_worker(coord)
+            f_rt, manager, watcher, service = await start_frontend(coord)
+            try:
+                await wait_model(manager)
+                seen_digest = False
+                async with aiohttp.ClientSession() as session:
+                    for i in range(20):
+                        status, body = await post_chat(
+                            session, service.port, f"chaos prefix {i}")
+                        assert status == 200, body
+                        _, front_kv = await get_json(
+                            session, service.port, "/debug/kv")
+                        fleet_view = front_kv["routers"][MODEL]["fleet"]
+                        if fleet_view["totals"]["workers"] >= 1:
+                            seen_digest = True
+                            break
+                        await asyncio.sleep(0.05)
+                assert seen_digest, \
+                    "no inventory digest survived the chaos plane"
+            finally:
+                await service.stop()
+                await watcher.stop()
+                await f_rt.close()
+                w1[1].inventory_publisher.stop_periodic()
+                await w1[1].stop()
+                await w1[3].stop()
+                await w1[0].close()
+    finally:
+        chaos.uninstall()
+        await coord.stop()
+
+
+@async_test
+async def test_fleet_snapshot_direct_empty_and_static():
+    """fleet_kv_snapshot degrades typed: no registrations -> empty pane,
+    a registration with no reachable server -> per-worker error."""
+    coord = Coordinator()
+    await coord.start()
+    rt = await DistributedRuntime.from_settings(
+        RuntimeConfig(coordinator_url=coord.url, lease_ttl_s=1.0,
+                      namespace=NS))
+    try:
+        snap = await fleet_kv_snapshot(rt)
+        assert snap["workers"] == {} and snap["errors"] == 0
+        assert snap["aggregate"]["workers_ok"] == 0
+        # A registered worker whose status server is gone: typed error.
+        await rt.require_coordinator().kv_put(
+            f"system/{NS}/dead1", {"addr": "127.0.0.1:1"})
+        snap = await fleet_kv_snapshot(rt, timeout_s=0.5)
+        assert snap["partial"] is True
+        assert snap["workers"]["dead1"]["ok"] is False
+        assert "error" in snap["workers"]["dead1"]
+    finally:
+        await rt.close()
+        await coord.stop()
+
+
+# -- unit: engine kv metrics exporter -----------------------------------------
+
+
+class _StubAllocator:
+    def __init__(self):
+        self.n = 0
+
+    def stats(self):
+        self.n += 1
+        return {"pages_total": 100, "pages_free": 60, "pages_active": 30,
+                "pages_inactive": 10, "cached_blocks": 40,
+                "occupancy": 0.3, "reuse_hit_blocks": 8 * self.n,
+                "reuse_lookup_blocks": 10 * self.n,
+                "evicted_blocks": 2 * self.n, "cleared_blocks": 0,
+                "clear_inactive_calls": 0}
+
+
+class _StubHostCache:
+    def stats(self):
+        return {"g2_blocks": 5, "g2_hits": 3, "g2_misses": 1, "g2_puts": 6,
+                "g2_spills_in": 6, "g2_demotions": 1, "g2_capacity": 8,
+                "g2_bytes": 5120, "g3_blocks": 1, "g3_hits": 0,
+                "g3_misses": 1, "g3_puts": 1, "g3_capacity": 64,
+                "g3_bytes": 1024}
+
+
+class _StubEngine:
+    def __init__(self):
+        self.allocator = _StubAllocator()
+        self.host_cache = _StubHostCache()
+        self.onboard_blocks = 7
+        self.g4_blocks = 2
+        self.remote_source = None
+        self.plane = None
+
+
+def test_kv_metrics_updater_exports_and_deltas():
+    reg = MetricsRegistry().namespace("t").component("w")
+    upd = KvMetricsUpdater(reg, min_interval_s=0.0)
+    engine = _StubEngine()
+    upd.update(engine, force=True)
+    root = MetricsRegistry.__init__  # noqa: F841 — readability only
+    assert upd.g_pages.get(state="free") == 60
+    assert upd.g_occupancy.get() == 0.3
+    assert upd.c_reuse.get(tier="hbm") == 8
+    assert upd.c_reuse.get(tier="host") == 5   # onboard - g4
+    assert upd.c_reuse.get(tier="peer") == 2
+    assert upd.c_tier_hits.get(tier="g2") == 3
+    assert upd.g_tier_bytes.get(tier="g2") == 5120
+    assert upd.c_tier_spills.get(tier="g3") == 1  # g2 demotions
+    # Second update: counters advance by the DELTA, never reset.
+    upd.update(engine, force=True)
+    assert upd.c_reuse.get(tier="hbm") == 16
+    assert upd.c_evicted.get() == 4
+    # Exposition carries the documented names.
+    text = reg.expose().decode()
+    assert "dynamo_tpu_kv_pages{" in text
+    assert "dynamo_tpu_kv_reuse_blocks_total{" in text
+    assert "dynamo_tpu_kv_tier_hits_total{" in text
+
+
+# -- unit: ledger attribution + slo_report rollup ------------------------------
+
+
+def test_slo_report_rolls_up_kv_hit_rate_per_tenant(tmp_path):
+    """Acceptance: per-tenant KV hit-rate appears in scripts/slo_report.py
+    output from ledger records."""
+    import json
+    import sys
+    sys.path.insert(0, "scripts")
+    try:
+        import slo_report
+    finally:
+        sys.path.pop(0)
+    recs = [
+        {"status": "ok", "tenant": "acme", "priority": "interactive",
+         "prompt_tokens": 100, "output_tokens": 10, "reuse_tokens": 80,
+         "kv_hit_ratio": 0.8, "kv_tiers": {"hbm": 64, "host": 16,
+                                           "peer": 0}, "ttft_s": 0.05},
+        {"status": "ok", "tenant": "acme", "priority": "interactive",
+         "prompt_tokens": 100, "output_tokens": 10, "reuse_tokens": 40,
+         "kv_tiers": {"hbm": 40, "host": 0, "peer": 0}, "ttft_s": 0.06},
+        {"status": "ok", "tenant": "cold-co", "priority": "interactive",
+         "prompt_tokens": 200, "output_tokens": 10, "reuse_tokens": 0,
+         "kv_tiers": {"hbm": 0, "host": 0, "peer": 0}, "ttft_s": 0.4},
+    ]
+    path = tmp_path / "requests.jsonl"
+    path.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    table = slo_report.rollup(slo_report.load_records(str(path)),
+                              ["tenant"])
+    acme = table[("acme",)]
+    assert acme["kv_hit_rate"] == 0.6          # (80+40)/200
+    assert acme["kv_reuse_tokens"] == 120
+    assert acme["kv_tier_tokens"] == {"hbm": 104, "host": 16}
+    cold = table[("cold-co",)]
+    assert cold["kv_hit_rate"] == 0.0          # the "cache was cold" answer
+    rendered = slo_report.render(table, ["tenant"])
+    assert "kv_hit_rate" in rendered
+    assert "kv reuse by tier" in rendered
+
+
+def test_ledger_record_carries_kv_tier_attribution():
+    from dynamo_tpu.llm.recorder import (RequestLedger, finish_account,
+                                         make_account)
+
+    class _Ctx:
+        id = "r1"
+        trace_id = "t1"
+        values = {"reuse_tokens": 48, "kv_hit_ratio": 0.75,
+                  "kv_tiers": {"hbm": 32, "host": 16, "peer": 0},
+                  "worker_id": "ab12"}
+
+    ledger = RequestLedger(capacity=4)
+    acct = make_account("chat_completions", MODEL)
+    finish_account(acct, "ok", http_status=200, ctx=_Ctx(), ledger=ledger)
+    rec = ledger.recent(1)[0]
+    assert rec["reuse_tokens"] == 48
+    assert rec["kv_tiers"] == {"hbm": 32, "host": 16, "peer": 0}
+    assert rec["worker_id"] == "ab12"

@@ -101,3 +101,79 @@ def test_failed_request_unregister_then_release():
     a.unregister(p)   # contents suspect; still referenced
     a.release(p)      # deferred release
     assert sorted(a.allocate(2)) == sorted(p)
+
+
+# -- drain_events / clear_inactive / telemetry edge cases ----------------------
+# These semantics back the dynamo_tpu_kv_* reuse counters and the
+# router's index (stored/removed events): pin them (PR 8 satellite).
+
+
+def test_release_while_cached_emits_no_removed_event():
+    """Releasing a still-registered page moves it ACTIVE -> INACTIVE:
+    the block stays served from this worker, so the router must NOT see
+    a removed event (it would mis-route the next same-prefix request)."""
+    a = PageAllocator(num_pages=3, page_size=16)
+    p = a.allocate(1)
+    a.register(p[0], 42)
+    stored, removed = a.drain_events()
+    assert stored == [42] and removed == []
+    a.release(p)
+    stored, removed = a.drain_events()
+    assert stored == [] and removed == []
+    assert a.lookup([42]) == [p[0]]  # still reusable
+
+
+def test_reregister_of_evicted_hash_emits_stored_again():
+    """Evict a hash, then a later sequence completes the same block on a
+    different page: the router's view must go stored -> removed ->
+    stored (not deduped away), or the fleet index goes stale."""
+    a = PageAllocator(num_pages=3, page_size=16)
+    p = a.allocate(2)
+    a.register(p[0], 7)
+    a.register(p[1], 8)
+    a.release(p)
+    a.drain_events()
+    fresh = a.allocate(2)  # evicts both (LRU): removed events for 7, 8
+    _, removed = a.drain_events()
+    assert set(removed) == {7, 8}
+    assert a.evicted_blocks == 2
+    a.register(fresh[0], 7)  # same content recomputed on a new page
+    stored, removed = a.drain_events()
+    assert stored == [7] and removed == []
+    assert a.lookup([7]) == [fresh[0]]
+
+
+def test_clear_inactive_spares_active_and_counts():
+    """clear_inactive drops ONLY inactive registrations (live pages keep
+    theirs) and the reclaim counters feed kv_cleared_blocks_total."""
+    a = PageAllocator(num_pages=4, page_size=16)
+    p = a.allocate(3)
+    a.register(p[0], 1)
+    a.register(p[1], 2)
+    a.register(p[2], 3)
+    a.release([p[0], p[1]])  # 1, 2 inactive; 3 still active
+    a.drain_events()
+    assert a.clear_inactive() == 2
+    _, removed = a.drain_events()
+    assert set(removed) == {1, 2}
+    assert a.cleared_blocks == 2 and a.clear_inactive_calls == 1
+    # The active page's registration survives the admin clear.
+    assert a.lookup([3]) == [p[2]]
+    stats = a.stats()
+    assert stats["pages_active"] == 1 and stats["pages_free"] == 2
+
+
+def test_reuse_counters_track_hits_and_lookups():
+    a = PageAllocator(num_pages=4, page_size=16)
+    p = a.allocate(2)
+    a.register(p[0], 10)
+    a.register(p[1], 11)
+    a.release(p)
+    got = a.acquire_cached([10, 11, 12])  # 2 hits out of 3 probed
+    assert got == p
+    assert a.reuse_hit_blocks == 2
+    assert a.reuse_lookup_blocks == 3
+    a.release(got)
+    stats = a.stats()
+    assert stats["reuse_hit_blocks"] == 2
+    assert stats["reuse_lookup_blocks"] == 3

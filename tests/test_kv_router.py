@@ -168,3 +168,92 @@ def test_approx_indexer_ttl():
     idx2 = ApproxKvIndexer(block_size=16, ttl_s=60.0)
     idx2.touch(5, toks)
     assert idx2.find_matches_for_tokens(toks) == {5: 2}
+
+
+def test_approx_indexer_purges_quiet_worker_on_touch():
+    """Regression (PR 8 satellite): purge() relied on callers running
+    find_matches — a router that only touch()ed let a QUIET worker's
+    expired entries pin the radix tree past ttl_s. touch() now purges
+    amortized, so routing traffic alone expires stale state."""
+    import time as _time
+    idx = ApproxKvIndexer(block_size=16, ttl_s=0.01)
+    quiet = list(range(32))
+    idx.touch(1, quiet)            # worker 1 then goes quiet
+    assert idx.tree.find_matches(
+        compute_block_hashes(quiet, 16)) == {1: 2}
+    _time.sleep(0.03)              # past ttl
+    # Only touches for OTHER workers/prefixes arrive — no find_matches.
+    idx.touch(2, list(range(100, 132)))
+    # The quiet worker's entries are gone from the tree itself (not just
+    # filtered at match time).
+    assert idx.tree.find_matches(
+        compute_block_hashes(quiet, 16)) == {}
+
+
+def test_kmin_sketch_overlap_estimates_jaccard():
+    """KMV overlap estimation assumes uniformly-distributed values —
+    which chained block hashes are (llm/tokens.py hash_block)."""
+    import random
+    from dynamo_tpu.llm.kv_router.protocols import kmin_sketch, sketch_overlap
+    rng = random.Random(0)
+    universe = [rng.getrandbits(64) for _ in range(1500)]
+    a = kmin_sketch(universe[:1000])
+    assert len(a) == 64 and a == sorted(a)
+    # Identical sets -> overlap 1; disjoint -> 0.
+    assert sketch_overlap(a, kmin_sketch(universe[:1000])) == 1.0
+    assert sketch_overlap(
+        a, kmin_sketch(rng.getrandbits(64) for _ in range(1000))) == 0.0
+    # Half-overlapping sets (true Jaccard 1/3) land in a sane band.
+    est = sketch_overlap(a, kmin_sketch(universe[500:1500]))
+    assert 0.15 < est < 0.55
+    assert sketch_overlap([], a) == 0.0
+
+
+def test_inventory_digest_round_trip_and_fleet_view():
+    from dynamo_tpu.llm.kv_router.fleet import FleetInventory
+    from dynamo_tpu.llm.kv_router.protocols import (KvInventoryDigest,
+                                                    kmin_sketch)
+    fleet = FleetInventory(stale_s=30.0)
+    d1 = KvInventoryDigest(
+        worker_id=0xa, seq=1, blocks=10, tier_blocks={"g1": 10},
+        pages_total=100, pages_free=60, pages_active=40,
+        sketch=kmin_sketch(range(10)))
+    d2 = KvInventoryDigest(
+        worker_id=0xb, seq=1, blocks=8, tier_blocks={"g1": 6, "g2": 2},
+        pages_total=100, pages_free=90, pages_active=10,
+        sketch=kmin_sketch(range(5, 13)))
+    assert fleet.apply(KvInventoryDigest.from_wire(d1.to_wire()))
+    assert fleet.apply(d2)
+    # Reordered (stale seq) digests are dropped, newer ones win.
+    assert not fleet.apply(KvInventoryDigest(worker_id=0xa, seq=1))
+    assert fleet.apply(KvInventoryDigest(
+        worker_id=0xa, seq=2, blocks=12, pages_total=100, pages_free=55,
+        pages_active=45))
+    snap = fleet.snapshot()
+    assert snap["totals"]["workers"] == 2
+    assert snap["totals"]["blocks"] == 12 + 8
+    assert snap["workers"]["a"]["seq"] == 2
+    assert snap["workers"]["b"]["tier_blocks"] == {"g1": 6, "g2": 2}
+    assert snap["workers"]["b"]["headroom"] == 0.9
+    # Overlap matrix present for the sketched pair (a's seq-2 digest
+    # carries no sketch, so no pair remains).
+    fleet.remove_worker(0xa)
+    assert fleet.workers() == {0xb}
+
+
+def test_decision_log_chosen_vs_best():
+    """Router decision telemetry: chosen-vs-best overlap — the 'how
+    cache-aware was this decision actually' signal (PR 8 acceptance)."""
+    from dynamo_tpu.llm.kv_router.fleet import DecisionLog
+    log = DecisionLog(capacity=8)
+    log.note(0xa, chosen_overlap=4, best_overlap=4, request_blocks=8)
+    log.note(0xb, chosen_overlap=0, best_overlap=6, request_blocks=8)
+    log.note(0xa, chosen_overlap=2, best_overlap=2, request_blocks=4)
+    snap = log.snapshot()
+    assert snap["decisions"] == 3
+    assert snap["cache_aware"] == 2
+    assert abs(snap["cache_aware_rate"] - 2 / 3) < 1e-9
+    assert snap["regret_blocks_total"] == 6
+    assert snap["best_overlap_p99"] == 6
+    assert snap["recent"][-1] == {"worker": "a", "chosen": 2, "best": 2,
+                                  "blocks": 4}

@@ -623,3 +623,19 @@ def test_docs_drift_new_series_are_documented():
     }
     missing = required - documented
     assert not missing, f"undocumented series: {sorted(missing)}"
+
+
+def test_docs_drift_kv_series_are_documented():
+    """PR 8 acceptance: every dynamo_tpu_kv_* series registered in the
+    source is documented in docs/OBSERVABILITY.md "KV & capacity" — the
+    whole family, scanned from registration sites so a new kv_ metric
+    can't ship undocumented."""
+    doc = (REPO / "docs" / "OBSERVABILITY.md").read_text()
+    documented = set(_DOC_NAME_RE.findall(doc))
+    kv_registered = {n for n in _registered_metric_names()
+                     if n.startswith("kv_")
+                     and not n.startswith("kv_transfer")}
+    assert len(kv_registered) >= 20, \
+        f"expected the full kv_ family, scan found {sorted(kv_registered)}"
+    missing = kv_registered - documented
+    assert not missing, f"undocumented kv series: {sorted(missing)}"
