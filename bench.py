@@ -37,6 +37,18 @@ import time
 
 import numpy as np
 
+def perf_snapshot(engine) -> dict:
+    """The perf-plane section every bench JSON embeds (scripts/
+    perf_gate.py diffs it against a committed baseline): per-program
+    compile counts/seconds, the unexpected-recompile total (MUST be 0
+    in steady state), and the roofline-attributed window series."""
+    from dynamo_tpu.engine import perf
+    reg = perf.get_registry()
+    return {"compiles": reg.snapshot(), "window": reg.window_snapshot(),
+            "hbm": engine.runner.hbm_stats(),
+            "memory": engine.runner.memory_breakdown()}
+
+
 ISL = int(os.environ.get("BENCH_ISL", "128"))
 OSL = int(os.environ.get("BENCH_OSL", "128"))
 BATCH = int(os.environ.get("BENCH_BATCH", "40"))
@@ -235,6 +247,7 @@ async def main_async(mode: str = "serve"):
         by_el = sorted(r["elapsed_s"] for r in pres)
         med_round = sorted(pres, key=lambda r: r["elapsed_s"])[len(pres) // 2]
         med = BATCH * ISL / by_el[len(by_el) // 2]
+        perf = perf_snapshot(engine)
         engine.stop()
         print(json.dumps({
             "metric": f"prefill_tok_s_per_chip_{spec.name}_bs{BATCH}"
@@ -250,6 +263,7 @@ async def main_async(mode: str = "serve"):
                 "ttft_p99_ms": round(med_round["ttft_p99_ms"], 1),
                 "platform": jax.devices()[0].platform,
                 "device": str(jax.devices()[0]),
+                "perf": perf,
             },
         }))
         return
@@ -265,6 +279,7 @@ async def main_async(mode: str = "serve"):
         med = sorted(rounds_m,
                      key=lambda r: r["itl_gap_p99_ms_during_prefill"])[
                          len(rounds_m) // 2]
+        perf = perf_snapshot(engine)
         engine.stop()
         steady_p99 = med["itl_gap_p99_ms_steady"]
         during_p99 = med["itl_gap_p99_ms_during_prefill"]
@@ -290,6 +305,7 @@ async def main_async(mode: str = "serve"):
                 "decode_window": config.decode_window,
                 "platform": jax.devices()[0].platform,
                 "device": str(jax.devices()[0]),
+                "perf": perf,
             },
         }))
         return
@@ -328,6 +344,7 @@ async def main_async(mode: str = "serve"):
     pre_elapsed = sorted(r["elapsed_s"] for r in pres)
     prefill_tok_s_measured = BATCH * ISL / pre_elapsed[1]
     prefill_spread = [round(BATCH * ISL / e, 1) for e in pre_elapsed]
+    perf = perf_snapshot(engine)
     engine.stop()
 
     # Roofline context: one decode step must read all weights once.
@@ -366,6 +383,7 @@ async def main_async(mode: str = "serve"):
             "pipeline_depth": config.pipeline_depth,
             "platform": jax.devices()[0].platform,
             "device": str(jax.devices()[0]),
+            "perf": perf,
         },
     }))
 

@@ -18,7 +18,7 @@ from dynamo_tpu.analysis.core import (
 from dynamo_tpu.analysis.rules_async import (
     BlockingCallInAsync, FireAndForgetTask, LockAcrossAwait,
     SwallowedCancellation, UnboundedQueue, UnboundedWait)
-from dynamo_tpu.analysis.rules_jax import JitRecompileHazard
+from dynamo_tpu.analysis.rules_jax import JitRecompileHazard, UnregisteredJit
 from dynamo_tpu.analysis.rules_metrics import DirectPrometheusImport
 from dynamo_tpu.analysis.rules_wire import WireErrorTaxonomy
 
@@ -35,6 +35,7 @@ DEFAULT_RULES: tuple[type[Rule], ...] = (
     UnboundedQueue,
     UnboundedWait,
     JitRecompileHazard,
+    UnregisteredJit,
     DirectPrometheusImport,
     WireErrorTaxonomy,
 )

@@ -160,3 +160,51 @@ class JitRecompileHazard(Rule):
                                     for t in sub.targets):
                         return True
         return False
+
+
+class UnregisteredJit(Rule):
+    """The perf plane's compile observatory (engine/perf.py
+    CompileRegistry) only sees programs built through
+    ``perf.instrumented_jit`` — a raw ``jax.jit`` call site is a dark
+    program: its compiles never reach ``perf_compiles_total``, and the
+    unexpected-recompile detector (the runtime twin of
+    jit-recompile-hazard) cannot watch it. One-shot jits that never
+    dispatch from the serving loop (e.g. runner._mh_zeros pool
+    creation) carry a justified suppression instead."""
+
+    rule_id = "unregistered-jit"
+    description = ("`jax.jit` call site outside engine/perf.py: serving "
+                   "programs must be built through perf.instrumented_jit "
+                   "so the compile observatory counts their compiles and "
+                   "the unexpected-recompile detector watches them")
+
+    _ALLOWED_SUFFIX = "engine/perf.py"
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        path = module.path.replace("\\", "/")
+        if path.endswith(self._ALLOWED_SUFFIX):
+            return
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call) and _is_jit_ctor(node):
+                yield self.finding(
+                    module, node,
+                    "`jax.jit` outside engine/perf.py: this program is "
+                    "invisible to the compile observatory "
+                    "(perf_compiles_total, unexpected-recompile detector)",
+                    "build it with perf.instrumented_jit(program, fn, "
+                    "key=<shape key>, **jit_kwargs); suppress only for "
+                    "one-shot jits that never dispatch from the serving "
+                    "loop")
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # bare `@jax.jit` decorator creates an unregistered
+                # program just the same.
+                for dec in node.decorator_list:
+                    if not isinstance(dec, ast.Call) \
+                            and qualified_name(dec) in _JIT_QUALS:
+                        yield self.finding(
+                            module, dec,
+                            f"`@jax.jit` on `{node.name}` outside "
+                            "engine/perf.py: this program is invisible to "
+                            "the compile observatory",
+                            "wrap with perf.instrumented_jit instead of "
+                            "the bare decorator")

@@ -124,7 +124,8 @@ async def run(args: argparse.Namespace) -> None:
             status_server = SystemStatusServer(runtime, host=cfg.bind_host,
                                                port=cfg.system_port,
                                                role_manager=roles,
-                                               kv_provider=engine.kv_status)
+                                               kv_provider=engine.kv_status,
+                                               perf_provider=engine.perf_status)
             await status_server.start()
             await register_status_server(
                 runtime, status_server.port,

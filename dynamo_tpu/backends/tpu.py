@@ -337,7 +337,11 @@ def make_profile_builder(runtime, args, engine, engine_cfg, tokenizer,
                 max_num_seqs=engine_cfg.max_num_seqs,
                 # The frontend's audio encoder projects to this width
                 # (mm_embeds spans must match the model hidden size).
-                extra={"hidden_size": engine_cfg.model.hidden_size}))
+                # expected_roofline_frac: the perf expectation doctor
+                # compares live perf_roofline_frac against.
+                extra={"hidden_size": engine_cfg.model.hidden_size,
+                       "expected_roofline_frac":
+                           engine_cfg.expected_roofline_frac}))
         prof.add_closer("model-card",
                         lambda: deregister_llm(runtime, model_name))
         return prof
@@ -562,7 +566,8 @@ async def run(args: argparse.Namespace) -> None:
             status_server = SystemStatusServer(runtime, host=cfg.bind_host,
                                                port=cfg.system_port,
                                                role_manager=roles,
-                                               kv_provider=engine.kv_status)
+                                               kv_provider=engine.kv_status,
+                                               perf_provider=engine.perf_status)
             await status_server.start()
             # Advertise for the frontend's /debug/fleet fan-out
             # (lease-bound: the entry dies with this worker).

@@ -21,6 +21,10 @@ prints OK/WARN/FAIL per check. The TPU-native equivalent probes:
   coordinator, ``/debug/fleet`` (WARN on partial results — some workers
   unreachable — or an empty fleet), and the KV router's decision
   telemetry (cache-aware rate / regret) when KV routing is on
+- the engine perf plane: ``/debug/perf`` (+ the fleet pane's per-worker
+  perf views), WARNing on unexpected steady-state recompiles, HBM
+  headroom under 10%, or live roofline_frac regressing > 20% below the
+  recorded expectation (DTPU_EXPECTED_ROOFLINE_FRAC / model card)
 
 Exit code 0 = no FAIL. Run: ``python -m dynamo_tpu.doctor
 [--coordinator-url tcp://...] [--frontend-url http://...]``.
@@ -384,6 +388,101 @@ async def check_fleet_kv(rep: Report, url: str) -> None:
         rep.add(FAIL, "fleet kv pane", f"{url}: {exc}")
 
 
+def _perf_views(body: dict, fleet: dict | None) -> list[tuple[str, dict]]:
+    """Flatten one /debug/perf body (+ optional /debug/fleet per-worker
+    perf views) into named engine-grade views to judge."""
+    views = [(str(body.get("role") or "process"), body)]
+    for name, eng in (body.get("engines") or {}).items():
+        views.append((f"engine {name}", eng))
+    for worker, res in ((fleet or {}).get("workers") or {}).items():
+        perf = res.get("perf")
+        if isinstance(perf, dict) and "compiles" in perf:
+            views.append((f"worker {worker}", perf))
+    return views
+
+
+#: HBM headroom below this fraction of bytes_limit is a WARN: the next
+#: long context or shape bucket will OOM-preempt instead of serving.
+PERF_HBM_HEADROOM = 0.10
+#: Live roofline_frac more than this fraction BELOW the model-card /
+#: config expectation is a WARN (ISSUE: "regressing > 20%").
+PERF_ROOFLINE_REGRESSION = 0.20
+
+
+async def check_perf(rep: Report, url: str) -> None:
+    """Engine perf plane (docs/OBSERVABILITY.md "Engine perf plane"):
+    probe /debug/perf (+ the fleet pane's per-worker perf views) and
+    WARN on any unexpected steady-state recompile, HBM headroom below
+    10%, or live roofline_frac regressing more than 20% below the
+    recorded expectation."""
+    import aiohttp
+    url = url.rstrip("/")
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"{url}/debug/perf",
+                                   timeout=aiohttp.ClientTimeout(5)) as r:
+                if r.status != 200:
+                    rep.add(FAIL, "/debug/perf", f"HTTP {r.status}")
+                    return
+                body = await r.json()
+            fleet = None
+            try:
+                async with session.get(
+                        f"{url}/debug/fleet",
+                        timeout=aiohttp.ClientTimeout(15)) as r:
+                    if r.status == 200:
+                        fleet = await r.json()
+            except (aiohttp.ClientError, OSError,
+                    asyncio.TimeoutError):
+                fleet = None  # pane probed separately; perf view optional
+    except (aiohttp.ClientError, OSError, asyncio.TimeoutError) as exc:
+        rep.add(FAIL, "perf plane", f"{url}: {exc}")
+        return
+    for name, view in _perf_views(body, fleet):
+        compiles = view.get("compiles") or {}
+        programs = compiles.get("programs") or {}
+        unexpected = compiles.get("unexpected_recompiles_total", 0)
+        if unexpected:
+            rep.add(WARN, f"perf {name}",
+                    f"{unexpected} unexpected steady-state recompile(s) — "
+                    "a served shape is recompiling on the hot path (see "
+                    "perf.recompile spans)")
+        elif programs:
+            total_s = compiles.get("compile_seconds_total", 0.0)
+            rep.add(OK, f"perf {name}",
+                    f"{compiles.get('compiles_total', 0)} compiles over "
+                    f"{len(programs)} programs ({total_s:.1f}s), zero "
+                    "unexpected recompiles")
+        hbm = view.get("hbm") or {}
+        limit = hbm.get("bytes_limit") or 0
+        if limit:
+            headroom = 1.0 - hbm.get("bytes_in_use", 0) / limit
+            if headroom < PERF_HBM_HEADROOM:
+                rep.add(WARN, f"perf {name} HBM",
+                        f"headroom {headroom:.1%} < "
+                        f"{PERF_HBM_HEADROOM:.0%} of "
+                        f"{limit / (1 << 30):.1f} GiB: next shape bucket "
+                        "or long context will thrash the KV pool")
+            else:
+                rep.add(OK, f"perf {name} HBM",
+                        f"{hbm.get('bytes_in_use', 0) / (1 << 30):.2f} / "
+                        f"{limit / (1 << 30):.1f} GiB in use "
+                        f"(headroom {headroom:.0%})")
+        roofline = view.get("roofline") or {}
+        frac = roofline.get("frac")
+        expected = roofline.get("expected_frac")
+        if expected and frac is not None:
+            floor = expected * (1.0 - PERF_ROOFLINE_REGRESSION)
+            if frac < floor:
+                rep.add(WARN, f"perf {name} roofline",
+                        f"live roofline_frac {frac:.3f} regressed below "
+                        f"{floor:.3f} ({PERF_ROOFLINE_REGRESSION:.0%} "
+                        f"under the recorded expectation {expected})")
+            else:
+                rep.add(OK, f"perf {name} roofline",
+                        f"{frac:.3f} vs expected {expected} (ok)")
+
+
 async def run(args) -> int:
     rep = Report()
     check_imports(rep)
@@ -398,6 +497,7 @@ async def run(args) -> int:
         await check_frontend(rep, args.frontend_url)
         await check_observability(rep, args.frontend_url)
         await check_fleet_kv(rep, args.frontend_url)
+        await check_perf(rep, args.frontend_url)
     n_fail = sum(1 for s, _, _ in rep.rows if s == FAIL)
     print(f"doctor: {len(rep.rows)} checks, {n_fail} failures", flush=True)
     return 1 if rep.failed else 0

@@ -252,6 +252,7 @@ def _merge_extra(q, num, l_star, m_s, k_extra, v_extra, s_mask, q_per_kv):
     return out.astype(q.dtype).reshape(b, nh, d)
 
 
+# dtpu: ignore[unregistered-jit] -- inner kernel: only ever traced INSIDE registered runner programs (inlined), never dispatched standalone from the serving loop
 @functools.partial(jax.jit, static_argnames=("q_per_kv",))
 def paged_decode_attention_pallas(q: jax.Array, k_cache: jax.Array,
                                   v_cache: jax.Array, layer: jax.Array,
@@ -277,6 +278,7 @@ def paged_decode_attention_pallas(q: jax.Array, k_cache: jax.Array,
                         v_self[:, :, None, :], mask, q_per_kv)
 
 
+# dtpu: ignore[unregistered-jit] -- inner kernel: only ever traced INSIDE registered runner programs (inlined), never dispatched standalone from the serving loop
 @functools.partial(jax.jit, static_argnames=("q_per_kv",))
 def paged_window_attention_pallas(q: jax.Array, k_cache: jax.Array,
                                   v_cache: jax.Array, layer: jax.Array,

@@ -260,6 +260,13 @@ class EngineConfig:
     # behind. 0 disables the hook. Needs ttft_budget_ms to have a
     # pressure signal at all.
     brownout_spec_disable_level: int = 2
+    # Perf plane (engine/perf.py): the roofline fraction this deployment
+    # is EXPECTED to achieve in steady-state decode — recorded into the
+    # model card's runtime_config.extra and served on /debug/perf, so
+    # doctor can WARN when the live perf_roofline_frac regresses > 20%
+    # below it. None (default) disables the comparison; env
+    # DTPU_EXPECTED_ROOFLINE_FRAC overrides at serving time.
+    expected_roofline_frac: float | None = None
 
     def bucket_for(self, length: int) -> int:
         for b in self.prefill_buckets:

@@ -22,6 +22,7 @@ import asyncio
 import collections
 import concurrent.futures
 import dataclasses
+import os
 import queue
 import threading
 import time
@@ -40,6 +41,7 @@ from dynamo_tpu.llm.kv_router.protocols import (ForwardPassMetrics, KvStats,
                                                 SpecDecodeStats, WorkerStats)
 from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput, PreprocessedRequest
 from dynamo_tpu.llm.tokens import TokenBlockSequence
+from dynamo_tpu.engine import perf as perf_plane
 from dynamo_tpu.runtime import chaos, flight
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.engine import AsyncEngine
@@ -260,6 +262,19 @@ class TPUEngine(AsyncEngine):
         self._flight = flight.get_recorder()
         self._flight_chunk_last = 0
         self._flight_stall_last = 0.0
+        self._flight_tokens_last = 0
+        # Perf plane (engine/perf.py): per-window roofline attribution
+        # feeds the process-global compile registry; the exporter turns
+        # it into dynamo_tpu_perf_* series alongside HBM gauges.
+        self._perf = perf_plane.get_registry()
+        self._perf_tokens_last = 0
+        self.tokens_generated_total = 0  # decode-window tokens emitted
+        self._step_floor_ms = config.model.weight_read_step_ms(
+            config.tp, config.pp)
+        self.perf_metrics = None
+        if metrics_registry is not None:
+            self.perf_metrics = perf_plane.PerfMetricsUpdater(
+                metrics_registry)
         self._running = False
         self._thread: threading.Thread | None = None
         self._publish_loop: asyncio.AbstractEventLoop | None = None
@@ -681,6 +696,29 @@ class TPUEngine(AsyncEngine):
         }
         return status
 
+    def perf_status(self) -> dict:
+        """The /debug/perf body for this worker (runtime/health.py;
+        docs/OBSERVABILITY.md "Engine perf plane"): per-program compile
+        stats from the process-global observatory, live window/roofline
+        series, HBM gauges, and the runner's params/KV/workspace memory
+        breakdown."""
+        expected = self.config.expected_roofline_frac
+        raw = os.environ.get("DTPU_EXPECTED_ROOFLINE_FRAC")
+        if raw:
+            expected = float(raw)
+        return {
+            "role": "engine",
+            "compiles": self._perf.snapshot(),
+            "window": self._perf.window_snapshot(),
+            "roofline": {
+                "weight_read_step_ms": round(self._step_floor_ms, 4),
+                "frac": round(self._perf.roofline_frac, 4),
+                "expected_frac": expected,
+            },
+            "hbm": self.runner.hbm_stats(),
+            "memory": self.runner.memory_breakdown(),
+        }
+
     def handler(self):
         async def handle(request, context):
             if isinstance(request, dict) and request.get("clear_kv_blocks"):
@@ -738,16 +776,23 @@ class TPUEngine(AsyncEngine):
         # with penalty bits set selects it; inactive rows do no work.
         packed_pen = packed.copy()
         packed_pen[0, PK_FREQPEN] = np.float32(1.0).view(np.int32)
-        outs = self.runner.decode_window(packed_pen, self.decode_window)
-        np.asarray(outs[0])
+        # TWICE: under tp > 1, GSPMD re-shards counts_dev in the first
+        # penalized program's output (replicated P() in, vocab-sharded
+        # out), so the SECOND call traces a new input signature — warm
+        # both here or the first real penalized request still pays that
+        # second compile (found by the perf plane's recompile detector).
+        for _ in range(2):
+            outs = self.runner.decode_window(packed_pen, self.decode_window)
+            np.asarray(outs[0])
         packed_seed = packed.copy()
         packed_seed[0, PK_SEEDED] = 1
         outs = self.runner.decode_window(packed_seed, self.decode_window)
         np.asarray(outs[0])
         packed_both = packed_seed.copy()
         packed_both[0, PK_FREQPEN] = np.float32(1.0).view(np.int32)
-        outs = self.runner.decode_window(packed_both, self.decode_window)
-        np.asarray(outs[0])
+        for _ in range(2):
+            outs = self.runner.decode_window(packed_both, self.decode_window)
+            np.asarray(outs[0])
         log.info("warmed window programs M=%d in %.1fs", self.decode_window,
                  time.monotonic() - t0)
         t0 = time.monotonic()
@@ -797,6 +842,10 @@ class TPUEngine(AsyncEngine):
                 self._warmup_window_programs()
             except Exception:  # noqa: BLE001 — warmup is best-effort
                 log.exception("window warmup failed; compiling lazily")
+        # Perf plane warmup boundary: compiles past here show up in the
+        # pane as post-warmup (larger buckets still compile lazily and
+        # legitimately; only SAME-signature recompiles are flagged).
+        self._perf.mark_ready()
         depth = max(1, self.config.pipeline_depth)
         while self._running:
             if chaos.ACTIVE:
@@ -1936,6 +1985,7 @@ class TPUEngine(AsyncEngine):
             r.last_token = inp
             if finish is None and r.ctx.is_stopped:
                 finish = FinishReason.CANCELLED
+            self.tokens_generated_total += len(accepted)
             if self._recorder.enabled and accepted:
                 self._recorder.add(
                     "engine.decode", r.ctx.trace_id, r.ctx.span_id,
@@ -2035,6 +2085,7 @@ class TPUEngine(AsyncEngine):
                 if delta != 0:
                     self.disp_positions[i] -= delta
                     self.disp_seq_lens[i] -= delta
+            self.tokens_generated_total += len(accepted)
             if self._recorder.enabled and accepted:
                 self._recorder.add(
                     "engine.decode", r.ctx.trace_id, r.ctx.span_id,
@@ -2109,11 +2160,23 @@ class TPUEngine(AsyncEngine):
     # -- metrics + events -----------------------------------------------------
     def _note_flight(self, w: _Window) -> None:
         """One flight-recorder row per processed decode window (engine
-        thread; the ring skips idle-stable windows itself)."""
+        thread; the ring skips idle-stable windows itself) — plus the
+        perf plane's roofline sample for the same window."""
+        now = time.monotonic()
+        tokens_total = self.tokens_generated_total
+        # Roofline attribution (engine/perf.py): device window time +
+        # tokens + dispatched rows -> EWMA step/tok_s/roofline gauges.
+        # Plain stores; independent of the flight ring's frozen state.
+        window_tokens = tokens_total - self._perf_tokens_last
+        self._perf_tokens_last = tokens_total
+        if w.t0 and w.toks is not None:
+            self._perf.note_window(
+                now - w.t0, window_tokens,
+                sum(1 for snap in w.slots if snap is not None),
+                w.size, self._step_floor_ms)
         fr = self._flight
         if not fr.enabled:
             return
-        now = time.monotonic()
         chunk_total = self.chunk_tokens_total
         accepted = fr.record(
             now, now - w.t0 if w.t0 else 0.0,
@@ -2122,19 +2185,22 @@ class TPUEngine(AsyncEngine):
             chunk_total - self._flight_chunk_last,
             len(self._chunk_inflight), self.preempt_count,
             self.brownout_level, self._flight_stall_last,
-            self.step_count)
+            self.step_count, tokens_total - self._flight_tokens_last)
         if accepted:
             # A frozen ring (bundle capture in flight) rejects the row:
-            # keep accumulating so the stall/chunk deltas land in the
-            # first post-thaw record instead of vanishing.
+            # keep accumulating so the stall/chunk/token deltas land in
+            # the first post-thaw record instead of vanishing.
             self._flight_chunk_last = chunk_total
             self._flight_stall_last = 0.0
+            self._flight_tokens_last = tokens_total
 
     def _publish(self) -> None:
         if self.kv_metrics is not None:
             # /metrics export is loop-independent (in-process pipelines
             # without a coordinator still get dynamo_tpu_kv_* series).
             self.kv_metrics.update(self)
+        if self.perf_metrics is not None:
+            self.perf_metrics.update(self)
         loop = self._publish_loop
         if loop is None or loop.is_closed():
             self.allocator.drain_events()

@@ -1,0 +1,462 @@
+"""Engine perf plane: compile observatory, roofline-attributed window
+timing, and HBM telemetry (docs/OBSERVABILITY.md "Engine perf plane").
+
+The device/compiler layer was the last dark subsystem: tracing covers
+requests, the flight recorder covers engine-loop state, the KV pane
+covers the cache — but nothing measured *compiles*, per-window device
+time, or HBM occupancy, so docs/PERF_NOTES.md's "~34% of roofline" and
+"first-long-prompt compile stall" findings were hand-run archaeology.
+This module makes them live series:
+
+- ``CompileRegistry``: every ``jax.jit`` program in the serving path is
+  built through :func:`instrumented_jit` (enforced by the
+  ``unregistered-jit`` lint rule), which wraps the jitted callable and
+  detects REAL XLA compiles via ``jax.monitoring``'s backend-compile
+  events — dispatch-cache churn (e.g. committed-ness changes) does not
+  count (falling back to first-call counting when the monitoring API is
+  unavailable). Per program family it records compile counts, actual
+  backend-compile seconds, the set of shape-signature keys seen, and a
+  one-time FLOPs/bytes cost estimate from ``lower().cost_analysis()``
+  (with a typed error fallback on backends without the API).
+- **Unexpected-recompile detector** — the runtime twin of the
+  ``jit-recompile-hazard`` lint rule: the SAME wrapper (one program
+  instance, one shape signature) compiling again after ``mark_ready()``
+  (the engine's warmup boundary) means the jit cache was invalidated on
+  the serving path (dtype/weak-type drift, shape leak, donation
+  mismatch). It bumps ``perf_unexpected_recompiles_total{program}``,
+  logs a WARNING, and emits a ``perf.recompile`` span with
+  ``status="warn"``. Judged per-wrapper so two runners in one process
+  don't cross-flag each other's first compiles; pre-ready compiles are
+  never flagged (warmup intentionally double-compiles signatures whose
+  input shardings converge after the first run).
+- ``note_window``: the engine feeds one (device-seconds, tokens,
+  active-slots, steps) sample per processed decode window — plain
+  float stores on the engine thread, no locks, no allocation — from
+  which the registry derives EWMA step seconds, achieved tok/s, and
+  the fraction of the weight-read roofline those tokens achieved.
+- ``PerfMetricsUpdater``: throttled exporter (same discipline as
+  engine/kv_metrics.py KvMetricsUpdater) turning the registry's plain
+  ints into ``dynamo_tpu_perf_*`` counters/gauges, plus periodic
+  ``device.memory_stats()`` HBM gauges from the runner.
+
+Env knobs: ``DTPU_PERF_COST`` = ``lower`` (default: cheap unoptimized-
+HLO estimate) | ``compile`` (accurate, pays a second XLA compile per
+program family) | ``off``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import jax
+
+from dynamo_tpu.runtime.logging import (generate_span_id, generate_trace_id,
+                                        get_logger)
+
+log = get_logger("perf")
+
+#: EWMA smoothing for the per-window series (0.2 = ~5-window memory).
+_EWMA = 0.2
+
+
+def _cost_mode() -> str:
+    return os.environ.get("DTPU_PERF_COST", "lower").strip().lower()
+
+
+class _Program:
+    """Plain-int per-program-family telemetry (engine-thread writers;
+    snapshot readers tolerate torn reads — these are gauges/counters,
+    not invariants)."""
+
+    __slots__ = ("name", "compiles", "compile_seconds", "unexpected",
+                 "sigs", "cost", "last_compile_ts")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.compiles = 0
+        self.compile_seconds = 0.0
+        self.unexpected = 0
+        self.sigs: dict = {}      # signature key -> compile count
+        self.cost: dict | None = None  # one-time FLOPs/bytes estimate
+        self.last_compile_ts = 0.0
+
+
+# -- compile detection probe ---------------------------------------------------
+# jax.monitoring fires ``/jax/core/compile/backend_compile_duration``
+# synchronously in the calling thread for every REAL XLA compile — the
+# only signal that separates compiles from dispatch-cache churn (the
+# private ``_cache_size`` probe also grows on fast-path entries for
+# committed-ness changes, which produced false recompile alarms). The
+# listener feeds a thread-local accumulator the wrappers snapshot
+# around each call.
+
+_tls = threading.local()
+
+
+def _probe() -> tuple[int, float]:
+    return (getattr(_tls, "n", 0), getattr(_tls, "s", 0.0))
+
+
+def _on_compile_event(event: str, duration: float, **_kw) -> None:
+    if "backend_compile" in event:
+        _tls.n = getattr(_tls, "n", 0) + 1
+        _tls.s = getattr(_tls, "s", 0.0) + duration
+
+
+_PROBE_OK = False
+try:  # pragma: no branch — registration is once at import
+    jax.monitoring.register_event_duration_secs_listener(_on_compile_event)
+    _PROBE_OK = True
+except Exception:  # noqa: BLE001 — older jax: degrade to first-call counting
+    log.info("jax.monitoring unavailable; compile observatory degrades "
+             "to first-call counting")
+
+
+class _InstrumentedJit:
+    """Transparent wrapper around one jitted callable: forwards calls,
+    counts compiles, triggers one-time cost analysis. One wrapper per
+    (program, signature key) — the runner's shape-bucket caches store
+    these in place of the raw jitted function."""
+
+    __slots__ = ("_fn", "_registry", "_program", "_key", "_calls",
+                 "_compiles")
+
+    def __init__(self, registry: "CompileRegistry", program: str,
+                 fn, key):
+        self._fn = fn
+        self._registry = registry
+        self._program = program
+        self._key = key
+        self._calls = 0
+        self._compiles = 0
+
+    def __call__(self, *args, **kwargs):
+        n0, s0 = _probe()
+        t0 = time.monotonic()
+        out = self._fn(*args, **kwargs)
+        dt = time.monotonic() - t0
+        self._calls += 1
+        if _PROBE_OK:
+            n1, s1 = _probe()
+            compiled = n1 > n0
+            dt = s1 - s0  # actual backend-compile seconds, not wall time
+        else:
+            compiled = self._calls == 1
+        if compiled:
+            # Unexpected = THIS wrapper (one program instance, one
+            # shape signature) compiling again AFTER warmup declared
+            # steady state. Judged per-wrapper, not per registry key:
+            # two runners in one process (tests, in-process
+            # multi-worker launchers) each legitimately compile the
+            # same (program, key) once. The warmup gate exists because
+            # warmup itself intentionally double-compiles signatures
+            # whose input shardings converge only after the first run
+            # (e.g. the penalized window's counts under tp > 1).
+            unexpected = (self._key is not None and self._compiles >= 1
+                          and self._registry.warmup_complete)
+            self._compiles += 1
+            self._registry.note_compile(self._program, self._key, dt,
+                                        unexpected=unexpected)
+            self._registry.maybe_cost(self._program, self._fn, args, kwargs)
+        return out
+
+    def lower(self, *args, **kwargs):
+        return self._fn.lower(*args, **kwargs)
+
+
+class CompileRegistry:
+    """Process-wide compile observatory + per-window perf accumulator."""
+
+    def __init__(self):
+        self._lock = threading.Lock()  # compile bookkeeping only (rare)
+        self._programs: dict[str, _Program] = {}
+        self.warmup_complete = False
+        self.warmup_complete_ts = 0.0
+        # Per-window series (single engine-thread writer, lock-free).
+        self.windows_total = 0
+        self.window_seconds_total = 0.0
+        self.window_tokens_total = 0
+        self.step_seconds = 0.0        # EWMA seconds per decode step
+        self.achieved_tok_s = 0.0      # EWMA tokens/s over device windows
+        self.roofline_frac = 0.0       # EWMA achieved / weight-read roofline
+
+    # -- compile observatory ---------------------------------------------------
+    def wrap(self, program: str, fn, key=None) -> _InstrumentedJit:
+        with self._lock:
+            self._programs.setdefault(program, _Program(program))
+        return _InstrumentedJit(self, program, fn, key)
+
+    def note_compile(self, program: str, key, seconds: float,
+                     unexpected: bool | None = None) -> None:
+        """``key`` is the caller's shape-signature cache key. The
+        instrumented wrapper passes ``unexpected`` explicitly (a second
+        compile of the SAME wrapper — per program instance, so two
+        runners in one process don't cross-flag); direct callers leave
+        it None and the registry falls back to key-seen detection.
+        ``key=None`` marks a self-bucketing program (one jit wrapper
+        legitimately compiling per input shape — the multimodal
+        encoders): compiles are counted but never flagged."""
+        with self._lock:
+            prog = self._programs.setdefault(program, _Program(program))
+            seen = prog.sigs.get(key, 0)
+            prog.sigs[key] = seen + 1
+            prog.compiles += 1
+            prog.compile_seconds += seconds
+            prog.last_compile_ts = time.time()
+            if unexpected is None:
+                unexpected = key is not None and seen >= 1
+            if unexpected:
+                prog.unexpected += 1
+        if unexpected:
+            self._warn_recompile(program, key, seconds)
+
+    def _warn_recompile(self, program: str, key, seconds: float) -> None:
+        log.warning(
+            "unexpected steady-state recompile: program %s key %r compiled "
+            "again (%.3fs) — the jit cache for an already-served shape was "
+            "invalidated (dtype/weak-type drift, donation mismatch, or a "
+            "shape leak); decode pays XLA time on the hot path", program,
+            key, seconds)
+        from dynamo_tpu.runtime import tracing
+        rec = tracing.get_recorder()
+        if rec.enabled:
+            now = time.monotonic()
+            rec.add("perf.recompile", generate_trace_id(),
+                    generate_span_id(), now - seconds, now, status="warn",
+                    attrs={"program": program, "key": repr(key),
+                           "compile_s": round(seconds, 4)})
+
+    def maybe_cost(self, program: str, fn, args, kwargs) -> None:
+        """One-time FLOPs/bytes estimate per program family. Cheap path
+        (``lower().cost_analysis()``) traces but never XLA-compiles;
+        the ``compile`` mode pays a real second compile for optimized
+        numbers. Every failure is recorded, never raised — the perf
+        plane must not be able to take down serving."""
+        mode = _cost_mode()
+        if mode == "off":
+            return
+        with self._lock:
+            prog = self._programs.setdefault(program, _Program(program))
+            if prog.cost is not None:
+                return
+            prog.cost = {"pending": True}  # claim before the slow work
+        cost: dict
+        try:
+            lowered = fn.lower(*args, **kwargs)
+            raw = (lowered.compile().cost_analysis() if mode == "compile"
+                   else lowered.cost_analysis())
+            if isinstance(raw, (list, tuple)):  # compiled returns per-device
+                raw = raw[0] if raw else {}
+            cost = {"flops": float(raw.get("flops", 0.0)),
+                    "bytes_accessed": float(raw.get("bytes accessed", 0.0)),
+                    "source": mode}
+        except Exception as exc:  # noqa: BLE001 — backend-dependent API
+            cost = {"error": f"{type(exc).__name__}: {exc}"[:200],
+                    "source": mode}
+        with self._lock:
+            prog.cost = cost
+
+    def mark_ready(self) -> None:
+        """Warmup boundary: compiles recorded after this are post-warmup
+        (the pane surfaces the flag; the recompile detector itself is
+        per-signature and needs no boundary)."""
+        self.warmup_complete = True
+        self.warmup_complete_ts = time.time()
+
+    # -- roofline-attributed window timing ------------------------------------
+    def note_window(self, window_s: float, tokens: int, active: int,
+                    steps: int, step_floor_ms: float) -> None:
+        """One processed decode window (ENGINE THREAD: plain stores
+        only). ``window_s`` is dispatch -> readback-complete device
+        time, ``tokens`` the tokens it emitted, ``active`` the
+        dispatched slot rows, ``step_floor_ms`` the shard's weight-read
+        step floor (ModelSpec.weight_read_step_ms)."""
+        if window_s <= 0 or steps <= 0:
+            return
+        self.windows_total += 1
+        self.window_seconds_total += window_s
+        self.window_tokens_total += tokens
+        step_s = window_s / steps
+        tok_s = tokens / window_s
+        if self.windows_total == 1:
+            self.step_seconds = step_s
+            self.achieved_tok_s = tok_s
+        else:
+            self.step_seconds += _EWMA * (step_s - self.step_seconds)
+            self.achieved_tok_s += _EWMA * (tok_s - self.achieved_tok_s)
+        if active > 0 and step_floor_ms > 0:
+            roofline_tok_s = active / (step_floor_ms / 1e3)
+            frac = min(tok_s / roofline_tok_s, 1.0)
+            if self.windows_total == 1:
+                self.roofline_frac = frac
+            else:
+                self.roofline_frac += _EWMA * (frac - self.roofline_frac)
+
+    # -- panes -----------------------------------------------------------------
+    @property
+    def compiles_total(self) -> int:
+        return sum(p.compiles for p in self._programs.values())
+
+    @property
+    def unexpected_total(self) -> int:
+        return sum(p.unexpected for p in self._programs.values())
+
+    def snapshot(self) -> dict:
+        """The /debug/perf "compiles" body."""
+        with self._lock:
+            programs = {
+                name: {
+                    "compiles": p.compiles,
+                    "compile_seconds": round(p.compile_seconds, 4),
+                    "signatures": len(p.sigs),
+                    "unexpected_recompiles": p.unexpected,
+                    "cost": p.cost,
+                    "last_compile_ts": p.last_compile_ts,
+                }
+                for name, p in sorted(self._programs.items())
+            }
+        return {
+            "programs": programs,
+            "compiles_total": sum(v["compiles"] for v in programs.values()),
+            "compile_seconds_total": round(
+                sum(v["compile_seconds"] for v in programs.values()), 4),
+            "unexpected_recompiles_total": sum(
+                v["unexpected_recompiles"] for v in programs.values()),
+            "warmup_complete": self.warmup_complete,
+        }
+
+    def window_snapshot(self) -> dict:
+        """The /debug/perf "window" body (EWMA-smoothed live series)."""
+        return {
+            "windows_total": self.windows_total,
+            "window_seconds_total": round(self.window_seconds_total, 4),
+            "window_tokens_total": self.window_tokens_total,
+            "step_seconds": self.step_seconds,
+            "achieved_tok_per_s": round(self.achieved_tok_s, 2),
+            "roofline_frac": round(self.roofline_frac, 4),
+        }
+
+    def reset(self) -> None:
+        """Tests only: drop every program and window sample."""
+        with self._lock:
+            self._programs.clear()
+        self.warmup_complete = False
+        self.warmup_complete_ts = 0.0
+        self.windows_total = 0
+        self.window_seconds_total = 0.0
+        self.window_tokens_total = 0
+        self.step_seconds = 0.0
+        self.achieved_tok_s = 0.0
+        self.roofline_frac = 0.0
+
+
+_REGISTRY = CompileRegistry()
+
+
+def get_registry() -> CompileRegistry:
+    return _REGISTRY
+
+
+def instrumented_jit(program: str, fun, *, key=None, registry=None,
+                     **jit_kwargs):
+    """The ONE sanctioned way to build a serving-path jit program:
+    ``jax.jit`` + compile observatory in a drop-in wrapper. ``program``
+    is the family label (``prefill``, ``decode_window``, ...); ``key``
+    the shape-signature cache key the caller memoizes under (the
+    recompile detector treats a second compile of the same key as
+    unexpected). Extra kwargs go straight to ``jax.jit``."""
+    reg = registry if registry is not None else _REGISTRY
+    # dtpu: ignore[jit-recompile-hazard] -- this IS the caching chokepoint: every caller memoizes the returned wrapper by its shape key
+    return reg.wrap(program, jax.jit(fun, **jit_kwargs), key=key)
+
+
+def process_perf_status() -> dict:
+    """Fallback /debug/perf body for a process without an engine (a
+    frontend in proxy mode, a bare status server): the compile
+    observatory is process-global, so it still answers."""
+    reg = get_registry()
+    return {"role": "process", "compiles": reg.snapshot(),
+            "window": reg.window_snapshot(), "hbm": {}, "memory": {}}
+
+
+class PerfMetricsUpdater:
+    """dynamo_tpu_perf_* exporter: registry plain-ints -> Prometheus,
+    throttled so the engine thread never takes a Prometheus lock per
+    window (same pattern as KvMetricsUpdater). Counters export DELTAS
+    so a registry reset can't make them go backwards. Every series is
+    documented in docs/OBSERVABILITY.md "Engine perf plane" (tier-1
+    docs-drift guard)."""
+
+    def __init__(self, registry, min_interval_s: float = 0.5):
+        self.min_interval_s = min_interval_s
+        self._next = 0.0
+        self._last: dict[tuple, float] = {}
+        self.c_compiles = registry.counter(
+            "perf_compiles_total", "XLA compiles per jit program family",
+            ["program"])
+        self.c_compile_seconds = registry.counter(
+            "perf_compile_seconds_total", "Wall-clock seconds spent in XLA "
+            "compiles per jit program family", ["program"])
+        self.c_unexpected = registry.counter(
+            "perf_unexpected_recompiles_total", "Compiles of an "
+            "already-seen (program, signature) after first use — the "
+            "runtime twin of the jit-recompile-hazard lint rule; any "
+            "nonzero rate in steady state is a serving-path bug",
+            ["program"])
+        self.g_step_seconds = registry.gauge(
+            "perf_step_seconds", "EWMA seconds per decode step "
+            "(window device time / window steps)")
+        self.g_achieved = registry.gauge(
+            "perf_achieved_tok_per_s", "EWMA decode tokens/s over "
+            "dispatched windows (device-time attributed)")
+        self.g_roofline = registry.gauge(
+            "perf_roofline_frac", "EWMA fraction of the shard's "
+            "weight-read roofline achieved by decode windows")
+        self.g_hbm_in_use = registry.gauge(
+            "perf_hbm_bytes_in_use", "device.memory_stats bytes_in_use "
+            "on this worker's first addressable device")
+        self.g_hbm_peak = registry.gauge(
+            "perf_hbm_peak_bytes", "device.memory_stats "
+            "peak_bytes_in_use on this worker's first addressable device")
+        self.g_hbm_limit = registry.gauge(
+            "perf_hbm_limit_bytes", "device.memory_stats bytes_limit on "
+            "this worker's first addressable device")
+        for bound in (self.g_step_seconds, self.g_achieved, self.g_roofline,
+                      self.g_hbm_in_use, self.g_hbm_peak, self.g_hbm_limit):
+            bound.ensure()
+
+    def _delta(self, bound, key: tuple, current: float, **labels) -> None:
+        prev = self._last.get(key, 0.0)
+        if current > prev:
+            bound.inc(current - prev, **labels)
+        self._last[key] = current
+
+    def update(self, engine, force: bool = False) -> None:
+        """``engine`` duck-types TPUEngine: needs ``.runner.hbm_stats``
+        (optional). Throttled; safe from the engine thread."""
+        now = time.monotonic()
+        if not force and now < self._next:
+            return
+        self._next = now + self.min_interval_s
+        reg = get_registry()
+        with reg._lock:
+            per_prog = [(p.name, p.compiles, p.compile_seconds, p.unexpected)
+                        for p in reg._programs.values()]
+        for name, compiles, seconds, unexpected in per_prog:
+            self._delta(self.c_compiles, ("c", name), compiles, program=name)
+            self._delta(self.c_compile_seconds, ("s", name), seconds,
+                        program=name)
+            self._delta(self.c_unexpected, ("u", name), unexpected,
+                        program=name)
+        self.g_step_seconds.set(reg.step_seconds)
+        self.g_achieved.set(reg.achieved_tok_s)
+        self.g_roofline.set(reg.roofline_frac)
+        runner = getattr(engine, "runner", None)
+        hbm = runner.hbm_stats() if runner is not None and hasattr(
+            runner, "hbm_stats") else {}
+        if hbm:
+            self.g_hbm_in_use.set(hbm.get("bytes_in_use", 0))
+            self.g_hbm_peak.set(hbm.get("peak_bytes_in_use", 0))
+            self.g_hbm_limit.set(hbm.get("bytes_limit", 0))

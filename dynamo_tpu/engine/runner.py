@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dynamo_tpu.engine import perf
 from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.engine.model import (
     dense_causal_attention,
@@ -130,7 +131,7 @@ def _mh_zeros(shape, dtype, sharding):
     and HBM-friendly for multi-GB KV pools."""
     if jax.process_count() > 1:
         # jit is the only multi-host-legal way to get out_shardings placement.
-        # dtpu: ignore[jit-recompile-hazard] -- one-shot at pool creation
+        # dtpu: ignore[jit-recompile-hazard, unregistered-jit] -- one-shot at pool creation, never dispatched from the serving loop
         return jax.jit(lambda: jnp.zeros(shape, dtype),
                        out_shardings=sharding)()
     return jax.device_put(jnp.zeros(shape, dtype), sharding)
@@ -236,11 +237,28 @@ class ModelRunner:
                     config.page_size, spec.head_dim)
         self.k_cache = _mh_zeros(kv_shape, jnp.bfloat16, self.kv_sharding)
         self.v_cache = _mh_zeros(kv_shape, jnp.bfloat16, self.kv_sharding)
+        # Byte ledgers for the perf plane's HBM breakdown (/debug/perf):
+        # this process's per-device share of params and the KV pool —
+        # workspace is whatever memory_stats says is in use beyond them.
+        per_weight = 1 if spec.quant == "int8" else 2
+        shard = max(1, config.tp * config.pp)
+        self.param_bytes = spec.num_params() * per_weight // shard
+        self.kv_pool_bytes = (2 * int(np.prod(kv_shape)) * 2) // shard
 
         self._prefill_cache: dict = {}
         self._decode_fn = None
         self._window_cache: dict = {}
-        self._rng = jax.random.key(seed + 1)
+        # COMMITTED rng: an uncommitted key traces a different jit
+        # signature than the committed key the program returns, so every
+        # program family paid one duplicate XLA compile on its second
+        # call (found by the perf plane's unexpected-recompile detector;
+        # multi-controller mode keeps the host value — device_put onto a
+        # cross-host sharding is illegal there, and followers replay
+        # identical dispatches anyway).
+        rng = jax.random.key(seed + 1)
+        if jax.process_count() == 1:
+            rng = jax.device_put(rng, NamedSharding(self.mesh, P()))
+        self._rng = rng
         self.tokens_dev = _mh_zeros(
             (config.max_num_seqs,), jnp.int32,
             NamedSharding(self.mesh, P()))
@@ -445,7 +463,8 @@ class ModelRunner:
                 None)
             return sampled, lp, top_v, top_i, logits, k_cache, v_cache, rng
 
-        fn = jax.jit(step, donate_argnums=(1, 2))
+        fn = perf.instrumented_jit("prefill", step, key=key,
+                                   donate_argnums=(1, 2))
         self._prefill_cache[key] = fn
         return fn
 
@@ -463,7 +482,8 @@ class ModelRunner:
             sampled = sample_tokens(logits, temperature, top_k, top_p, sub)
             return sampled, k_cache, v_cache, rng
 
-        self._decode_fn = jax.jit(step, donate_argnums=(1, 2))
+        self._decode_fn = perf.instrumented_jit(
+            "decode_step", step, key="decode_step", donate_argnums=(1, 2))
         return self._decode_fn
 
     def _get_window(self, window: int, bucket_pages: int,
@@ -605,7 +625,8 @@ class ModelRunner:
             return toks, lps, top_vs, top_is, tokens, k_cache, v_cache, rng
 
         donate = (1, 2, 6) if penalized else (1, 2)
-        fn = jax.jit(run_window, donate_argnums=donate)
+        fn = perf.instrumented_jit("decode_window", run_window, key=key,
+                                   donate_argnums=donate)
         self._window_cache[key] = fn
         return fn
 
@@ -728,7 +749,8 @@ class ModelRunner:
             return (outs, emits, ndrafts, tokens, pos, hist,
                     k_cache, v_cache)
 
-        fn = jax.jit(run_spec, donate_argnums=(1, 2, 4))
+        fn = perf.instrumented_jit("spec_window", run_spec, key=key,
+                                   donate_argnums=(1, 2, 4))
         self._window_cache[key] = fn
         return fn
 
@@ -798,7 +820,8 @@ class ModelRunner:
                 pos_dev = pos_dev.at[pslot].set(starts + lens, mode="drop")
                 return hist, pos_dev
 
-            fn = jax.jit(scatter, donate_argnums=(0, 1))
+            fn = perf.instrumented_jit("seed_history", scatter, key=key,
+                                       donate_argnums=(0, 1))
             self._seed_hist_cache[key] = fn
         with self.mesh:
             self.hist_dev, self.positions_dev = fn(
@@ -1033,8 +1056,9 @@ class ModelRunner:
         key = ("embed", bucket, bp, pooling)
         fn = self._window_cache.get(key)
         if fn is None:
-            fn = jax.jit(lambda p, t, sl: embed_forward(
-                p, spec, t, sl, pooling=pooling))
+            fn = perf.instrumented_jit(
+                "embed", lambda p, t, sl: embed_forward(
+                    p, spec, t, sl, pooling=pooling), key=key)
             self._window_cache[key] = fn
         toks = np.zeros((bp, bucket), np.int32)
         lens = np.ones((bp,), np.int32)
@@ -1059,10 +1083,11 @@ class ModelRunner:
                 # and the leader's host fetch is purely local. This is the
                 # cross-host gather that unblocks disagg + tiering in
                 # multi-host mode (round-3 VERDICT missing #2).
-                fn = jax.jit(gather,
-                             out_shardings=NamedSharding(self.mesh, P()))
+                fn = perf.instrumented_jit(
+                    "extract", gather, key=key,
+                    out_shardings=NamedSharding(self.mesh, P()))
             else:
-                fn = jax.jit(gather)
+                fn = perf.instrumented_jit("extract", gather, key=key)
             self._window_cache[key] = fn
         return fn
 
@@ -1074,7 +1099,8 @@ class ModelRunner:
                 k_cache = k_cache.at[:, :, pages].set(kv[0])
                 v_cache = v_cache.at[:, :, pages].set(kv[1])
                 return k_cache, v_cache
-            fn = jax.jit(scatter, donate_argnums=(0, 1))
+            fn = perf.instrumented_jit("insert", scatter, key=key,
+                                       donate_argnums=(0, 1))
             self._window_cache[key] = fn
         return fn
 
@@ -1084,6 +1110,40 @@ class ModelRunner:
         while b < n:
             b *= 2
         return b
+
+    # -- perf plane (engine/perf.py; docs/OBSERVABILITY.md) -------------------
+    def hbm_stats(self) -> dict:
+        """``device.memory_stats()`` of this process's first addressable
+        mesh device, normalized to the three gauge fields. Empty dict on
+        backends without the API (CPU tests) — the perf pane degrades,
+        never raises."""
+        try:
+            devices = list(self.mesh.devices.flat)
+            local = [d for d in devices
+                     if d.process_index == jax.process_index()]
+            stats = (local[0] if local else devices[0]).memory_stats()
+        except Exception:  # noqa: BLE001 — optional, backend-dependent API
+            return {}
+        if not stats:
+            return {}
+        return {"bytes_in_use": int(stats.get("bytes_in_use", 0)),
+                "peak_bytes_in_use": int(stats.get("peak_bytes_in_use", 0)),
+                "bytes_limit": int(stats.get("bytes_limit", 0))}
+
+    def memory_breakdown(self) -> dict:
+        """Params / KV-pool / workspace attribution of device memory from
+        this runner's own ledgers (the breakdown memory_stats can't
+        give): workspace = measured in-use minus the two known pools,
+        None when the backend has no memory_stats."""
+        hbm = self.hbm_stats()
+        in_use = hbm.get("bytes_in_use")
+        return {
+            "params_bytes": self.param_bytes,
+            "kv_pool_bytes": self.kv_pool_bytes,
+            "workspace_bytes": (max(0, in_use - self.param_bytes
+                                    - self.kv_pool_bytes)
+                                if in_use is not None else None),
+        }
 
     def d2h_fetch_floor_ms(self) -> float:
         """Measured per-fetch device->host latency floor (cached probe).

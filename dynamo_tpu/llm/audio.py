@@ -149,10 +149,11 @@ class AudioEncoder:
         else:
             self.params = self._init(jax.random.key(seed))
         # jax.jit caches compilations per input shape itself; one wrapper
-        # serves every length bucket.
-        import jax as _jax
+        # serves every length bucket (perf key=None: those per-bucket
+        # compiles are expected, never flagged as recompiles).
+        from dynamo_tpu.engine.perf import instrumented_jit
 
-        self._fn = _jax.jit(self._forward)
+        self._fn = instrumented_jit("audio_encoder", self._forward)
 
     def _init(self, key):
         import jax

@@ -3,7 +3,8 @@
 Workers that run a SystemStatusServer register its address under
 ``system/<namespace>/<instance_hex>`` on the coordinator (lease-bound,
 so a dead worker's entry expires with its lease). The frontend's
-``GET /debug/fleet`` reads that prefix and fans out ``GET /debug/kv`` to
+``GET /debug/fleet`` reads that prefix and fans out ``GET /debug/kv``
+(plus ``GET /debug/perf`` for the per-worker perf view) to
 every worker — bounded concurrency, a per-worker timeout, and TYPED
 partial results: an unreachable worker contributes
 ``{"ok": false, "error": ...}`` instead of failing the pane, because the
@@ -64,10 +65,24 @@ async def _probe_worker(session, sem: asyncio.Semaphore, worker: str,
                 if r.status != 200:
                     return worker, {"ok": False,
                                     "error": f"HTTP {r.status}", **base}
-                return worker, {"ok": True, "kv": await r.json(), **base}
+                res = {"ok": True, "kv": await r.json(), **base}
         except (aiohttp.ClientError, OSError, asyncio.TimeoutError) as exc:
             return worker, {"ok": False,
                             "error": f"{type(exc).__name__}: {exc}", **base}
+        # Perf view (docs/OBSERVABILITY.md "Engine perf plane"): same
+        # status server, typed partial result — a worker predating the
+        # perf plane (404) just contributes no "perf" key.
+        try:
+            async with session.get(
+                    f"http://{addr}/debug/perf",
+                    timeout=aiohttp.ClientTimeout(total=timeout_s)) as r:
+                if r.status == 200:
+                    res["perf"] = await r.json()
+                elif r.status != 404:
+                    res["perf"] = {"error": f"HTTP {r.status}"}
+        except (aiohttp.ClientError, OSError, asyncio.TimeoutError) as exc:
+            res["perf"] = {"error": f"{type(exc).__name__}: {exc}"}
+    return worker, res
 
 
 def _aggregate(workers: dict[str, dict]) -> dict:
@@ -75,12 +90,17 @@ def _aggregate(workers: dict[str, dict]) -> dict:
     agg = {"workers_ok": 0, "workers_down": 0, "pages_total": 0,
            "pages_free": 0, "pages_active": 0, "cached_blocks": 0,
            "tier_blocks": {}, "reuse_hit_blocks": 0,
-           "reuse_lookup_blocks": 0}
+           "reuse_lookup_blocks": 0, "unexpected_recompiles": 0,
+           "compiles_total": 0}
     for res in workers.values():
         if not res.get("ok"):
             agg["workers_down"] += 1
             continue
         agg["workers_ok"] += 1
+        compiles = (res.get("perf") or {}).get("compiles") or {}
+        agg["unexpected_recompiles"] += compiles.get(
+            "unexpected_recompiles_total", 0)
+        agg["compiles_total"] += compiles.get("compiles_total", 0)
         kv = res.get("kv") or {}
         alloc = kv.get("allocator") or {}
         agg["pages_total"] += alloc.get("pages_total", 0)

@@ -140,7 +140,8 @@ class HttpService:
         # frontend's /debug/kv serves the KV routers' fleet view +
         # decision telemetry.
         from dynamo_tpu.runtime.health import add_debug_routes
-        add_debug_routes(app, kv_provider=self._kv_router_status)
+        add_debug_routes(app, kv_provider=self._kv_router_status,
+                         perf_provider=self._perf_status)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         ssl_ctx = None
@@ -928,6 +929,25 @@ class HttpService:
                 if engine_status is not None:
                     engines[name] = engine_status()
         return {"role": "frontend", "routers": routers, "engines": engines}
+
+    def _perf_status(self) -> dict:
+        """This frontend's /debug/perf: the process-global compile
+        observatory plus in-process engines' full perf view (unified
+        launcher — no worker status server to ask)."""
+        from dynamo_tpu.engine.perf import process_perf_status
+        engines = {}
+        for name, served in self.manager.models.items():
+            if served.client is not None:
+                continue
+            engine = getattr(
+                getattr(served.preprocessor, "inner", None), "inner", None)
+            status = getattr(engine, "perf_status", None)
+            if status is not None:
+                engines[name] = status()
+        body = process_perf_status()
+        body["role"] = "frontend"
+        body["engines"] = engines
+        return body
 
     async def _debug_fleet(self, request: web.Request) -> web.Response:
         """GET /debug/fleet: merged per-worker KV/capacity view from

@@ -382,3 +382,15 @@ class MockerEngine(AsyncEngine):
             "remote": None,
             "digest": self.inventory_digest().to_wire(),
         }
+
+    def perf_status(self) -> dict:
+        """The /debug/perf body for a mocker worker: the process-global
+        compile observatory (empty of device programs — mockers never
+        jit) so the fleet pane's perf merge is exercisable without
+        hardware."""
+        from dynamo_tpu.engine.perf import get_registry
+        reg = get_registry()
+        return {"role": "mocker", "compiles": reg.snapshot(),
+                "window": reg.window_snapshot(), "hbm": {}, "memory": {},
+                "roofline": {"frac": reg.roofline_frac,
+                             "expected_frac": None}}

@@ -83,7 +83,10 @@ class VisionEncoder:
             self.params = self._load(weights_path)
         else:
             self.params = self._init(jax.random.key(seed))
-        self._fn = jax.jit(self._forward)
+        # key=None: self-bucketing program (jit caches per input shape;
+        # new image sizes compile legitimately, never flagged).
+        from dynamo_tpu.engine.perf import instrumented_jit
+        self._fn = instrumented_jit("vision_encoder", self._forward)
 
     def _init(self, key):
         import jax

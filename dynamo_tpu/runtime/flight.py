@@ -43,10 +43,13 @@ from dynamo_tpu.runtime.logging import get_logger
 
 log = get_logger("flight")
 
-# Ring columns, in record() argument order.
+# Ring columns, in record() argument order. "tokens" (decode tokens
+# emitted by the window) rides with "dur_s" (dispatch -> readback device
+# time) so the perf plane's roofline attribution is replayable from a
+# frozen ring, not only from live gauges.
 FIELDS = ("t_mono", "dur_s", "active", "waiting", "free_pages",
           "chunk_tokens", "chunks_inflight", "preempts", "brownout",
-          "stall_s", "step")
+          "stall_s", "step", "tokens")
 
 
 def _env_int(name: str, default: int) -> int:
@@ -84,7 +87,7 @@ class FlightRecorder:
     def record(self, t_mono: float, dur_s: float, active: int, waiting: int,
                free_pages: int, chunk_tokens: int, chunks_inflight: int,
                preempts: int, brownout: int, stall_s: float,
-               step: int) -> bool:
+               step: int, tokens: int = 0) -> bool:
         """One engine-window row. Idle-stable windows (no active slots,
         no waiters, no chunk work — same as the previous call) are
         skipped without touching the ring. Returns False when the row
@@ -112,6 +115,7 @@ class FlightRecorder:
             cols["brownout"][i] = brownout
             cols["stall_s"][i] = stall_s
             cols["step"][i] = step
+            cols["tokens"][i] = tokens
             self._idx = (i + 1) % self.capacity
             if self._count < self.capacity:
                 self._count += 1
@@ -154,7 +158,7 @@ class FlightRecorder:
                        for name, col in self._cols.items()}
                 for name in ("active", "waiting", "free_pages",
                              "chunk_tokens", "chunks_inflight", "preempts",
-                             "brownout", "step"):
+                             "brownout", "step", "tokens"):
                     row[name] = int(row[name])
                 rows.append(row)
             return rows

@@ -25,6 +25,12 @@ serves the tracing/SLO/accounting/flight debug API:
   frontend, the KV router's fleet view + decision telemetry. The
   provider is per-app (``app[KV_PROVIDER]``), NOT process-global, so
   in-process multi-worker tests keep distinct panes.
+- ``GET /debug/perf``                     — the engine perf plane
+  (docs/OBSERVABILITY.md "Engine perf plane"): per-program compile
+  stats + unexpected-recompile detector, roofline-attributed window
+  timing, HBM gauges, memory breakdown. Per-app provider like
+  ``/debug/kv`` (``TPUEngine.perf_status``); without one the
+  process-global compile observatory still answers.
 """
 
 from __future__ import annotations
@@ -49,9 +55,15 @@ try:
 except AttributeError:  # older aiohttp: plain string keys
     KV_PROVIDER = "dtpu_kv_provider"
 
+#: App key for the /debug/perf provider (e.g. TPUEngine.perf_status).
+try:
+    PERF_PROVIDER = web.AppKey("dtpu_perf_provider", object)
+except AttributeError:  # older aiohttp: plain string keys
+    PERF_PROVIDER = "dtpu_perf_provider"
+
 
 def add_debug_routes(app: web.Application,
-                     kv_provider=None) -> None:
+                     kv_provider=None, perf_provider=None) -> None:
     """Attach the observability debug routes (shared with the OpenAI
     frontend so in-process pipelines get them without a status server)."""
     app.router.add_get("/debug/traces", _debug_traces)
@@ -62,8 +74,28 @@ def add_debug_routes(app: web.Application,
     app.router.add_get("/debug/flight", _debug_flight)
     app.router.add_post("/debug/flight", _debug_flight_capture)
     app.router.add_get("/debug/kv", _debug_kv)
+    app.router.add_get("/debug/perf", _debug_perf)
     if kv_provider is not None:
         app[KV_PROVIDER] = kv_provider
+    if perf_provider is not None:
+        app[PERF_PROVIDER] = perf_provider
+
+
+async def _debug_perf(request: web.Request) -> web.Response:
+    provider = request.app.get(PERF_PROVIDER)
+    if provider is None:
+        # The compile observatory is process-global: a process without
+        # an engine (proxy frontend, bare status server) still reports
+        # its own jit programs — just no HBM/window attribution.
+        from dynamo_tpu.engine.perf import process_perf_status
+        provider = process_perf_status
+    try:
+        body = provider()
+    except Exception as exc:  # noqa: BLE001 — a pane, not a crash vector
+        log.exception("perf status provider failed")
+        return web.json_response({"error": f"perf provider failed: {exc}"},
+                                 status=500)
+    return web.json_response(body)
 
 
 async def _debug_kv(request: web.Request) -> web.Response:
@@ -149,7 +181,7 @@ async def _debug_profile(request: web.Request) -> web.Response:
 
 class SystemStatusServer:
     def __init__(self, runtime, host: str = "0.0.0.0", port: int = 0,
-                 role_manager=None, kv_provider=None):
+                 role_manager=None, kv_provider=None, perf_provider=None):
         self._runtime = runtime
         self.host, self.port = host, port
         self._endpoint_health: dict[str, bool] = {}
@@ -159,6 +191,8 @@ class SystemStatusServer:
         self.role_manager = role_manager
         # /debug/kv provider for THIS worker (engine.kv_status).
         self.kv_provider = kv_provider
+        # /debug/perf provider (engine.perf_status).
+        self.perf_provider = perf_provider
 
     def set_endpoint_health(self, endpoint_path: str, healthy: bool) -> None:
         self._endpoint_health[endpoint_path] = healthy
@@ -170,7 +204,8 @@ class SystemStatusServer:
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/control/role", self._role_status)
         app.router.add_post("/control/role", self._role_set)
-        add_debug_routes(app, kv_provider=self.kv_provider)
+        add_debug_routes(app, kv_provider=self.kv_provider,
+                         perf_provider=self.perf_provider)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)

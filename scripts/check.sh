@@ -40,6 +40,16 @@ timeout -k 10 180 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_fleet_pane.py -q -k 'smoke' \
     -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
 
+echo "== perf smoke (compile observatory + perf gate) =="
+# Tiny CPU engine: /debug/perf shape on status server + frontend, ZERO
+# unexpected recompiles across consecutive decode windows, and the
+# scripts/perf_gate.py machinery (record -> pass -> regress -> fail;
+# CPU runs gate only on structural fields vs the committed TPU
+# baseline, never absolute throughput).
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_perf_plane.py -q -m 'not slow' -k 'smoke or gate' \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+
 echo "== chunked-prefill smoke (stall-free scheduling) =="
 # Tiny CPU model: one long prompt prefilling in chunks with concurrent
 # short decoders — asserts completion, decode windows interleaved between

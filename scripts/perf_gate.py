@@ -1,0 +1,202 @@
+#!/usr/bin/env python3
+"""Perf regression gate: diff a bench.py JSON run against a committed
+baseline (docs/OBSERVABILITY.md "Engine perf plane").
+
+bench.py now embeds the perf-plane snapshot (``detail.perf``: per-program
+compile counts/seconds, the unexpected-recompile total, roofline window
+series, HBM) in its one-line JSON. This gate turns that into a CI-able
+regression check:
+
+**Structural checks** (every run, any platform):
+- ``detail.perf.compiles.programs`` exists and is non-empty, each entry
+  carrying ``compiles``/``compile_seconds``/``unexpected_recompiles``;
+- ``unexpected_recompiles_total == 0`` — a steady-state recompile is a
+  serving-path bug regardless of hardware.
+
+**Value checks** (skipped with ``--structural-only`` or when the run and
+baseline platforms differ — a CPU smoke must not be judged against a
+TPU baseline):
+- throughput: ``value >= baseline.value * (1 - tolerance)``;
+- roofline fraction: ``vs_baseline >= baseline.vs_baseline * (1 - tolerance)``
+  (bench's ``vs_baseline`` IS the roofline fraction for serve mode);
+- compile budget: no program may compile more than
+  ``baseline compiles + compile-slack`` times (a new shape bucket or two
+  is legitimate growth; tripling is a bucketing regression).
+
+Record a fresh baseline from a run: ``--record`` copies the run JSON to
+the baseline path (committed baselines live at deploy/perf-baseline.json).
+
+Usage:
+  python bench.py > /tmp/run.json
+  python scripts/perf_gate.py --run /tmp/run.json \
+      --baseline deploy/perf-baseline.json [--tolerance 0.15] \
+      [--compile-slack 2] [--structural-only] [--record]
+
+Exit code 0 = pass, 1 = regression (or structurally broken run).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+REQUIRED_PROGRAM_FIELDS = ("compiles", "compile_seconds",
+                           "unexpected_recompiles")
+
+
+def load_run(path: str) -> dict:
+    """A bench.py output line, or a driver capture wrapping it under
+    "parsed" (the committed BENCH_r*.json shape)."""
+    with open(path) as fh:
+        data = json.load(fh)
+    return data.get("parsed", data)
+
+
+def structural_failures(run: dict) -> list[str]:
+    fails = []
+    perf = (run.get("detail") or {}).get("perf")
+    if not isinstance(perf, dict):
+        return ["run has no detail.perf section (bench.py too old, or a "
+                "hand-built JSON)"]
+    compiles = perf.get("compiles") or {}
+    programs = compiles.get("programs") or {}
+    if not programs:
+        fails.append("detail.perf.compiles.programs is empty: no jit "
+                     "program registered a compile (observatory broken?)")
+    for name, entry in programs.items():
+        missing = [f for f in REQUIRED_PROGRAM_FIELDS if f not in entry]
+        if missing:
+            fails.append(f"program {name!r} missing fields: {missing}")
+    unexpected = compiles.get("unexpected_recompiles_total", 0)
+    if unexpected:
+        fails.append(
+            f"unexpected_recompiles_total={unexpected}: a steady-state "
+            "recompile on the serving path (see the perf.recompile WARN "
+            "spans / dynamo_tpu_perf_unexpected_recompiles_total)")
+    window = perf.get("window") or {}
+    if "roofline_frac" not in window:
+        fails.append("detail.perf.window.roofline_frac missing")
+    return fails
+
+
+def value_failures(run: dict, baseline: dict, tolerance: float,
+                   compile_slack: int) -> tuple[list[str], list[str]]:
+    """(failures, notes). Platform-gated by the caller."""
+    fails, notes = [], []
+    bval = baseline.get("value")
+    rval = run.get("value")
+    if isinstance(bval, (int, float)) and isinstance(rval, (int, float)):
+        floor = bval * (1.0 - tolerance)
+        if rval < floor:
+            fails.append(f"throughput regressed: {rval} < {floor:.1f} "
+                         f"(baseline {bval} - {tolerance:.0%})")
+        else:
+            notes.append(f"throughput {rval} vs baseline {bval} (ok)")
+    bfrac = baseline.get("vs_baseline")
+    rfrac = run.get("vs_baseline")
+    if isinstance(bfrac, (int, float)) and isinstance(rfrac, (int, float)) \
+            and bfrac > 0:
+        floor = bfrac * (1.0 - tolerance)
+        if rfrac < floor:
+            fails.append(f"roofline fraction regressed: {rfrac} < "
+                         f"{floor:.3f} (baseline {bfrac} - {tolerance:.0%})")
+        else:
+            notes.append(f"roofline frac {rfrac} vs baseline {bfrac} (ok)")
+    base_progs = (((baseline.get("detail") or {}).get("perf") or {})
+                  .get("compiles") or {}).get("programs") or {}
+    run_progs = (((run.get("detail") or {}).get("perf") or {})
+                 .get("compiles") or {}).get("programs") or {}
+    if not base_progs:
+        notes.append("baseline has no perf section: compile-budget checks "
+                     "skipped (record a fresh baseline with --record)")
+    for name, entry in run_progs.items():
+        budget = base_progs.get(name, {}).get("compiles")
+        if budget is None:
+            continue
+        if entry.get("compiles", 0) > budget + compile_slack:
+            fails.append(
+                f"program {name!r} compiled {entry['compiles']}x vs "
+                f"baseline {budget} (+slack {compile_slack}): shape "
+                "bucketing regressed")
+    return fails, notes
+
+
+def gate(run: dict, baseline: dict | None, tolerance: float = 0.15,
+         compile_slack: int = 2, structural_only: bool = False
+         ) -> tuple[list[str], list[str]]:
+    """Returns (failures, notes); empty failures = pass."""
+    fails = structural_failures(run)
+    notes: list[str] = []
+    if baseline is None:
+        notes.append("no baseline: structural checks only")
+        return fails, notes
+    run_platform = (run.get("detail") or {}).get("platform")
+    base_platform = (baseline.get("detail") or {}).get("platform")
+    if structural_only:
+        notes.append("--structural-only: value checks skipped")
+    elif run_platform != base_platform:
+        notes.append(
+            f"platform mismatch (run={run_platform!r} "
+            f"baseline={base_platform!r}): value checks skipped — absolute "
+            "throughput only gates like-for-like hardware")
+    else:
+        vf, vn = value_failures(run, baseline, tolerance, compile_slack)
+        fails.extend(vf)
+        notes.extend(vn)
+    return fails, notes
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Perf regression gate over bench.py JSON")
+    ap.add_argument("--run", required=True, help="bench.py output JSON")
+    ap.add_argument("--baseline", required=True,
+                    help="committed baseline JSON "
+                         "(deploy/perf-baseline.json)")
+    ap.add_argument("--tolerance", type=float, default=0.15,
+                    help="allowed fractional regression on throughput / "
+                         "roofline frac (default 0.15)")
+    ap.add_argument("--compile-slack", type=int, default=2,
+                    help="extra compiles per program over baseline before "
+                         "failing (default 2)")
+    ap.add_argument("--structural-only", action="store_true",
+                    help="skip absolute-value checks (CPU smoke runs)")
+    ap.add_argument("--record", action="store_true",
+                    help="write the run to the baseline path (after "
+                         "passing the structural checks) and exit")
+    args = ap.parse_args(argv)
+
+    run = load_run(args.run)
+    if args.record:
+        fails = structural_failures(run)
+        for f in fails:
+            print(f"[FAIL] {f}")
+        if fails:
+            print("perf_gate: refusing to record a structurally broken "
+                  "baseline")
+            return 1
+        with open(args.baseline, "w") as fh:
+            json.dump(run, fh, indent=1, sort_keys=True)
+        print(f"perf_gate: baseline recorded at {args.baseline} "
+              f"(platform={(run.get('detail') or {}).get('platform')!r})")
+        return 0
+
+    try:
+        baseline = load_run(args.baseline)
+    except FileNotFoundError:
+        baseline = None
+    fails, notes = gate(run, baseline, tolerance=args.tolerance,
+                        compile_slack=args.compile_slack,
+                        structural_only=args.structural_only)
+    for n in notes:
+        print(f"[note] {n}")
+    for f in fails:
+        print(f"[FAIL] {f}")
+    print(f"perf_gate: {'FAIL' if fails else 'PASS'} "
+          f"({len(fails)} failure(s))")
+    return 1 if fails else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

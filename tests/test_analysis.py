@@ -359,6 +359,69 @@ def test_jit_unhashable_static_spec_fires(tmp_path):
     assert len(found) == 1 and "static_argnums" in found[0].message
 
 
+# -- unregistered-jit ---------------------------------------------------------
+
+UNREGISTERED_BAD = """\
+import functools
+import jax
+
+compiled = jax.jit(forward)  # module scope is still a dark program
+
+@functools.partial(jax.jit, static_argnames=("bucket",))
+def kernel(x, bucket):
+    return x
+
+@jax.jit
+def bare(x):
+    return x
+
+class Runner:
+    def __init__(self):
+        self._fn = jax.jit(forward)
+"""
+
+UNREGISTERED_GOOD = """\
+from dynamo_tpu.engine import perf
+
+class Runner:
+    def __init__(self):
+        self._fn = perf.instrumented_jit("decode", forward,
+                                         key="decode", donate_argnums=(1,))
+
+    def _get_step(self, key):
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = perf.instrumented_jit("prefill", forward, key=key)
+            self._cache[key] = fn
+        return fn
+"""
+
+
+def test_unregistered_jit_fires(tmp_path):
+    found = run_rule(tmp_path, "unregistered-jit", UNREGISTERED_BAD)
+    assert len(found) == 4
+    assert all("observatory" in f.message for f in found)
+
+
+def test_unregistered_jit_quiet_on_good(tmp_path):
+    assert run_rule(tmp_path, "unregistered-jit", UNREGISTERED_GOOD) == []
+
+
+def test_unregistered_jit_exempts_perf_module(tmp_path):
+    # engine/perf.py is the chokepoint: its own jax.jit is the point.
+    found = run_rule(tmp_path, "unregistered-jit",
+                     "import jax\nfn = jax.jit(forward)\n",
+                     name="engine/perf.py")
+    assert found == []
+
+
+def test_unregistered_jit_suppression(tmp_path):
+    src = ("import jax\n"
+           "# dtpu: ignore[unregistered-jit] -- one-shot at pool creation\n"
+           "fn = jax.jit(forward)\n")
+    assert run_rule(tmp_path, "unregistered-jit", src) == []
+
+
 # -- wire-error-taxonomy ------------------------------------------------------
 
 ERRORS_SRC = """\
@@ -541,8 +604,8 @@ def test_default_rules_catalog():
     assert ids == {"blocking-call-in-async", "fire-and-forget-task",
                    "lock-across-await", "swallowed-cancellation",
                    "unbounded-queue", "unbounded-wait",
-                   "jit-recompile-hazard", "wire-error-taxonomy",
-                   "direct-prometheus-import"}
+                   "jit-recompile-hazard", "unregistered-jit",
+                   "wire-error-taxonomy", "direct-prometheus-import"}
 
 
 # -- direct-prometheus-import -------------------------------------------------

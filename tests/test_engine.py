@@ -323,10 +323,12 @@ async def test_warmup_windows_precompiles_and_serves():
         got, finish = await collect(eng, prompt, 8)
         assert finish == "length" and len(got) == 8
         # Warmup ran before the serving dispatches: the four window
-        # variants (plain, penalized, seeded, penalized+seeded) then the
-        # inert slots=None prefill.
-        assert calls[:4] == [("window", eng.decode_window)] * 4
-        assert calls[4] == ("prefill", None)
+        # variants (plain, penalized x2, seeded, penalized+seeded x2 —
+        # the penalized ones run twice so the post-GSPMD counts
+        # sharding signature also compiles pre-serving) then the inert
+        # slots=None prefill.
+        assert calls[:6] == [("window", eng.decode_window)] * 6
+        assert calls[6] == ("prefill", None)
     finally:
         eng.stop()
 

@@ -59,7 +59,8 @@ async def start_worker(coord):
     engine.start()
     inv_pub.start_periodic(engine.inventory_digest)
     status = SystemStatusServer(rt, host="127.0.0.1", port=0,
-                                kv_provider=engine.kv_status)
+                                kv_provider=engine.kv_status,
+                                perf_provider=engine.perf_status)
     await status.start()
     await register_status_server(rt, status.port,
                                  extra={"backend": "mocker"})
@@ -135,6 +136,11 @@ async def test_fleet_pane_smoke_two_workers_and_partial_path():
                 assert res["ok"] is True
                 assert res["kv"]["role"] == "mocker"
                 assert "digest" in res["kv"]
+                # Per-worker perf view rides the same fan-out
+                # (docs/OBSERVABILITY.md "Engine perf plane").
+                assert res["perf"]["role"] == "mocker"
+                assert "programs" in res["perf"]["compiles"]
+            assert "unexpected_recompiles" in agg
             # -- worker-local pane ---------------------------------------
             status, kv = await get_json(session, w1[3].port, "/debug/kv")
             assert status == 200

@@ -625,6 +625,21 @@ def test_docs_drift_new_series_are_documented():
     assert not missing, f"undocumented series: {sorted(missing)}"
 
 
+def test_docs_drift_perf_series_are_documented():
+    """PR 9 acceptance: every dynamo_tpu_perf_* series registered in the
+    source is documented in docs/OBSERVABILITY.md "Engine perf plane" —
+    the whole family, scanned from registration sites so a new perf_
+    metric can't ship undocumented."""
+    doc = (REPO / "docs" / "OBSERVABILITY.md").read_text()
+    documented = set(_DOC_NAME_RE.findall(doc))
+    perf_registered = {n for n in _registered_metric_names()
+                       if n.startswith("perf_")}
+    assert len(perf_registered) >= 9, \
+        f"expected the full perf_ family, scan found {sorted(perf_registered)}"
+    missing = perf_registered - documented
+    assert not missing, f"undocumented perf series: {sorted(missing)}"
+
+
 def test_docs_drift_kv_series_are_documented():
     """PR 8 acceptance: every dynamo_tpu_kv_* series registered in the
     source is documented in docs/OBSERVABILITY.md "KV & capacity" — the
