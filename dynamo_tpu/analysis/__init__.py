@@ -19,6 +19,7 @@ from dynamo_tpu.analysis.rules_async import (
     BlockingCallInAsync, FireAndForgetTask, LockAcrossAwait,
     SwallowedCancellation, UnboundedQueue, UnboundedWait)
 from dynamo_tpu.analysis.rules_jax import JitRecompileHazard, UnregisteredJit
+from dynamo_tpu.analysis.rules_journal import UntypedJournalEvent
 from dynamo_tpu.analysis.rules_metrics import DirectPrometheusImport
 from dynamo_tpu.analysis.rules_wire import WireErrorTaxonomy
 
@@ -37,6 +38,7 @@ DEFAULT_RULES: tuple[type[Rule], ...] = (
     JitRecompileHazard,
     UnregisteredJit,
     DirectPrometheusImport,
+    UntypedJournalEvent,
     WireErrorTaxonomy,
 )
 

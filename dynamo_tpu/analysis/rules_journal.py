@@ -1,0 +1,90 @@
+"""untyped-journal-event rule: journal emits stay on the typed taxonomy.
+
+The fleet event journal (runtime/journal.py) is only useful as an
+operator surface if its event vocabulary stays CLOSED: the timeline
+viewer, the doctor's flap/canary checks, and the Grafana decision-plane
+row all key on ``EventKind`` values. ``Journal.emit`` rejects unknown
+kinds at runtime, but a string literal that happens to match survives —
+until someone renames the constant and the call site silently forks the
+taxonomy. This rule makes the constructor discipline a lint invariant:
+
+- every ``journal.emit(...)`` call names its kind via the ``EventKind``
+  constants (an attribute access), never a string literal or a free
+  variable;
+- nothing publishes ad-hoc dict payloads onto the journal subject —
+  deltas are built only by ``JournalPublisher`` (runtime/journal.py is
+  the single allowed module, the same chokepoint pattern as
+  direct-prometheus-import).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from dynamo_tpu.analysis.core import Finding, Module, Rule, qualified_name
+
+_ALLOWED_SUFFIX = "runtime/journal.py"
+
+
+def _is_journal_base(node: ast.AST) -> bool:
+    """True for the receivers the journal API is reached through:
+    ``journal.emit``, ``journal_mod.emit``, ``self._journal.emit``..."""
+    name = qualified_name(node)
+    last = name.rsplit(".", 1)[-1] if name else ""
+    return "journal" in last.lower()
+
+
+class UntypedJournalEvent(Rule):
+    rule_id = "untyped-journal-event"
+    description = ("journal emits must use the typed EventKind "
+                   "constructors from runtime/journal.py (no string "
+                   "literals, no ad-hoc dict publishes onto the journal "
+                   "subject): the timeline, doctor, and dashboards key "
+                   "on the closed taxonomy")
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        path = module.path.replace("\\", "/")
+        if path.endswith(_ALLOWED_SUFFIX):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            if func.attr == "emit" and _is_journal_base(func.value):
+                kind = node.args[0] if node.args else None
+                if kind is None:
+                    for kw in node.keywords:
+                        if kw.arg == "kind":
+                            kind = kw.value
+                if kind is None:
+                    continue  # malformed; runtime raises anyway
+                if not (isinstance(kind, ast.Attribute)
+                        and "EventKind" in qualified_name(kind)):
+                    yield self.finding(
+                        module, node,
+                        "journal emit with an untyped kind: the event "
+                        "vocabulary is a closed taxonomy keyed on the "
+                        "EventKind constants",
+                        "pass EventKind.<NAME> from runtime/journal.py "
+                        "(add a new constant there if the taxonomy "
+                        "genuinely grows)")
+            elif func.attr == "publish" and node.args:
+                subject = node.args[0]
+                subject_name = (qualified_name(subject.func)
+                                if isinstance(subject, ast.Call)
+                                else qualified_name(subject))
+                if "journal_subject" not in subject_name:
+                    continue
+                payload = node.args[1] if len(node.args) > 1 else None
+                if isinstance(payload, (ast.Dict, ast.Constant, ast.List)):
+                    yield self.finding(
+                        module, node,
+                        "ad-hoc payload published onto the journal "
+                        "subject: consumers seq-fence deltas and expect "
+                        "the JournalPublisher envelope",
+                        "emit through the process journal and let "
+                        "JournalPublisher (runtime/journal.py) ship the "
+                        "delta")

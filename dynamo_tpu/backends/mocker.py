@@ -27,8 +27,10 @@ from dynamo_tpu.llm.model_card import (ModelRuntimeConfig, deregister_llm,
                                        register_llm)
 from dynamo_tpu.llm.reconfig import ROLES, RoleManager, ServingProfile
 from dynamo_tpu.llm.tokenizer import Tokenizer, make_test_tokenizer
+from dynamo_tpu.runtime import journal
 from dynamo_tpu.runtime.config import RuntimeConfig
 from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.journal import JournalPublisher
 
 
 def parse_args(argv=None) -> argparse.Namespace:
@@ -109,6 +111,14 @@ async def run(args: argparse.Namespace) -> None:
         engine = MockerEngine(mocker_cfg, kv_pub, metrics_pub,
                               inventory_publisher=inventory_pub)
         inventory_pub.start_periodic(engine.inventory_digest)
+        # Decision plane: this worker's journal (role flips, preempts,
+        # breaker views) rides the event plane into the frontend's
+        # merged /debug/timeline.
+        journal.configure(worker=f"{runtime.instance_id:x}",
+                          metrics=runtime.metrics)
+        journal_pub = JournalPublisher(runtime.require_coordinator(), ns,
+                                       f"{runtime.instance_id:x}")
+        journal_pub.start_periodic()
         roles = RoleManager(runtime,
                             make_profile_builder(runtime, engine, args,
                                                  tokenizer),
@@ -142,6 +152,7 @@ async def run(args: argparse.Namespace) -> None:
             except NotImplementedError:
                 pass
         await runtime.wait_for_shutdown()
+        journal_pub.stop_periodic()
         inventory_pub.stop_periodic()
         await engine.stop()
         if status_server is not None:

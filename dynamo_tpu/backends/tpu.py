@@ -554,11 +554,21 @@ async def run(args: argparse.Namespace) -> None:
         import dataclasses as _dc
 
         from dynamo_tpu.runtime import flight as _flight
+        from dynamo_tpu.runtime import journal as _journal
         from dynamo_tpu.runtime import slo as _slo
         _flight.configure(metrics=runtime.metrics,
                           config_fingerprint=_dc.asdict(cfg))
         _slo.configure(cfg.slo, metrics=runtime.metrics).on_page(
             _flight.on_slo_page)
+        # Decision plane (runtime/journal.py): this worker's preempts,
+        # role-flip edges, and chaos injections ride the event plane
+        # into the frontend's merged /debug/timeline.
+        _journal.configure(worker=f"{runtime.instance_id:x}",
+                           metrics=runtime.metrics)
+        journal_pub = _journal.JournalPublisher(
+            runtime.require_coordinator(), cfg.namespace,
+            f"{runtime.instance_id:x}")
+        journal_pub.start_periodic()
         status_server = None
         if cfg.system_enabled:
             from dynamo_tpu.llm.fleet import register_status_server
@@ -587,6 +597,7 @@ async def run(args: argparse.Namespace) -> None:
             except NotImplementedError:
                 pass
         await runtime.wait_for_shutdown()
+        journal_pub.stop_periodic()
         inventory_pub.stop_periodic()
         engine.stop()
         if multihost_engine:

@@ -25,6 +25,9 @@ prints OK/WARN/FAIL per check. The TPU-native equivalent probes:
   perf views), WARNing on unexpected steady-state recompiles, HBM
   headroom under 10%, or live roofline_frac regressing > 20% below the
   recorded expectation (DTPU_EXPECTED_ROOFLINE_FRAC / model card)
+- the decision plane: ``/debug/timeline`` (runtime/journal.py), WARNing
+  on journal-ring overflow drops, breakers that flapped open more than
+  N times in the window, and live canary failure streaks
 
 Exit code 0 = no FAIL. Run: ``python -m dynamo_tpu.doctor
 [--coordinator-url tcp://...] [--frontend-url http://...]``.
@@ -483,6 +486,87 @@ async def check_perf(rep: Report, url: str) -> None:
                         f"{frac:.3f} vs expected {expected} (ok)")
 
 
+#: Breaker open-transitions per worker in the timeline window above
+#: which the doctor calls it flapping (open -> half-open -> open churn:
+#: the worker is sick but keeps winning its half-open probe).
+BREAKER_FLAP_N = 3
+#: Consecutive canary failures on one worker worth a WARN.
+CANARY_FAIL_N = 3
+
+
+def check_decision_plane(rep: Report, timeline: dict) -> None:
+    """Decision plane (docs/OBSERVABILITY.md "Decision plane"): judge a
+    /debug/timeline body — journal-ring overflow drops, repeated canary
+    failures, breaker flapping. Pure function over the payload so the
+    checks are unit-testable without HTTP."""
+    events = timeline.get("events") or []
+    local = timeline.get("local") or {}
+    dropped = int(local.get("dropped_overflow") or 0)
+    gaps = int(timeline.get("gaps") or 0)
+    if dropped or gaps:
+        rep.add(WARN, "journal ring",
+                f"{dropped} events dropped to ring overflow, {gaps} "
+                "timeline gaps (raise DTPU_JOURNAL_CAPACITY or the "
+                "publisher cadence): cause chains may be broken")
+    else:
+        rep.add(OK, "journal ring",
+                f"{len(events)} events merged, zero overflow drops")
+    # Breaker flaps: open transitions per worker in the window.
+    opens: dict[str, int] = {}
+    for e in events:
+        attrs = e.get("attrs") or {}
+        if (e.get("kind") == "breaker_transition"
+                and attrs.get("to") == "open"):
+            w = str(attrs.get("worker_id") or "?")
+            opens[w] = opens.get(w, 0) + 1
+    for w, n in sorted(opens.items()):
+        if n > BREAKER_FLAP_N:
+            rep.add(WARN, f"breaker {w}",
+                    f"flapped open {n} times in the timeline window "
+                    "(open -> half-open -> open churn): probes keep "
+                    "re-admitting a sick worker")
+    if opens and all(n <= BREAKER_FLAP_N for n in opens.values()):
+        rep.add(OK, "breakers",
+                f"{sum(opens.values())} open transition(s) across "
+                f"{len(opens)} worker(s), none flapping")
+    # Canary: trailing consecutive failures per worker (a fail streak
+    # ended by canary_ok is a recovered incident, not a live one).
+    streaks: dict[str, int] = {}
+    for e in events:
+        attrs = e.get("attrs") or {}
+        w = str(attrs.get("worker_id") or "?")
+        if e.get("kind") == "canary_fail":
+            streaks[w] = streaks.get(w, 0) + 1
+        elif e.get("kind") == "canary_ok":
+            streaks[w] = 0
+    live = {w: n for w, n in streaks.items() if n >= CANARY_FAIL_N}
+    for w, n in sorted(live.items()):
+        rep.add(WARN, f"canary {w}",
+                f"{n} consecutive canary failures and no recovery: the "
+                "worker is wedged (its breaker should be open — check "
+                "breaker_transition events)")
+    if streaks and not live:
+        rep.add(OK, "canary", "probing active, no live failure streaks")
+
+
+async def check_timeline(rep: Report, url: str) -> None:
+    """Probe GET /debug/timeline and judge the decision plane."""
+    import aiohttp
+    url = url.rstrip("/")
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"{url}/debug/timeline",
+                                   timeout=aiohttp.ClientTimeout(10)) as r:
+                if r.status != 200:
+                    rep.add(FAIL, "/debug/timeline", f"HTTP {r.status}")
+                    return
+                timeline = await r.json()
+    except (aiohttp.ClientError, OSError, asyncio.TimeoutError) as exc:
+        rep.add(FAIL, "/debug/timeline", f"{url}: {exc}")
+        return
+    check_decision_plane(rep, timeline)
+
+
 async def run(args) -> int:
     rep = Report()
     check_imports(rep)
@@ -498,6 +582,7 @@ async def run(args) -> int:
         await check_observability(rep, args.frontend_url)
         await check_fleet_kv(rep, args.frontend_url)
         await check_perf(rep, args.frontend_url)
+        await check_timeline(rep, args.frontend_url)
     n_fail = sum(1 for s, _, _ in rep.rows if s == FAIL)
     print(f"doctor: {len(rep.rows)} checks, {n_fail} failures", flush=True)
     return 1 if rep.failed else 0

@@ -42,7 +42,8 @@ from dynamo_tpu.llm.kv_router.protocols import (ForwardPassMetrics, KvStats,
 from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput, PreprocessedRequest
 from dynamo_tpu.llm.tokens import TokenBlockSequence
 from dynamo_tpu.engine import perf as perf_plane
-from dynamo_tpu.runtime import chaos, flight
+from dynamo_tpu.runtime import chaos, flight, journal
+from dynamo_tpu.runtime.journal import EventKind
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.engine import AsyncEngine
 from dynamo_tpu.runtime.logging import current_trace, get_logger
@@ -2155,6 +2156,15 @@ class TPUEngine(AsyncEngine):
         log.warning("KV pool exhausted: preempting slot %d (request %s, "
                     "%d tokens so far) and requeueing", slot, r.ctx.id,
                     len(r.tokens_all))
+        # Decision plane: preemption is an autonomous capacity decision
+        # (engine thread; journal.emit is lock-only, no I/O). Cause: a
+        # chaos injection when one is driving the pressure.
+        journal.emit(EventKind.PREEMPT,
+                     cause=(journal.recent_ref(EventKind.CHAOS_INJECT)
+                            if chaos.ACTIVE else None),
+                     trace_id=r.ctx.trace_id, request=r.ctx.id, slot=slot,
+                     tokens=len(r.tokens_all),
+                     free_pages=self.allocator.num_free)
         self._queue_put(r)
 
     # -- metrics + events -----------------------------------------------------

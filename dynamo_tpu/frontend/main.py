@@ -15,7 +15,7 @@ import dataclasses
 from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
 from dynamo_tpu.llm.http_service import HttpService
 from dynamo_tpu.llm.recorder import configure_ledger
-from dynamo_tpu.runtime import flight, slo
+from dynamo_tpu.runtime import flight, journal, slo
 from dynamo_tpu.runtime.config import RuntimeConfig
 from dynamo_tpu.runtime.distributed import DistributedRuntime
 from dynamo_tpu.runtime.logging import get_logger
@@ -25,12 +25,16 @@ log = get_logger("frontend")
 
 
 def init_observability(cfg: RuntimeConfig, runtime) -> None:
-    """Arm the SLO plane, the accounting ledger, and the flight
-    recorder's bundle context for this process (shared by the frontend
-    and launcher entrypoints)."""
+    """Arm the SLO plane, the accounting ledger, the fleet journal, and
+    the flight recorder's bundle context for this process (shared by
+    the frontend and launcher entrypoints)."""
     plane = slo.configure(cfg.slo, metrics=runtime.metrics)
     configure_ledger(cfg.slo.request_ring,
                      cfg.slo.request_log_path or None)
+    # Decision plane (runtime/journal.py): attribute this process's
+    # events to its instance id so cause refs are fleet-unique.
+    journal.configure(worker=f"{runtime.instance_id:x}",
+                      metrics=runtime.metrics)
     flight.configure(metrics=runtime.metrics,
                      config_fingerprint=dataclasses.asdict(cfg))
     # A fast-burn SLO page freezes the flight ring and captures a
@@ -85,6 +89,18 @@ def parse_args(argv=None) -> argparse.Namespace:
                         help="append per-request accounting records as "
                              "JSONL here (scripts/slo_report.py rolls "
                              "them up)")
+    # Synthetic canary probing (llm/canary.py; docs/OBSERVABILITY.md
+    # "Decision plane"): fine-grained knobs via DTPU_CANARY_* env.
+    parser.add_argument("--canary", action="store_true",
+                        help="probe every worker with tiny known-answer "
+                             "greedy requests; repeated failures eject "
+                             "the worker via its circuit breaker before "
+                             "user traffic hits it")
+    parser.add_argument("--canary-interval-s", type=float, default=None,
+                        help="seconds between canary probe sweeps")
+    parser.add_argument("--canary-ttft-bound-ms", type=float, default=None,
+                        help="a canary first token slower than this "
+                             "fails the probe")
     parser.add_argument("--coordinator-url", default=None)
     parser.add_argument("--grpc-port", type=int, default=None,
                         help="also serve the KServe v2 gRPC inference "
@@ -117,7 +133,6 @@ async def run(args: argparse.Namespace) -> None:
     manager = ModelManager()
     watcher = ModelWatcher(runtime, manager, router_mode=args.router_mode,
                            kv_router_factory=kv_router_factory)
-    await watcher.start()
     ov = cfg.overload
     if args.no_overload_defense:
         ov.enabled = False
@@ -139,12 +154,36 @@ async def run(args: argparse.Namespace) -> None:
         cfg.slo.error_rate = args.slo_error_rate
     if args.request_log is not None:
         cfg.slo.request_log_path = args.request_log
+    # Observability (incl. the journal's worker identity) arms BEFORE
+    # discovery starts: the first worker_join events must already carry
+    # this process's id, not the "proc" placeholder.
     init_observability(cfg, runtime)
+    await watcher.start()
+    # Decision plane: merge the fleet's journal deltas into one causal
+    # timeline (llm/timeline.py) served at GET /debug/timeline, and arm
+    # the synthetic canary prober when asked.
+    from dynamo_tpu.llm.canary import (CanaryConfig, CanaryProber,
+                                       apply_canary_env)
+    from dynamo_tpu.llm.timeline import TimelineCollector
+    collector = TimelineCollector(runtime)
+    await collector.start()
+    canary_cfg = apply_canary_env(CanaryConfig())
+    if args.canary:
+        canary_cfg.enabled = True
+    if args.canary_interval_s is not None:
+        canary_cfg.interval_s = args.canary_interval_s
+    if args.canary_ttft_bound_ms is not None:
+        canary_cfg.ttft_bound_ms = args.canary_ttft_bound_ms
+    canary = (CanaryProber(manager, canary_cfg, metrics=runtime.metrics)
+              if canary_cfg.enabled else None)
     service = HttpService(runtime, manager, args.http_host, args.http_port,
                           tls_cert_path=args.tls_cert_path,
                           tls_key_path=args.tls_key_path,
                           overload=limiter)
+    service.timeline_provider = collector.timeline_status
     await service.start()
+    if canary is not None:
+        canary.start()
     grpc_server = None
     if args.grpc_port is not None:
         from dynamo_tpu.grpc.kserve import make_server
@@ -165,6 +204,9 @@ async def run(args: argparse.Namespace) -> None:
     finally:
         if grpc_server is not None:
             await grpc_server.stop(grace=2)
+        if canary is not None:
+            await canary.stop()
+        await collector.stop()
         await service.stop()
         await watcher.stop()
         await runtime.close()

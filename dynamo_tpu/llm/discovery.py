@@ -17,8 +17,10 @@ from dynamo_tpu.llm.backend import Backend
 from dynamo_tpu.llm.migration import Migration
 from dynamo_tpu.llm.model_card import MODEL_ROOT, ModelEntry, fetch_tokenizer
 from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.runtime import journal
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.engine import AsyncEngine
+from dynamo_tpu.runtime.journal import EventKind
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.runtime.tracing import span
 
@@ -121,9 +123,16 @@ class ModelWatcher:
                 log.info("model %s now served via %s/%s/%s", entry.model_name,
                          entry.namespace, entry.component, entry.endpoint)
             try:
-                served.instances.add(int(instance_hex, 16))
+                iid = int(instance_hex, 16)
             except ValueError:
-                pass
+                return
+            if iid not in served.instances:
+                served.instances.add(iid)
+                # Decision plane: fleet membership changes are the raw
+                # material of most incident chains ("the flip happened
+                # because the fleet lost a worker").
+                journal.emit(EventKind.WORKER_JOIN, model=entry.model_name,
+                             instance=instance_hex)
 
     async def _on_delete(self, key: str) -> None:
         parts = key[len(MODEL_ROOT):].split("/")
@@ -136,9 +145,18 @@ class ModelWatcher:
                 if model_slug(name) != slug:
                     continue
                 try:
-                    served.instances.discard(int(instance_hex, 16))
+                    iid = int(instance_hex, 16)
                 except ValueError:
-                    pass
+                    iid = None
+                if iid is not None and iid in served.instances:
+                    served.instances.discard(iid)
+                    # A lease-expiry delete under chaos is chaos's doing.
+                    from dynamo_tpu.runtime import chaos
+                    journal.emit(
+                        EventKind.WORKER_LEAVE,
+                        cause=(journal.recent_ref(EventKind.CHAOS_INJECT)
+                               if chaos.ACTIVE else None),
+                        model=name, instance=instance_hex)
                 if not served.instances:
                     log.info("model %s: last instance gone; removing", name)
                     await self._close_served(served)

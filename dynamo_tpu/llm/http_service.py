@@ -103,6 +103,10 @@ class HttpService:
         # deadline-aware shedding + brownout around the generate routes.
         # None = no admission control (tests, embedded use).
         self.overload = overload
+        # GET /debug/timeline provider: the frontend entrypoint installs
+        # its TimelineCollector's merged fleet view before start(); left
+        # None, the route serves this process's own journal.
+        self.timeline_provider = None
         self._runner: web.AppRunner | None = None
         metrics = runtime.metrics.namespace("http")
         self._m_requests = metrics.counter(
@@ -141,7 +145,8 @@ class HttpService:
         # decision telemetry.
         from dynamo_tpu.runtime.health import add_debug_routes
         add_debug_routes(app, kv_provider=self._kv_router_status,
-                         perf_provider=self._perf_status)
+                         perf_provider=self._perf_status,
+                         timeline_provider=self.timeline_provider)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         ssl_ctx = None

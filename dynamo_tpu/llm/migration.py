@@ -21,9 +21,11 @@ from __future__ import annotations
 from typing import AsyncIterator
 
 from dynamo_tpu.llm.protocols import LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.runtime import chaos, journal
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.engine import AsyncEngine, Operator
 from dynamo_tpu.runtime.errors import StreamIncompleteError
+from dynamo_tpu.runtime.journal import EventKind
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.runtime.retry import Backoff, RetryBudget, policies
 from dynamo_tpu.runtime.tracing import span
@@ -89,6 +91,20 @@ class Migration(Operator):
                                                           or "disconnect")
                 if self._m_migrations is not None:
                     self._m_migrations.inc()
+                # Decision plane: the migration decision with its typed
+                # reason. Cause: the worker's drain/flip when the typed
+                # reason says so (the flip events arrive on the merged
+                # timeline from the worker's own journal), else a chaos
+                # injection when one is active.
+                journal.emit(
+                    EventKind.MIGRATION,
+                    cause=(journal.recent_ref(EventKind.CHAOS_INJECT)
+                           if chaos.ACTIVE else None),
+                    trace_id=context.trace_id, attempt=attempt,
+                    reason=context.values.get("migration_reason"),
+                    carried_tokens=len(accumulated),
+                    retries_left=retries_left,
+                    worker_id=context.values.get("worker_id"))
                 log.warning(
                     "Stream disconnected (%s)... recreating stream "
                     "(%d retries left, carrying %d generated tokens)",

@@ -52,7 +52,9 @@ import asyncio
 import time
 from typing import Awaitable, Callable
 
+from dynamo_tpu.runtime import journal
 from dynamo_tpu.runtime.errors import RoleTransitionError
+from dynamo_tpu.runtime.journal import EventKind
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.runtime.retry import Backoff, policies
 from dynamo_tpu.runtime.tracing import span
@@ -236,11 +238,15 @@ class RoleManager:
     # -- the SetRole verb -----------------------------------------------------
     async def set_role(self, role: str, epoch: int,
                        issued_by: str = "operator",
-                       drain_s: float | None = None) -> dict:
-        """Apply one SetRole directive. Returns the outcome record;
-        raises RoleTransitionError (typed, wire-prefixed) on fencing
-        rejections — unknown role, stale/duplicate epoch, or a
-        conflicting flip already in flight."""
+                       drain_s: float | None = None,
+                       cause: str | None = None) -> dict:
+        """Apply one SetRole directive. ``cause`` is the issuer's
+        journal ref (a planner_decision event rides the directive) so
+        the worker's flip events chain back to the decision that issued
+        them. Returns the outcome record; raises RoleTransitionError
+        (typed, wire-prefixed) on fencing rejections — unknown role,
+        stale/duplicate epoch, or a conflicting flip already in
+        flight."""
         if role not in ROLES:
             raise RoleTransitionError(
                 f"unknown role {role!r} (want one of {ROLES})")
@@ -253,7 +259,8 @@ class RoleManager:
                     and self._inflight_epoch == epoch):
                 return {"from": self.role, "to": role, "epoch": epoch,
                         "outcome": "duplicate", "state": self.state}
-            self._note_fence(self.role, role, epoch, "rejected_busy")
+            self._note_fence(self.role, role, epoch, "rejected_busy",
+                             cause=cause)
             raise RoleTransitionError(
                 f"flip to {self.target_role!r} (epoch "
                 f"{self._inflight_epoch}) in flight; retry after it "
@@ -264,7 +271,8 @@ class RoleManager:
                     # Exact duplicate of the applied directive: idempotent.
                     return {"from": self.role, "to": role, "epoch": epoch,
                             "outcome": "duplicate", "state": self.state}
-                self._note_fence(self.role, role, epoch, "rejected_stale")
+                self._note_fence(self.role, role, epoch, "rejected_stale",
+                                 cause=cause)
                 raise RoleTransitionError(
                     f"stale epoch {epoch} (applied epoch "
                     f"{self.applied_epoch}, role {self.role!r})")
@@ -272,12 +280,16 @@ class RoleManager:
                 # Fence forward without a transition.
                 self.applied_epoch = epoch
                 self.last_outcome = self._outcome(role, role, epoch, "noop")
+                journal.emit(EventKind.ROLE_FLIP_DONE, cause=cause,
+                             **{"from": role, "to": role, "epoch": epoch,
+                                "outcome": "noop"})
                 await self._write_status()
                 return self.last_outcome
-            return await self._flip(role, epoch, issued_by, drain_s)
+            return await self._flip(role, epoch, issued_by, drain_s, cause)
 
     async def _flip(self, role: str, epoch: int, issued_by: str,
-                    drain_s: float | None) -> dict:
+                    drain_s: float | None,
+                    cause: str | None = None) -> dict:
         old = self.role
         self.target_role = role
         self._inflight_epoch = epoch
@@ -285,10 +297,23 @@ class RoleManager:
         budget = self.drain_s if drain_s is None else drain_s
         log.info("role flip %s -> %s (epoch %d, by %s): draining up to "
                  "%.1fs", old, role, epoch, issued_by, budget)
+        # Every state-machine edge lands on the decision plane, each
+        # edge caused by the previous one (and the first by the
+        # issuer's decision event when the directive carried its ref).
+        requested_ref = journal.emit(
+            EventKind.ROLE_FLIP_REQUESTED, cause=cause,
+            **{"from": old, "to": role, "epoch": epoch,
+               "issued_by": issued_by})
         with span("role.flip", to=role, epoch=epoch, issued_by=issued_by,
                   **{"from": old}) as sp:
             try:
                 self.state = RoleState.DRAINING
+                drain_ref = journal.emit(
+                    EventKind.ROLE_FLIP_DRAINING, cause=requested_ref,
+                    **{"from": old, "to": role, "epoch": epoch,
+                       "inflight": self.profile.inflight,
+                       "drain_s": budget})
+                requested_ref = drain_ref
                 await self._write_status()
                 with span("role.drain", inflight=self.profile.inflight):
                     await self.profile.drain(budget, reason=DRAIN_REASON)
@@ -321,6 +346,10 @@ class RoleManager:
                 self.flips += 1
                 self.last_outcome = self._outcome(old, role, epoch, outcome,
                                                   error)
+                journal.emit(EventKind.ROLE_FLIP_DONE, cause=requested_ref,
+                             **{"from": old, "to": role, "epoch": epoch,
+                                "outcome": outcome,
+                                **({"error": error} if error else {})})
                 sp.set(outcome=outcome)
                 if self._m_flips is not None:
                     self._m_flips.inc(**{"from": old, "to": role,
@@ -354,7 +383,8 @@ class RoleManager:
             await self.set_role(
                 str(value["role"]), int(value.get("epoch", 0)),
                 issued_by=str(value.get("issued_by", "directive")),
-                drain_s=value.get("drain_s"))
+                drain_s=value.get("drain_s"),
+                cause=value.get("cause"))
         except RoleTransitionError as exc:
             # Fencing rejections are normal under replay/duplication;
             # the typed decision is visible in status/metrics.
@@ -430,8 +460,11 @@ class RoleManager:
         return rec
 
     def _note_fence(self, old: str, new: str, epoch: int,
-                    outcome: str) -> None:
+                    outcome: str, cause: str | None = None) -> None:
         self.last_outcome = self._outcome(old, new, epoch, outcome)
+        journal.emit(EventKind.ROLE_FLIP_REJECTED, cause=cause,
+                     **{"from": old, "to": new, "epoch": epoch,
+                        "outcome": outcome})
         if self._m_flips is not None:
             self._m_flips.inc(**{"from": old, "to": new, "outcome": outcome})
 

@@ -124,6 +124,13 @@ class Planner:
                 pressure_fn=self._slo_pressure,
                 queue_depth_fn=(self._queue_depth
                                 if cfg.model_name else None))
+        # Decision plane: the planner's reconfig decisions (and their
+        # input signals) ride the journal subject into the frontend's
+        # merged /debug/timeline, same as worker journals.
+        from dynamo_tpu.runtime.journal import JournalPublisher, get_journal
+        get_journal().worker = "planner"
+        self._journal_pub = JournalPublisher(client, cfg.namespace, "planner")
+        self._journal_pub.start_periodic()
         self._tasks.append(asyncio.create_task(self._loop()))
 
     @staticmethod
@@ -141,6 +148,9 @@ class Planner:
         return await client.queue_len(queue_name(self.config.model_name))
 
     async def stop(self) -> None:
+        pub = getattr(self, "_journal_pub", None)
+        if pub is not None:
+            pub.stop_periodic()
         for t in self._tasks:
             t.cancel()
         for s in self._subs:

@@ -35,6 +35,8 @@ import time
 
 from dynamo_tpu.llm.reconfig import (ROLE_ROOT, ROLE_STATUS_ROOT, RoleState,
                                      role_key)
+from dynamo_tpu.runtime import journal
+from dynamo_tpu.runtime.journal import EventKind
 from dynamo_tpu.runtime.logging import get_logger
 
 log = get_logger("planner.reconfig")
@@ -103,6 +105,7 @@ class RoleReconfigurator:
         self._clock = clock
         self._last_flip_t: float | None = None
         self._streak = {"to_prefill": 0, "to_decode": 0}
+        self._last_decision_ref: str | None = None
         self.issued: list[dict] = []
 
     # -- fleet view -----------------------------------------------------------
@@ -149,34 +152,59 @@ class RoleReconfigurator:
             return record
         if self._streak[want] < cfg.hysteresis_intervals:
             record["action"] = "hysteresis"
-            return record
+            return self._journal_decision(record)
         now = self._clock()
         if (self._last_flip_t is not None
                 and now - self._last_flip_t < cfg.cooldown_s):
             record["action"] = "cooldown"
-            return record
+            return self._journal_decision(record)
         if self._flip_in_flight(fleet, directives):
             record["action"] = "flip_in_flight"
-            return record
+            return self._journal_decision(record)
         target_role = "prefill" if want == "to_prefill" else "decode"
         candidate = self._candidate(fleet, target_role)
         if candidate is None:
             record["action"] = "bounded"
-            return record
+            return self._journal_decision(record)
         epoch = self._next_epoch(fleet, directives)
-        directive = await self.issue(candidate["worker"], target_role, epoch)
+        self._journal_decision(dict(record, action="flip",
+                                    worker=candidate["worker"],
+                                    target_role=target_role, epoch=epoch))
+        directive = await self.issue(candidate["worker"], target_role,
+                                     epoch, cause=self._last_decision_ref)
         self._last_flip_t = now
         self._streak[want] = 0
         record["action"] = "flip"
         record["directive"] = directive
         return record
 
+    def _journal_decision(self, record: dict) -> dict:
+        """Every non-trivial planner decision (including the guard rails
+        that SUPPRESSED a flip) lands on the decision plane with its
+        input signals — 'why did/didn't the planner act' is answerable
+        from the timeline. The flip decision's ref rides the directive
+        so the worker's role_flip_requested chains back to it."""
+        self._last_decision_ref = journal.emit(
+            EventKind.PLANNER_DECISION,
+            action=record.get("action"), signal=record.get("signal"),
+            pressure=record.get("pressure"),
+            queue_depth=record.get("queue_depth"),
+            roles=record.get("roles"),
+            worker=record.get("worker"),
+            target_role=record.get("target_role"))
+        return record
+
     async def issue(self, worker_hex: str, role: str, epoch: int,
-                    issued_by: str = "planner") -> dict:
+                    issued_by: str = "planner",
+                    cause: str | None = None) -> dict:
         """Write one SetRole directive on OUR lease (planner death ->
-        lease expiry -> directive key deleted -> stale flip fenced)."""
+        lease expiry -> directive key deleted -> stale flip fenced).
+        ``cause`` (the planner_decision journal ref) rides the directive
+        into the worker's role_flip_* events."""
         directive = {"role": role, "epoch": int(epoch),
                      "issued_by": issued_by, "ts": time.time()}
+        if cause is not None:
+            directive["cause"] = cause
         if self.cfg.drain_s > 0:
             directive["drain_s"] = self.cfg.drain_s
         await self._client.kv_put(

@@ -69,9 +69,16 @@ import re
 import threading
 import time
 
+from dynamo_tpu.runtime import journal
+from dynamo_tpu.runtime.journal import EventKind
 from dynamo_tpu.runtime.logging import get_logger
 
 log = get_logger("chaos")
+
+#: Journal throttle: at most one chaos_inject event per (key, site) per
+#: this many seconds (a 100%-probability delay rule fires per frame —
+#: the decision plane wants "chaos is injecting X here", not a flood).
+_JOURNAL_INTERVAL_S = 1.0
 
 ENV_VAR = "DTPU_CHAOS"
 
@@ -177,6 +184,10 @@ class FaultPlan:
         # Bounded decision log: (key, site, magnitude) per FIRED fault —
         # lets tests assert same-seed runs produce identical sequences.
         self.log: list[tuple[str, str, float]] = []
+        # (key, site) -> [last_journal_t, suppressed_count] for the
+        # journal emit throttle (chaos runs are self-documenting on the
+        # decision plane without flooding the ring).
+        self._journal_last: dict[tuple[str, str], list] = {}
         for directive in spec.split(";"):
             directive = directive.strip()
             if not directive:
@@ -214,8 +225,28 @@ class FaultPlan:
                 if magnitude is not None:
                     if len(self.log) < 4096:
                         self.log.append((key, site or "", magnitude))
+                    self._journal_fire(key, site or "", magnitude)
                     return magnitude
         return None
+
+    def _journal_fire(self, key: str, site: str, magnitude: float) -> None:
+        """Every injected fault lands on the decision plane (throttled
+        per key/site): a chaos run documents itself, and downstream
+        breaker/shed/alert events can name the injection as their
+        cause. Called under self._lock; the journal's own lock nests
+        inside it and never takes this one back."""
+        now = time.monotonic()
+        state = self._journal_last.setdefault((key, site), [-1e18, 0])
+        if now - state[0] < _JOURNAL_INTERVAL_S:
+            state[1] += 1
+            return
+        suppressed, state[0], state[1] = state[1], now, 0
+        try:
+            journal.emit(EventKind.CHAOS_INJECT, key=key, site=site,
+                         magnitude=round(magnitude, 4), seed=self.seed,
+                         suppressed=suppressed)
+        except Exception:  # noqa: BLE001 — fault injection must not crash
+            log.exception("chaos journal emit failed")
 
 
 # -- module-level install/uninstall -------------------------------------------

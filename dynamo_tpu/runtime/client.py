@@ -123,6 +123,9 @@ class EndpointClient:
         self.breakers = BreakerBoard(
             getattr(runtime.config, "overload", None),
             metrics=getattr(runtime, "metrics", None))
+        # Throttle for the all-breakers-open journal shed event.
+        self._breakers_shed_t = -1e18
+        self._breakers_shed_n = 0
 
     async def start(self) -> None:
         if self._runtime.has_discovery:
@@ -206,6 +209,22 @@ class EndpointClient:
         # must be able to reach a sick instance deliberately).
         healthy = self.breakers.admitted(ids)
         if not healthy:
+            from dynamo_tpu.runtime import journal
+            from dynamo_tpu.runtime.journal import EventKind
+            now = time.monotonic()
+            if now - self._breakers_shed_t >= 1.0:
+                # Throttled like the limiter's shed events: one journal
+                # event speaks for the storm, with the suppressed tally.
+                journal.emit(
+                    EventKind.SHED,
+                    cause=journal.recent_ref(EventKind.BREAKER_TRANSITION),
+                    reason="breakers_open", instances=len(ids),
+                    endpoint=self._endpoint.path,
+                    suppressed=self._breakers_shed_n)
+                self._breakers_shed_t = now
+                self._breakers_shed_n = 0
+            else:
+                self._breakers_shed_n += 1
             raise OverloadedError(
                 f"all {len(ids)} instances for {self._endpoint.path} are "
                 "circuit-open; retry shortly")

@@ -39,6 +39,8 @@ import time
 
 import numpy as np
 
+from dynamo_tpu.runtime import journal as journal_mod
+from dynamo_tpu.runtime.journal import EventKind
 from dynamo_tpu.runtime.logging import get_logger
 
 log = get_logger("flight")
@@ -242,6 +244,11 @@ def capture_bundle(reason: str, out_dir: str | None = None) -> str:
         "spans": span_rec.export_chrome(),
         "metrics": (_metrics_registry.expose().decode()
                     if _metrics_registry is not None else None),
+        # The recent decision-plane slice: one bundle is a complete
+        # incident artifact — what the engine was doing (flight ring),
+        # what requests were doing (spans), and WHY the fleet acted
+        # (journal), side by side.
+        "journal": journal_mod.get_journal().snapshot(limit=256),
         "config_fingerprint": _fingerprint_payload(),
     }
     with open(path, "w") as fh:
@@ -263,6 +270,16 @@ def trigger(reason: str, clock=time.monotonic) -> bool:
         _last_trigger_t = now
         triggers_total += 1
     _RECORDER.freeze(reason)
+    # Decision plane: an anomaly capture is itself a fleet decision.
+    # Cause: the SLO page that pulled the trigger, else (decode-stall
+    # path) the chaos injection that froze the engine, when either is
+    # on the recent record.
+    journal_mod.emit(
+        EventKind.FLIGHT_BUNDLE,
+        cause=(journal_mod.recent_ref(EventKind.SLO_ALERT_FIRE)
+               if reason.startswith("slo_burn")
+               else journal_mod.recent_ref(EventKind.CHAOS_INJECT)),
+        reason=reason)
 
     def _write() -> None:
         try:

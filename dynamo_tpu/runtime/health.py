@@ -61,9 +61,18 @@ try:
 except AttributeError:  # older aiohttp: plain string keys
     PERF_PROVIDER = "dtpu_perf_provider"
 
+#: App key for the /debug/timeline provider. A frontend registers its
+#: TimelineCollector (llm/timeline.py) so the route serves the MERGED
+#: fleet timeline; a worker serves its own process journal.
+try:
+    TIMELINE_PROVIDER = web.AppKey("dtpu_timeline_provider", object)
+except AttributeError:  # older aiohttp: plain string keys
+    TIMELINE_PROVIDER = "dtpu_timeline_provider"
+
 
 def add_debug_routes(app: web.Application,
-                     kv_provider=None, perf_provider=None) -> None:
+                     kv_provider=None, perf_provider=None,
+                     timeline_provider=None) -> None:
     """Attach the observability debug routes (shared with the OpenAI
     frontend so in-process pipelines get them without a status server)."""
     app.router.add_get("/debug/traces", _debug_traces)
@@ -75,10 +84,36 @@ def add_debug_routes(app: web.Application,
     app.router.add_post("/debug/flight", _debug_flight_capture)
     app.router.add_get("/debug/kv", _debug_kv)
     app.router.add_get("/debug/perf", _debug_perf)
+    app.router.add_get("/debug/timeline", _debug_timeline)
     if kv_provider is not None:
         app[KV_PROVIDER] = kv_provider
     if perf_provider is not None:
         app[PERF_PROVIDER] = perf_provider
+    if timeline_provider is not None:
+        app[TIMELINE_PROVIDER] = timeline_provider
+
+
+async def _debug_timeline(request: web.Request) -> web.Response:
+    """The decision plane (docs/OBSERVABILITY.md "Decision plane"): on
+    a frontend, the causally ordered merged fleet timeline; on a worker
+    (or any process without a collector), this process's own journal."""
+    provider = request.app.get(TIMELINE_PROVIDER)
+    try:
+        limit = int(request.query.get("limit", "512"))
+    except ValueError:
+        return web.json_response({"error": "limit must be an integer"},
+                                 status=400)
+    if provider is None:
+        from dynamo_tpu.runtime import journal
+        body = {"role": "process", **journal.get_journal().snapshot(limit)}
+        return web.json_response(body)
+    try:
+        body = provider(limit)
+    except Exception as exc:  # noqa: BLE001 — a pane, not a crash vector
+        log.exception("timeline provider failed")
+        return web.json_response(
+            {"error": f"timeline provider failed: {exc}"}, status=500)
+    return web.json_response(body)
 
 
 async def _debug_perf(request: web.Request) -> web.Response:

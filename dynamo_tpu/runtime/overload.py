@@ -50,10 +50,18 @@ import random
 import time
 from typing import Callable, Iterable
 
+from dynamo_tpu.runtime import journal
 from dynamo_tpu.runtime.errors import OverloadedError, RateLimitedError
+from dynamo_tpu.runtime.journal import EventKind
 from dynamo_tpu.runtime.logging import get_logger
 
 log = get_logger("overload")
+
+#: Journal throttle for shed events: an overload storm sheds thousands
+#: of requests per second — the decision plane wants one event per
+#: (reason, priority) per interval with a suppressed count, not all of
+#: them (the shed_total counter keeps the exact tally).
+_SHED_JOURNAL_INTERVAL_S = 1.0
 
 PRIORITY_INTERACTIVE = "interactive"
 PRIORITY_BATCH = "batch"
@@ -199,6 +207,9 @@ class AdaptiveLimiter:
         # Local mirrors of the metrics (always available to tests).
         self.admitted_total = collections.Counter()   # priority -> n
         self.shed_counts = collections.Counter()      # (reason, priority)
+        # Journal state: shed-event throttle + last brownout level.
+        self._shed_journal: dict[tuple[str, str], list] = {}
+        self._journal_level = 0
         self._m_shed = self._m_admitted = None
         self._m_limit = self._m_queue = self._m_level = None
         if metrics is not None:
@@ -237,6 +248,13 @@ class AdaptiveLimiter:
                  2 if p < cfg.level3_pressure else 3)
         if self._m_level is not None:
             self._m_level.set(level)
+        if level != self._journal_level:
+            # Brownout edges are rare and load-bearing (they gate batch
+            # shedding and token clamping): every change is journaled.
+            journal.emit(EventKind.BROWNOUT_CHANGE,
+                         **{"from": self._journal_level, "to": level,
+                            "pressure": round(p, 3)})
+            self._journal_level = level
         return level
 
     def projected_wait_s(self, position: int) -> float:
@@ -346,6 +364,21 @@ class AdaptiveLimiter:
         self.shed_counts[(reason, priority)] += 1
         if self._m_shed is not None:
             self._m_shed.inc(reason=reason, priority=priority)
+        # Decision plane: one typed shed event per (reason, priority)
+        # per throttle interval, carrying how many siblings it speaks
+        # for. Cause: the brownout edge when one is active (priority
+        # sheds ARE the brownout acting), else root.
+        now = self._clock()
+        state = self._shed_journal.setdefault((reason, priority), [-1e18, 0])
+        if now - state[0] >= _SHED_JOURNAL_INTERVAL_S:
+            suppressed, state[0], state[1] = state[1], now, 0
+            cause = (journal.recent_ref(EventKind.BROWNOUT_CHANGE)
+                     if reason == "priority" else None)
+            journal.emit(EventKind.SHED, cause=cause, reason=reason,
+                         priority=priority, limit=int(self.limit),
+                         waiting=self.waiting(), suppressed=suppressed)
+        else:
+            state[1] += 1
         # The typed reason rides the exception so the accounting stream
         # (llm/recorder.py RequestLedger) records WHY, not just that a
         # 429/503 happened.
@@ -524,15 +557,28 @@ class BreakerBoard:
         self.breaker(worker_id).on_dispatch()
 
     def record_success(self, worker_id: int,
-                       latency_s: float | None = None) -> None:
+                       latency_s: float | None = None,
+                       cause: str | None = None) -> None:
+        """``cause``: the journal ref of whatever proved the worker
+        healthy (a canary_ok probe passes its own event) — plain
+        request-plane successes leave it None."""
         b = self.breaker(worker_id)
-        was_open = b.state != CLOSED
+        before = b.state
         b.record_success(latency_s)
-        if was_open and b.state == CLOSED:
+        if before != CLOSED and b.state == CLOSED:
             log.info("worker %x circuit closed (probe succeeded)", worker_id)
+            journal.emit(EventKind.BREAKER_TRANSITION, cause=cause,
+                         worker_id=f"{worker_id:x}",
+                         **{"from": before, "to": CLOSED})
             self._publish(worker_id)
 
-    def record_failure(self, worker_id: int) -> None:
+    def record_failure(self, worker_id: int,
+                       cause: str | None = None) -> None:
+        """``cause``: the journal ref of the failure's origin when the
+        caller knows it (a canary_fail probe passes its own event);
+        with chaos armed, an open without an explicit cause names the
+        most recent injection — the decision that opened the breaker is
+        attributable either way."""
         b = self.breaker(worker_id)
         before = b.state
         b.record_failure()
@@ -540,6 +586,12 @@ class BreakerBoard:
             log.warning("worker %x circuit OPEN after %d consecutive "
                         "failures; excluded for %.1fs", worker_id,
                         b.streak, self.cfg.breaker_cooldown_s)
+            if cause is None:
+                cause = journal.recent_ref(EventKind.CHAOS_INJECT)
+            journal.emit(EventKind.BREAKER_TRANSITION, cause=cause,
+                         worker_id=f"{worker_id:x}", streak=b.streak,
+                         cooldown_s=self.cfg.breaker_cooldown_s,
+                         **{"from": before, "to": OPEN})
             if self._m_opens is not None:
                 self._m_opens.inc(worker=f"{worker_id:x}")
             self._publish(worker_id)

@@ -44,6 +44,8 @@ import threading
 import time
 from typing import Callable
 
+from dynamo_tpu.runtime import journal
+from dynamo_tpu.runtime.journal import EventKind
 from dynamo_tpu.runtime.logging import get_logger
 
 log = get_logger("slo")
@@ -192,6 +194,9 @@ class SloPlane:
         self.alerts: dict[str, dict[str, bool]] = {
             name: {"fast": False, "slow": False} for name in self.targets}
         self.pages_total = 0  # fast-page rising edges (observability)
+        # (target, severity) -> journal ref of the firing event, so the
+        # clear names its own fire as the cause.
+        self._alert_refs: dict[tuple[str, str], str] = {}
         self._last_eval = -1e18
         self._callbacks: list[Callable[[str, str], None]] = []
         self._m_sli = self._m_burn = self._m_alert = None
@@ -289,6 +294,12 @@ class SloPlane:
                             state[sev] = False
                             log.info("SLO %s %s-burn alert cleared", name,
                                      sev)
+                            journal.emit(
+                                EventKind.SLO_ALERT_CLEAR,
+                                cause=self._alert_refs.pop((name, sev),
+                                                           None),
+                                objective=name, severity=sev,
+                                burn_short=round(b_short, 3))
                     elif (b_short > threshold and b_long > threshold
                           and n_short >= cfg.min_events):
                         state[sev] = True
@@ -298,6 +309,20 @@ class SloPlane:
                             "SLO %s %s-burn alert FIRING: burn %s=%.1f "
                             "%s=%.1f (threshold %.1f)", name, sev, short,
                             b_short, long_, b_long, threshold)
+                        # Cause: the most recent defensive action on
+                        # this process — the burn usually IS what the
+                        # sheds/breakers/preempts were reacting to.
+                        self._alert_refs[(name, sev)] = journal.emit(
+                            EventKind.SLO_ALERT_FIRE,
+                            cause=journal.recent_ref(
+                                EventKind.SHED,
+                                EventKind.BREAKER_TRANSITION,
+                                EventKind.PREEMPT,
+                                EventKind.BROWNOUT_CHANGE),
+                            objective=name, severity=sev,
+                            burn_short=round(b_short, 3),
+                            burn_long=round(b_long, 3),
+                            threshold=threshold, events=n_short)
                         for cb in list(self._callbacks):
                             try:
                                 cb(name, sev)

@@ -50,6 +50,15 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_perf_plane.py -q -m 'not slow' -k 'smoke or gate' \
     -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
 
+echo "== timeline smoke (decision plane: journal -> causal timeline) =="
+# Mocker fleet + a seeded chaos key: asserts /debug/timeline contains
+# the linked chain chaos_inject -> breaker_transition -> shed ->
+# slo_alert_fire (every link via explicit cause refs) and that the
+# canary ejects a wedged worker with zero user-visible errors.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_journal.py -q -m 'not slow' -k 'smoke or chain or canary' \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+
 echo "== chunked-prefill smoke (stall-free scheduling) =="
 # Tiny CPU model: one long prompt prefilling in chunks with concurrent
 # short decoders — asserts completion, decode windows interleaved between

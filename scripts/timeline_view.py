@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Terminal cause-tree viewer for the fleet decision plane.
+
+Fetches ``/debug/timeline`` from a running frontend (or per-worker
+status server), or reads a journal dump (JSONL — one event per line —
+or a JSON body with an ``events`` list, including flight-recorder
+bundles, which embed the journal slice), and renders the incident as an
+indented cause tree::
+
+    +0.000s  chaos_inject        [3f2a]   key=stream.disconnect site=client
+    +0.120s  `- breaker_transition [1b44]  worker_id=3f2a closed->open
+    +0.121s     `- shed            [1b44]  reason=breakers_open
+    +0.250s        `- slo_alert_fire [1b44] objective=goodput severity=fast
+
+Events whose ``cause`` references an event outside the window render as
+roots. Usage:
+
+    python scripts/timeline_view.py http://127.0.0.1:8000
+    python scripts/timeline_view.py journal.jsonl
+    python scripts/timeline_view.py /tmp/dtpu-flight/flight-*.json
+    python scripts/timeline_view.py http://host:8000 --kind canary_fail
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def _fetch_json(url: str) -> dict:
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read().decode())
+
+
+def load_events(source: str) -> list[dict]:
+    """Events from a /debug/timeline URL, a JSONL dump (journal sink),
+    or any JSON body carrying an ``events`` list (flight bundles embed
+    the journal under the "journal" key)."""
+    if source.startswith(("http://", "https://")):
+        data = _fetch_json(f"{source.rstrip('/')}/debug/timeline")
+    else:
+        with open(source) as fh:
+            text = fh.read()
+        try:
+            # One JSON document: a /debug/timeline dump, a flight
+            # bundle, a bare event list, or a single event.
+            data = json.loads(text)
+            if isinstance(data, list):
+                data = {"events": data}
+        except json.JSONDecodeError:
+            # JSONL, one event per line (torn tail lines from a live
+            # sink are skipped).
+            events = []
+            for line in text.splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+            data = {"events": events}
+    if "journal" in data and "events" not in data:
+        data = data["journal"]  # flight-recorder bundle
+    events = data.get("events")
+    if events is None and data.get("kind"):
+        events = [data]  # a single-event JSONL file
+    if not events:
+        raise SystemExit(f"{source}: no journal events found")
+    return events
+
+
+def build_tree(events: list[dict]) -> tuple[list[dict], dict[str, list]]:
+    """(roots, children-by-ref). An event is a root when its cause is
+    absent or references something outside this window; children keep
+    timestamp order."""
+    events = sorted(events, key=lambda e: e.get("ts") or 0.0)
+    by_ref = {e.get("ref"): e for e in events if e.get("ref")}
+    children: dict[str, list] = {}
+    roots: list[dict] = []
+    for e in events:
+        cause = e.get("cause")
+        if cause and cause in by_ref and by_ref[cause] is not e:
+            children.setdefault(cause, []).append(e)
+        else:
+            roots.append(e)
+    return roots, children
+
+
+def _attr_text(event: dict) -> str:
+    attrs = event.get("attrs") or {}
+    parts = []
+    for k, v in attrs.items():
+        if v in (None, "", 0, {}) and k != "to":
+            continue
+        parts.append(f"{k}={v}")
+    if event.get("trace_id"):
+        parts.append(f"trace={event['trace_id'][:8]}")
+    return " ".join(parts)
+
+
+def render_tree(events: list[dict]) -> str:
+    """Pure renderer (unit-testable): offset from the first event, the
+    kind, the emitting worker, attrs — indented one level per cause
+    hop."""
+    if not events:
+        return "(empty timeline)\n"
+    roots, children = build_tree(events)
+    t0 = min(e.get("ts") or 0.0 for e in events)
+    lines = [f"timeline: {len(events)} events over "
+             f"{(max(e.get('ts') or 0.0 for e in events) - t0):.3f}s"]
+
+    def walk(event: dict, depth: int) -> None:
+        offset = (event.get("ts") or 0.0) - t0
+        prefix = "   " * depth + ("`- " if depth else "")
+        lines.append(
+            f"{offset:>+9.3f}s  {prefix}{event.get('kind', '?'):<20} "
+            f"[{event.get('worker', '?')}]  {_attr_text(event)}".rstrip())
+        for child in children.get(event.get("ref"), ()):
+            walk(child, depth + 1)
+
+    for root in roots:
+        walk(root, 0)
+    return "\n".join(lines) + "\n"
+
+
+def chain_kinds(events: list[dict], leaf_ref: str) -> list[str]:
+    """The kinds along the cause chain ending at ``leaf_ref`` (root
+    first) — the programmatic form of the rendered indentation; tests
+    and the doctor use it to assert linkage."""
+    by_ref = {e.get("ref"): e for e in events if e.get("ref")}
+    chain: list[str] = []
+    seen: set[str] = set()
+    ref: str | None = leaf_ref
+    while ref and ref in by_ref and ref not in seen:
+        seen.add(ref)
+        event = by_ref[ref]
+        chain.append(event.get("kind", "?"))
+        ref = event.get("cause")
+    return chain[::-1]
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("source",
+                        help="base URL (http://host:port), a journal "
+                             "JSONL dump, a /debug/timeline dump, or a "
+                             "flight-recorder bundle")
+    parser.add_argument("--kind", default=None,
+                        help="only render trees containing this event "
+                             "kind (e.g. slo_alert_fire)")
+    parser.add_argument("--limit", type=int, default=0,
+                        help="only the newest N events")
+    args = parser.parse_args(argv)
+    events = load_events(args.source)
+    if args.limit:
+        events = sorted(events, key=lambda e: e.get("ts") or 0.0)[-args.limit:]
+    if args.kind:
+        roots, children = build_tree(events)
+
+        def tree_events(event):
+            yield event
+            for child in children.get(event.get("ref"), ()):
+                yield from tree_events(child)
+
+        keep: list[dict] = []
+        for root in roots:
+            tree = list(tree_events(root))
+            if any(e.get("kind") == args.kind for e in tree):
+                keep.extend(tree)
+        events = keep
+    sys.stdout.write(render_tree(events))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -12,12 +12,18 @@ Usage:
     python scripts/trace_view.py /tmp/prof/spans.chrome.json
     python scripts/trace_view.py http://127.0.0.1:8000 --flight
     python scripts/trace_view.py /tmp/dtpu-flight/flight-*.json --flight
+    python scripts/trace_view.py http://127.0.0.1:8000 --journal
+    python scripts/trace_view.py journal.jsonl --journal
 
 With no --trace-id, the newest recorded trace is shown. ``--flight``
 renders the engine flight recorder instead (live /debug/flight ring or
 a diagnostic bundle file): one line per engine window with occupancy /
 free-page / chunk-token / stall columns — "what was the engine doing"
 next to the span waterfall's "what was this request doing".
+``--journal`` renders the fleet decision plane (live /debug/timeline,
+a journal JSONL/ring dump, or the journal slice inside a flight
+bundle) as the same indented cause tree ``scripts/timeline_view.py``
+draws — "why did the fleet do that" next to the other two views.
 """
 
 from __future__ import annotations
@@ -166,6 +172,18 @@ def render_flight(windows: list[dict], meta: dict | None = None) -> str:
     return "\n".join(lines) + "\n"
 
 
+def _load_timeline_view():
+    """scripts/ is not a package: load the sibling cause-tree renderer
+    by path so --journal and timeline_view.py share one implementation."""
+    import importlib.util
+    import pathlib
+    path = pathlib.Path(__file__).resolve().parent / "timeline_view.py"
+    spec = importlib.util.spec_from_file_location("timeline_view", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("source",
@@ -176,7 +194,17 @@ def main(argv: list[str] | None = None) -> int:
                         help="render the engine flight recorder "
                              "(/debug/flight or a diagnostic bundle) "
                              "instead of a span waterfall")
+    parser.add_argument("--journal", action="store_true",
+                        help="render the fleet event journal "
+                             "(/debug/timeline, a journal JSONL dump, "
+                             "or a flight bundle's journal slice) as a "
+                             "cause tree instead of a span waterfall")
     args = parser.parse_args(argv)
+    if args.journal:
+        timeline_view = _load_timeline_view()
+        events = timeline_view.load_events(args.source)
+        sys.stdout.write(timeline_view.render_tree(events))
+        return 0
     if args.flight:
         windows, meta = load_flight(args.source)
         sys.stdout.write(render_flight(windows, meta))

@@ -605,7 +605,8 @@ def test_default_rules_catalog():
                    "lock-across-await", "swallowed-cancellation",
                    "unbounded-queue", "unbounded-wait",
                    "jit-recompile-hazard", "unregistered-jit",
-                   "wire-error-taxonomy", "direct-prometheus-import"}
+                   "wire-error-taxonomy", "direct-prometheus-import",
+                   "untyped-journal-event"}
 
 
 # -- direct-prometheus-import -------------------------------------------------
@@ -648,3 +649,57 @@ def test_unparseable_file_reports_parse_error(tmp_path):
     bad.write_text("def f(:\n")
     found = analyze_paths([str(bad)])
     assert len(found) == 1 and found[0].rule_id == "parse-error"
+
+
+# -- untyped-journal-event ----------------------------------------------------
+
+JOURNAL_BAD = """\
+from dynamo_tpu.runtime import journal
+from dynamo_tpu.runtime.journal import journal_subject
+
+async def breaker_opened(client, ns):
+    journal.emit("breaker_transition", worker_id="3f", to="open")
+    kind = "shed"
+    journal.emit(kind, reason="queue_full")
+    await client.publish(journal_subject(ns), {"kind": "shed"})
+"""
+
+JOURNAL_GOOD = """\
+from dynamo_tpu.runtime import journal
+from dynamo_tpu.runtime.journal import EventKind, JournalPublisher
+
+async def breaker_opened(client, ns, pub: JournalPublisher, delta):
+    journal.emit(EventKind.BREAKER_TRANSITION, worker_id="3f", to="open")
+    ref = journal.emit(EventKind.SHED, cause=None, reason="queue_full")
+    await pub.flush()
+    await client.publish("ns.x.other_subject", {"anything": 1})
+    return ref
+"""
+
+
+def test_untyped_journal_event_fires(tmp_path):
+    findings = run_rule(tmp_path, "untyped-journal-event", JOURNAL_BAD)
+    # String-literal kind, free-variable kind, and the ad-hoc dict
+    # publish onto the journal subject.
+    assert len(findings) == 3
+    assert any("closed taxonomy" in f.message for f in findings)
+    assert any("seq-fence" in f.message for f in findings)
+
+
+def test_untyped_journal_event_quiet_on_typed_use(tmp_path):
+    assert run_rule(tmp_path, "untyped-journal-event", JOURNAL_GOOD) == []
+
+
+def test_untyped_journal_event_allows_journal_module(tmp_path):
+    findings = run_rule(tmp_path, "untyped-journal-event", JOURNAL_BAD,
+                        name="runtime/journal.py")
+    assert findings == []
+
+
+def test_untyped_journal_event_suppression(tmp_path):
+    src = JOURNAL_BAD.replace(
+        'journal.emit("breaker_transition", worker_id="3f", to="open")',
+        'journal.emit("breaker_transition", worker_id="3f", to="open")'
+        '  # dtpu: ignore[untyped-journal-event] -- fixture')
+    findings = run_rule(tmp_path, "untyped-journal-event", src)
+    assert len(findings) == 2

@@ -640,6 +640,32 @@ def test_docs_drift_perf_series_are_documented():
     assert not missing, f"undocumented perf series: {sorted(missing)}"
 
 
+def test_docs_drift_journal_series_are_documented():
+    """PR 10 acceptance: every dynamo_tpu_journal_* series registered in
+    the source is documented in docs/OBSERVABILITY.md "Decision plane" —
+    whole-family scan like the kv_/perf_ guards."""
+    doc = (REPO / "docs" / "OBSERVABILITY.md").read_text()
+    documented = set(_DOC_NAME_RE.findall(doc))
+    registered = {n for n in _registered_metric_names()
+                  if n.startswith("journal_")}
+    assert len(registered) >= 2, \
+        f"expected the journal_ family, scan found {sorted(registered)}"
+    missing = registered - documented
+    assert not missing, f"undocumented journal series: {sorted(missing)}"
+
+
+def test_docs_drift_canary_series_are_documented():
+    """...and the canary prober's whole family likewise."""
+    doc = (REPO / "docs" / "OBSERVABILITY.md").read_text()
+    documented = set(_DOC_NAME_RE.findall(doc))
+    registered = {n for n in _registered_metric_names()
+                  if n.startswith("canary_")}
+    assert len(registered) >= 2, \
+        f"expected the canary_ family, scan found {sorted(registered)}"
+    missing = registered - documented
+    assert not missing, f"undocumented canary series: {sorted(missing)}"
+
+
 def test_docs_drift_kv_series_are_documented():
     """PR 8 acceptance: every dynamo_tpu_kv_* series registered in the
     source is documented in docs/OBSERVABILITY.md "KV & capacity" — the
