@@ -1,6 +1,13 @@
 """dtpu-lint: repo-native static analysis for async/JAX/wire hazards.
 
-Usage (CLI): ``python -m dynamo_tpu.analysis [paths] [--json]``
+v2 is interprocedural: a project-wide symbol table and call graph
+(``callgraph.py``) feed transitive facts — async-context, blocking-ness,
+hot-path reachability — to the rules, and findings carry the
+propagation chain (``engine._dispatch_window → runner.decode_window →
+np.asarray``).
+
+Usage (CLI): ``python -m dynamo_tpu.analysis [paths] [--format json]
+[--budget deploy/lint-budget.json] [--callgraph MODULE] [--stats]``
 Usage (API)::
 
     from dynamo_tpu.analysis import analyze_paths
@@ -11,21 +18,29 @@ Rule catalog and suppression syntax: docs/ANALYSIS.md.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Iterable
 
+from dynamo_tpu.analysis.callgraph import CallGraph, build_callgraph
 from dynamo_tpu.analysis.core import (
-    Finding, Module, ProjectRule, Rule, analyze, load_paths)
+    CallGraphRule, Finding, Module, ProjectRule, Rule, analyze,
+    count_suppressions, load_paths)
 from dynamo_tpu.analysis.rules_async import (
     BlockingCallInAsync, FireAndForgetTask, LockAcrossAwait,
     SwallowedCancellation, UnboundedQueue, UnboundedWait)
+from dynamo_tpu.analysis.rules_hotpath import HostSyncInHotPath
 from dynamo_tpu.analysis.rules_jax import JitRecompileHazard, UnregisteredJit
 from dynamo_tpu.analysis.rules_journal import UntypedJournalEvent
 from dynamo_tpu.analysis.rules_metrics import DirectPrometheusImport
+from dynamo_tpu.analysis.rules_purity import ImpureJitProgram
+from dynamo_tpu.analysis.rules_threads import EngineThreadSharedState
 from dynamo_tpu.analysis.rules_wire import WireErrorTaxonomy
 
 __all__ = [
-    "Finding", "Module", "Rule", "ProjectRule", "analyze", "load_paths",
-    "DEFAULT_RULES", "default_rules", "analyze_paths",
+    "Finding", "Module", "Rule", "ProjectRule", "CallGraphRule", "analyze",
+    "load_paths", "CallGraph", "build_callgraph", "count_suppressions",
+    "DEFAULT_RULES", "default_rules", "analyze_paths", "run_analysis",
+    "AnalysisRun",
 ]
 
 DEFAULT_RULES: tuple[type[Rule], ...] = (
@@ -37,6 +52,9 @@ DEFAULT_RULES: tuple[type[Rule], ...] = (
     UnboundedWait,
     JitRecompileHazard,
     UnregisteredJit,
+    HostSyncInHotPath,
+    ImpureJitProgram,
+    EngineThreadSharedState,
     DirectPrometheusImport,
     UntypedJournalEvent,
     WireErrorTaxonomy,
@@ -55,11 +73,38 @@ def default_rules(select: Iterable[str] | None = None) -> list[Rule]:
     return rules
 
 
-def analyze_paths(paths: Iterable[str],
-                  select: Iterable[str] | None = None) -> list[Finding]:
+@dataclasses.dataclass
+class AnalysisRun:
+    """One full pass: modules parsed once, the call graph built once,
+    every rule run over the shared structures."""
+
+    modules: list[Module]
+    failed: list[str]
+    rules: list[Rule]
+    graph: CallGraph | None
+    findings: list[Finding]
+
+    def suppression_counts(self) -> dict[str, int]:
+        return count_suppressions(self.modules,
+                                  [r.rule_id for r in default_rules()])
+
+
+def run_analysis(paths: Iterable[str],
+                 select: Iterable[str] | None = None) -> AnalysisRun:
+    """The single-pass engine behind both the CLI and ``analyze_paths``:
+    parse each module once, build the call graph at most once, and share
+    both across all selected rules."""
     modules, failed = load_paths(paths)
-    findings = analyze(modules, default_rules(select))
+    rules = default_rules(select)
+    graph = (build_callgraph(modules)
+             if any(isinstance(r, CallGraphRule) for r in rules) else None)
+    findings = analyze(modules, rules, graph=graph)
     findings.extend(
         Finding(path, 1, 0, "parse-error", "file could not be parsed")
         for path in failed)
-    return findings
+    return AnalysisRun(modules, failed, rules, graph, findings)
+
+
+def analyze_paths(paths: Iterable[str],
+                  select: Iterable[str] | None = None) -> list[Finding]:
+    return run_analysis(paths, select).findings

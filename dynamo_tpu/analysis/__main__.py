@@ -1,8 +1,24 @@
-"""CLI: ``python -m dynamo_tpu.analysis [paths] [--json] [--select ids]``.
+"""CLI: ``python -m dynamo_tpu.analysis [paths] [options]``.
 
-Exit codes: 0 clean, 1 findings (or unparseable files), 2 usage error.
-With no paths, analyzes the installed dynamo_tpu package — so the bare
-module invocation is the repo gate scripts/check.sh runs.
+Exit codes: 0 clean, 1 findings / unparseable files / budget violations,
+2 usage error. With no paths, analyzes the installed dynamo_tpu package —
+so the bare module invocation is the repo gate scripts/check.sh runs.
+
+Options beyond path selection:
+
+- ``--format json``: versioned, schema-pinned machine output (findings
+  sorted by (path, line, col, rule), suppression counts, graph stats) —
+  stable across runs so lint gates can diff them. ``--json`` stays as
+  the legacy bare-findings-array alias.
+- ``--budget FILE``: the suppression ratchet. FILE maps rule id ->
+  maximum allowed suppression directives; any rule over its
+  budget fails the run. Ratchet down by lowering the number in the
+  committed file when suppressions get fixed; never raise a number
+  without review (docs/ANALYSIS.md "Suppression ratchet").
+- ``--callgraph MODULE``: debug dump of one module's functions, facts
+  (async/hot/blocks) and resolved edges.
+- ``--stats``: one summary line (modules/functions/edges/rules) on
+  stderr — check.sh prints it so gate logs record graph size drift.
 """
 
 from __future__ import annotations
@@ -12,20 +28,87 @@ import json
 import sys
 from pathlib import Path
 
-from dynamo_tpu.analysis import analyze_paths, default_rules
+from dynamo_tpu.analysis import default_rules, run_analysis
+
+SCHEMA_VERSION = 1
+
+
+def _dump_callgraph(run, want: str) -> int:
+    """Sorted, deterministic dump of one module's slice of the graph."""
+    if run.graph is None:
+        print("error: call graph not built (narrow --select?)",
+              file=sys.stderr)
+        return 2
+    hits = [mi for mi in run.graph.modules
+            if mi.dotted == want or mi.dotted.endswith("." + want)
+            or mi.module.path == want]
+    if not hits:
+        print(f"error: no loaded module matches `{want}`", file=sys.stderr)
+        return 2
+    for mi in sorted(hits, key=lambda m: m.dotted):
+        fns = sorted((fn for fn in run.graph.functions.values()
+                      if fn.module is mi.module),
+                     key=lambda f: f.node.lineno)
+        print(f"{mi.module.path} ({mi.dotted}): {len(fns)} function(s)")
+        for fn in fns:
+            facts = [k for k, on in (("async", fn.is_async),
+                                     ("hotpath-anchor", fn.hot_anchor),
+                                     ("hot", fn.is_hot),
+                                     ("blocks", fn.blocks)) if on]
+            suffix = f"  [{', '.join(facts)}]" if facts else ""
+            print(f"  {fn.qname}:{fn.node.lineno}{suffix}")
+            for site in fn.calls:
+                if site.callee is not None:
+                    print(f"    -> {site.callee.qname}  ({site.raw}, "
+                          f"line {site.line})")
+    return 0
+
+
+def _check_budget(run, budget_path: str) -> list[str]:
+    try:
+        budget = json.loads(Path(budget_path).read_text(encoding="utf-8"))
+    except (OSError, ValueError) as exc:
+        return [f"budget file unreadable: {exc}"]
+    counts = run.suppression_counts()
+    errors = []
+    for rule_id in sorted(counts):
+        allowed = budget.get(rule_id, 0)
+        if counts[rule_id] > allowed:
+            errors.append(
+                f"suppression budget exceeded for [{rule_id}]: "
+                f"{counts[rule_id]} > {allowed} — fix the new finding "
+                f"instead of suppressing it, or (with review) raise "
+                f"{budget_path}")
+    return errors
 
 
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m dynamo_tpu.analysis",
-        description="dtpu-lint: async/JAX/wire hazard analyzer")
+        description="dtpu-lint: interprocedural async/JAX/wire hazard "
+                    "analyzer")
     parser.add_argument("paths", nargs="*",
                         help="files or directories (default: the "
                              "dynamo_tpu package)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text", dest="fmt",
+                        help="output format (json is versioned and "
+                             "schema-pinned for gate diffing)")
     parser.add_argument("--json", action="store_true", dest="as_json",
-                        help="emit findings as a JSON array")
+                        help="legacy alias: emit findings as a bare JSON "
+                             "array")
     parser.add_argument("--select", metavar="IDS",
                         help="comma-separated rule ids to run")
+    parser.add_argument("--budget", metavar="FILE",
+                        help="suppression-ratchet budget file "
+                             "(deploy/lint-budget.json); any rule over "
+                             "its count fails")
+    parser.add_argument("--callgraph", metavar="MODULE",
+                        help="dump one module's call-graph slice "
+                             "(dotted suffix or file path) and exit")
+    parser.add_argument("--stats", action="store_true",
+                        help="print modules/functions/edges/rules summary "
+                             "on stderr")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalog and exit")
     args = parser.parse_args(argv)
@@ -39,13 +122,36 @@ def main(argv: list[str] | None = None) -> int:
               if args.select else None)
     paths = args.paths or [str(Path(__file__).resolve().parent.parent)]
     try:
-        findings = analyze_paths(paths, select)
+        run = run_analysis(paths, select)
     except ValueError as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2
 
+    if args.callgraph:
+        return _dump_callgraph(run, args.callgraph)
+
+    budget_errors = _check_budget(run, args.budget) if args.budget else []
+    stats = run.graph.stats() if run.graph is not None else {}
+    stats["rules"] = len(run.rules)
+    stats["findings"] = len(run.findings)
+
+    if args.stats:
+        print("dtpu-lint: " + " ".join(f"{k}={v}"
+                                       for k, v in sorted(stats.items())),
+              file=sys.stderr)
+
+    findings = run.findings
     if args.as_json:
         print(json.dumps([f.to_json() for f in findings], indent=2))
+    elif args.fmt == "json":
+        doc = {
+            "version": SCHEMA_VERSION,
+            "findings": [f.to_json() for f in findings],
+            "suppressions": run.suppression_counts(),
+            "stats": dict(sorted(stats.items())),
+            "budget_errors": budget_errors,
+        }
+        print(json.dumps(doc, indent=2, sort_keys=True))
     else:
         for f in findings:
             print(f.render())
@@ -53,7 +159,9 @@ def main(argv: list[str] | None = None) -> int:
             print(f"\n{len(findings)} finding(s). Fix, or suppress with "
                   "`# dtpu: ignore[rule-id]  -- rationale` "
                   "(see docs/ANALYSIS.md).", file=sys.stderr)
-    return 1 if findings else 0
+        for err in budget_errors:
+            print(f"budget: {err}", file=sys.stderr)
+    return 1 if (findings or budget_errors) else 0
 
 
 if __name__ == "__main__":

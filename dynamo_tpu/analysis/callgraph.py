@@ -1,0 +1,522 @@
+"""Project-wide symbol table + call graph for interprocedural rules.
+
+The per-file rules in this package see one AST at a time; the bug
+classes that motivated dtpu-lint v2 live *between* frames: a sync helper
+that blocks, two calls below an ``async def``; a device→host readback
+three frames under the engine decode-window dispatch; a trace-time
+side effect inside a function handed to ``perf.instrumented_jit``. This
+module turns the loaded ``Module`` set into one graph so facts can flow
+along call edges:
+
+- **Symbol table**: per module, the top-level functions, classes
+  (methods, base names, ``self.attr`` types inferred from
+  ``self.x = ClassName(...)`` / ``self.x: ClassName``), nested function
+  definitions, and the import bindings (``import a.b``,
+  ``from a.b import f [as g]``, relative forms).
+- **Call edges**: inside each function's own scope, every call is
+  recorded as a :class:`CallSite`; the resolver connects ``name(...)``,
+  ``self.method(...)``, ``self.attr.method(...)``, ``module.func(...)``
+  and ``Class.method(...)`` shapes to project functions. Unresolvable
+  calls keep their raw dotted text — the leaf of a finding chain is
+  usually exactly such an external name (``np.asarray``).
+- **Fact propagation** (cycle-tolerant worklists, each fact set at most
+  once per function):
+
+  * *blocking-ness* flows **up** the graph: a sync function blocks when
+    its own scope makes a known blocking call or when it calls a sync
+    project function that blocks.
+  * *hot-path reachability* flows **down** from functions carrying a
+    ``# dtpu: hotpath`` anchor comment (on the ``def`` line, or on the
+    line directly above the def/first decorator).
+
+Findings built from the graph carry the propagation chain
+(``engine._dispatch_window → runner.decode_window → np.asarray``) via
+:meth:`CallGraph.hot_chain` / :meth:`CallGraph.blocking_chain`.
+
+Module-name resolution is suffix-based: a loaded file's dotted name is
+derived from its path, and ``from dynamo_tpu.engine import perf``
+matches any loaded module whose dotted path *ends with*
+``dynamo_tpu.engine.perf`` — so the graph works identically on the
+installed package (absolute paths) and on test fixture trees.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from dynamo_tpu.analysis.core import Module, iter_scope, qualified_name
+
+__all__ = [
+    "BLOCKING_CALLS", "CallGraph", "CallSite", "ClassInfo", "FunctionInfo",
+    "ModuleInfo", "build_callgraph",
+]
+
+_HOTPATH_RE = re.compile(r"#\s*dtpu:\s*hotpath\b")
+
+# Calls that park the calling thread. Exact dotted names; shared with
+# rules_async's per-file check and used here as the transitive
+# blocking-fact leaves.
+BLOCKING_CALLS = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "os.system": "use `asyncio.create_subprocess_shell` or run in a thread",
+    "subprocess.run": "use `asyncio.create_subprocess_exec` or `asyncio.to_thread`",
+    "subprocess.call": "use `asyncio.create_subprocess_exec`",
+    "subprocess.check_call": "use `asyncio.create_subprocess_exec`",
+    "subprocess.check_output": "use `asyncio.create_subprocess_exec`",
+    "socket.create_connection": "use `asyncio.open_connection`",
+    "socket.getaddrinfo": "use `loop.getaddrinfo`",
+    "socket.gethostbyname": "use `loop.getaddrinfo`",
+    "urllib.request.urlopen": "use an async HTTP client or `asyncio.to_thread`",
+    "requests.get": "use an async HTTP client or `asyncio.to_thread`",
+    "requests.post": "use an async HTTP client or `asyncio.to_thread`",
+    "requests.request": "use an async HTTP client or `asyncio.to_thread`",
+}
+
+
+class CallSite:
+    """One call expression inside a function's own scope."""
+
+    __slots__ = ("node", "raw", "callee")
+
+    def __init__(self, node: ast.Call, raw: str):
+        self.node = node
+        self.raw = raw                       # dotted text as written
+        self.callee: FunctionInfo | None = None
+
+    @property
+    def line(self) -> int:
+        return self.node.lineno
+
+
+class FunctionInfo:
+    """One function/method/nested def, plus its graph facts."""
+
+    __slots__ = (
+        "qname", "display", "module", "node", "cls", "parent", "calls",
+        "nested", "is_async", "is_method", "hot_anchor", "callers",
+        "blocking_site", "blocks_through", "is_hot", "hot_via",
+    )
+
+    def __init__(self, qname: str, display: str, module: Module,
+                 node: ast.FunctionDef | ast.AsyncFunctionDef,
+                 cls: "ClassInfo | None" = None,
+                 parent: "FunctionInfo | None" = None):
+        self.qname = qname
+        self.display = display
+        self.module = module
+        self.node = node
+        self.cls = cls
+        self.parent = parent
+        self.calls: list[CallSite] = []
+        self.nested: dict[str, FunctionInfo] = {}
+        self.is_async = isinstance(node, ast.AsyncFunctionDef)
+        self.is_method = cls is not None and parent is None
+        self.hot_anchor = False
+        self.callers: list[tuple[FunctionInfo, CallSite]] = []
+        # -- propagated facts (each set at most once; cycle-safe) ----------
+        self.blocking_site: CallSite | None = None   # direct blocking call
+        self.blocks_through: CallSite | None = None  # call to a blocking callee
+        self.is_hot = False
+        self.hot_via: tuple[FunctionInfo, CallSite] | None = None
+
+    @property
+    def blocks(self) -> bool:
+        return self.blocking_site is not None or self.blocks_through is not None
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"<fn {self.qname}>"
+
+
+class ClassInfo:
+    __slots__ = ("name", "module", "node", "bases", "methods", "attr_types")
+
+    def __init__(self, name: str, module: Module, node: ast.ClassDef):
+        self.name = name
+        self.module = module
+        self.node = node
+        self.bases: list[str] = [qualified_name(b) for b in node.bases]
+        self.methods: dict[str, FunctionInfo] = {}
+        self.attr_types: dict[str, ClassInfo] = {}
+
+
+class ModuleInfo:
+    __slots__ = ("module", "dotted", "functions", "classes", "bindings")
+
+    def __init__(self, module: Module, dotted: str):
+        self.module = module
+        self.dotted = dotted
+        self.functions: dict[str, FunctionInfo] = {}   # top-level defs
+        self.classes: dict[str, ClassInfo] = {}
+        # name -> ("module", dotted) | ("symbol", module_dotted, symbol)
+        self.bindings: dict[str, tuple] = {}
+
+
+def _path_to_dotted(path: str) -> str:
+    p = path.replace("\\", "/")
+    if p.endswith(".py"):
+        p = p[:-3]
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return ".".join(seg for seg in p.strip("/").split("/") if seg)
+
+
+def _has_hot_anchor(module: Module, node) -> bool:
+    first = min([d.lineno for d in node.decorator_list] + [node.lineno])
+    for ln in (node.lineno, first, first - 1):
+        if 1 <= ln <= len(module.lines) and _HOTPATH_RE.search(
+                module.lines[ln - 1]):
+            return True
+    return False
+
+
+class CallGraph:
+    """The built graph: modules, every function by qname, chain helpers."""
+
+    def __init__(self, modules: list[Module]):
+        self.modules: list[ModuleInfo] = []
+        self.functions: dict[str, FunctionInfo] = {}
+        self._by_dotted: dict[str, ModuleInfo] = {}
+        self._by_module: dict[int, ModuleInfo] = {}
+        self._suffix_cache: dict[str, ModuleInfo | None] = {}
+        for m in modules:
+            mi = ModuleInfo(m, _path_to_dotted(m.path))
+            self.modules.append(mi)
+            self._by_dotted[mi.dotted] = mi
+            self._by_module[id(m)] = mi
+        for mi in self.modules:
+            self._collect(mi)
+        for mi in self.modules:
+            self._collect_bindings(mi)
+        for mi in self.modules:
+            self._infer_attr_types(mi)
+        for fn in self.functions.values():
+            self._resolve_calls(fn)
+        self._propagate_blocking()
+        self._propagate_hot()
+
+    # -- symbol collection ----------------------------------------------------
+
+    def _collect(self, mi: ModuleInfo) -> None:
+        short = mi.dotted.rsplit(".", 1)[-1] or mi.dotted
+        for node in mi.module.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_function(mi, short, node, cls=None, parent=None)
+            elif isinstance(node, ast.ClassDef):
+                ci = ClassInfo(node.name, mi.module, node)
+                mi.classes[node.name] = ci
+                for stmt in node.body:
+                    if isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self._add_function(mi, short, stmt, cls=ci,
+                                           parent=None)
+
+    def _add_function(self, mi: ModuleInfo, short: str, node, *,
+                      cls: ClassInfo | None,
+                      parent: FunctionInfo | None) -> FunctionInfo:
+        if parent is not None:
+            qname = f"{parent.qname}.<locals>.{node.name}"
+        elif cls is not None:
+            qname = f"{mi.dotted}:{cls.name}.{node.name}"
+        else:
+            qname = f"{mi.dotted}:{node.name}"
+        display = f"{short}.{node.name}"
+        fn = FunctionInfo(qname, display, mi.module, node,
+                          cls=cls if parent is None else parent.cls,
+                          parent=parent)
+        fn.hot_anchor = _has_hot_anchor(mi.module, node)
+        self.functions[qname] = fn
+        if parent is not None:
+            parent.nested[node.name] = fn
+        elif cls is not None:
+            cls.methods[node.name] = fn
+        else:
+            mi.functions[node.name] = fn
+        # collect own-scope calls and recurse into nested defs
+        for sub in iter_scope(node.body):
+            if isinstance(sub, ast.Call):
+                raw = qualified_name(sub.func)
+                if not raw and isinstance(sub.func, ast.Attribute):
+                    raw = f"?.{sub.func.attr}"
+                fn.calls.append(CallSite(sub, raw))
+            elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_function(mi, short, sub, cls=cls, parent=fn)
+        return fn
+
+    def _collect_bindings(self, mi: ModuleInfo) -> None:
+        pkg = mi.dotted.rsplit(".", 1)[0] if "." in mi.dotted else ""
+        for node in ast.walk(mi.module.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        # `import a.b.c as x`: x names the leaf module
+                        mi.bindings[alias.asname] = ("module", alias.name)
+                    else:
+                        # `import a.b.c` binds `a`; later segments resolve
+                        # progressively from the bound root.
+                        root = alias.name.split(".")[0]
+                        mi.bindings[root] = ("module", root)
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    segs = mi.dotted.split(".")
+                    anchor = segs[: len(segs) - node.level] or segs[:1]
+                    base = ".".join(anchor + ([node.module]
+                                              if node.module else []))
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    bound = alias.asname or alias.name
+                    sub = f"{base}.{alias.name}" if base else alias.name
+                    if self.resolve_module(sub) is not None:
+                        mi.bindings[bound] = ("module", sub)
+                    else:
+                        mi.bindings[bound] = ("symbol", base, alias.name)
+
+    def _infer_attr_types(self, mi: ModuleInfo) -> None:
+        for ci in mi.classes.values():
+            for fn in ci.methods.values():
+                for node in iter_scope(fn.node.body):
+                    target = value = None
+                    if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                        target, value = node.targets[0], node.value
+                    elif isinstance(node, ast.AnnAssign):
+                        target = node.target
+                        ann = qualified_name(node.annotation) \
+                            if node.annotation is not None else ""
+                        hit = self._resolve_class(mi, ann)
+                        if hit is not None and _is_self_attr(target):
+                            ci.attr_types.setdefault(target.attr, hit)
+                        value = node.value
+                    if (target is None or value is None
+                            or not _is_self_attr(target)):
+                        continue
+                    if isinstance(value, ast.Call):
+                        hit = self._resolve_class(mi, qualified_name(value.func))
+                        if hit is not None:
+                            ci.attr_types.setdefault(target.attr, hit)
+
+    def _resolve_class(self, mi: ModuleInfo, dotted: str) -> ClassInfo | None:
+        if not dotted:
+            return None
+        parts = dotted.split(".")
+        if len(parts) == 1:
+            if parts[0] in mi.classes:
+                return mi.classes[parts[0]]
+            b = mi.bindings.get(parts[0])
+            if b is not None and b[0] == "symbol":
+                target = self.resolve_module(b[1])
+                if target is not None:
+                    return target.classes.get(b[2])
+            return None
+        b = mi.bindings.get(parts[0])
+        if b is not None and b[0] == "module":
+            target = self._resolve_dotted_module(b[1], parts[1:-1])
+            if target is not None:
+                return target.classes.get(parts[-1])
+        return None
+
+    # -- module resolution ----------------------------------------------------
+
+    def resolve_module(self, dotted: str) -> ModuleInfo | None:
+        """Exact dotted-name match, else unique-suffix match."""
+        if dotted in self._by_dotted:
+            return self._by_dotted[dotted]
+        if dotted in self._suffix_cache:
+            return self._suffix_cache[dotted]
+        tail = "." + dotted
+        hits = [mi for name, mi in self._by_dotted.items()
+                if name.endswith(tail)]
+        out = hits[0] if len(hits) == 1 else None
+        self._suffix_cache[dotted] = out
+        return out
+
+    def _resolve_dotted_module(self, root: str,
+                               middle: list[str]) -> ModuleInfo | None:
+        """Longest prefix of root.middle... that names a loaded module."""
+        for cut in range(len(middle), -1, -1):
+            mi = self.resolve_module(".".join([root] + middle[:cut]))
+            if mi is not None:
+                return mi
+        return None
+
+    # -- call resolution ------------------------------------------------------
+
+    def _resolve_calls(self, fn: FunctionInfo) -> None:
+        mi = self._by_module[id(fn.module)]
+        for site in fn.calls:
+            callee = self._resolve_call(mi, fn, site.raw)
+            if callee is not None:
+                site.callee = callee
+                callee.callers.append((fn, site))
+
+    def _resolve_call(self, mi: ModuleInfo, fn: FunctionInfo,
+                      raw: str) -> FunctionInfo | None:
+        if not raw or raw.startswith("?."):
+            return None
+        parts = raw.split(".")
+        head = parts[0]
+        if head in ("self", "cls") and fn.cls is not None:
+            if len(parts) == 2:
+                return self._method_lookup(mi, fn.cls, parts[1])
+            if len(parts) == 3:
+                attr_cls = fn.cls.attr_types.get(parts[1])
+                if attr_cls is not None:
+                    owner = self._by_module.get(id(attr_cls.module), mi)
+                    return self._method_lookup(owner, attr_cls, parts[2])
+            return None
+        if len(parts) == 1:
+            # nested def in this or an enclosing function, else module fn
+            scope: FunctionInfo | None = fn
+            while scope is not None:
+                if head in scope.nested:
+                    return scope.nested[head]
+                scope = scope.parent
+            hit = mi.functions.get(head)
+            if hit is not None:
+                return hit
+            if head in mi.classes:   # ClassName(...) -> __init__
+                return mi.classes[head].methods.get("__init__")
+            b = mi.bindings.get(head)
+            if b is not None and b[0] == "symbol":
+                target = self.resolve_module(b[1])
+                if target is not None:
+                    if b[2] in target.functions:
+                        return target.functions[b[2]]
+                    if b[2] in target.classes:
+                        return target.classes[b[2]].methods.get("__init__")
+            return None
+        # dotted: ClassName.method in this module, else via import binding
+        if head in mi.classes and len(parts) == 2:
+            return self._method_lookup(mi, mi.classes[head], parts[1])
+        b = mi.bindings.get(head)
+        if b is None:
+            return None
+        if b[0] == "symbol":
+            target = self.resolve_module(b[1])
+            if target is not None and b[2] in target.classes \
+                    and len(parts) == 2:
+                return self._method_lookup(target, target.classes[b[2]],
+                                           parts[1])
+            return None
+        target = self._resolve_dotted_module(b[1], parts[1:-1])
+        if target is None:
+            return None
+        leaf = parts[-1]
+        if leaf in target.functions:
+            return target.functions[leaf]
+        if leaf in target.classes:
+            return target.classes[leaf].methods.get("__init__")
+        if len(parts) >= 3 and parts[-2] in target.classes:
+            return self._method_lookup(target, target.classes[parts[-2]], leaf)
+        return None
+
+    def _method_lookup(self, mi: ModuleInfo, cls: ClassInfo,
+                       name: str) -> FunctionInfo | None:
+        seen: set[int] = set()
+        stack = [(mi, cls)]
+        while stack:
+            owner_mi, ci = stack.pop()
+            if id(ci) in seen:
+                continue
+            seen.add(id(ci))
+            if name in ci.methods:
+                return ci.methods[name]
+            for base in ci.bases:
+                base_ci = self._resolve_class(owner_mi, base)
+                if base_ci is not None:
+                    base_mi = self._by_module.get(id(base_ci.module),
+                                                  owner_mi)
+                    stack.append((base_mi, base_ci))
+        return None
+
+    # -- fact propagation -----------------------------------------------------
+
+    def _propagate_blocking(self) -> None:
+        worklist: list[FunctionInfo] = []
+        for fn in self.functions.values():
+            for site in fn.calls:
+                if site.raw in BLOCKING_CALLS or site.raw == "open" or (
+                        isinstance(site.node.func, ast.Attribute)
+                        and site.node.func.attr == "block_until_ready"):
+                    if fn.module.is_suppressed(site.line,
+                                               "blocking-call-in-async"):
+                        # A suppression ON the blocking line of a sync
+                        # helper declares the helper allowed-to-block
+                        # (startup/cold I/O): it stops propagation, so
+                        # one source-side rationale covers every caller.
+                        continue
+                    fn.blocking_site = site
+                    break
+            if fn.blocking_site is not None and not fn.is_async:
+                worklist.append(fn)
+        while worklist:
+            fn = worklist.pop()
+            for caller, site in fn.callers:
+                if caller.is_async or caller.blocks:
+                    continue
+                caller.blocks_through = site
+                worklist.append(caller)
+
+    def _propagate_hot(self) -> None:
+        worklist = [fn for fn in self.functions.values() if fn.hot_anchor]
+        for fn in worklist:
+            fn.is_hot = True
+        while worklist:
+            fn = worklist.pop()
+            for site in fn.calls:
+                c = site.callee
+                if c is not None and not c.is_hot:
+                    c.is_hot = True
+                    c.hot_via = (fn, site)
+                    worklist.append(c)
+
+    # -- chain helpers --------------------------------------------------------
+
+    def hot_chain(self, fn: FunctionInfo) -> list[str]:
+        """Display names from the hot-path anchor down to ``fn``."""
+        parts = [fn.display]
+        cur, seen = fn, {fn.qname}
+        while cur.hot_via is not None:
+            cur = cur.hot_via[0]
+            if cur.qname in seen:
+                break
+            seen.add(cur.qname)
+            parts.append(cur.display)
+        return list(reversed(parts))
+
+    def blocking_chain(self, fn: FunctionInfo) -> list[str]:
+        """Display names from ``fn`` down to the concrete blocking leaf."""
+        parts = [fn.display]
+        cur, seen = fn, {fn.qname}
+        while cur.blocks_through is not None:
+            nxt = cur.blocks_through.callee
+            if nxt is None or nxt.qname in seen:
+                break
+            seen.add(nxt.qname)
+            parts.append(nxt.display)
+            cur = nxt
+        if cur.blocking_site is not None:
+            parts.append(cur.blocking_site.raw)
+        return parts
+
+    # -- stats (CLI / check.sh) -----------------------------------------------
+
+    def stats(self) -> dict:
+        edges = sum(1 for fn in self.functions.values()
+                    for s in fn.calls if s.callee is not None)
+        return {"modules": len(self.modules),
+                "functions": len(self.functions),
+                "edges": edges,
+                "hot": sum(f.is_hot for f in self.functions.values()),
+                "blocking": sum(f.blocks for f in self.functions.values())}
+
+
+def _is_self_attr(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self")
+
+
+def build_callgraph(modules: list[Module]) -> CallGraph:
+    return CallGraph(modules)

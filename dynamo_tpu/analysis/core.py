@@ -30,8 +30,9 @@ from pathlib import Path
 from typing import Iterable, Iterator
 
 __all__ = [
-    "Finding", "Module", "Rule", "ProjectRule", "analyze",
+    "Finding", "Module", "Rule", "ProjectRule", "CallGraphRule", "analyze",
     "load_module", "load_paths", "qualified_name", "iter_scope",
+    "count_suppressions",
 ]
 
 _SUPPRESS_RE = re.compile(r"#\s*dtpu:\s*ignore(?:\[([A-Za-z0-9_,\- ]*)\])?")
@@ -39,7 +40,12 @@ _SUPPRESS_RE = re.compile(r"#\s*dtpu:\s*ignore(?:\[([A-Za-z0-9_,\- ]*)\])?")
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
-    """One rule violation, pointing at a file:line with a fix hint."""
+    """One rule violation, pointing at a file:line with a fix hint.
+
+    Interprocedural rules attach the propagation ``chain`` — display
+    names from the entry point down to the concrete leaf, e.g.
+    ``("engine._dispatch_window", "runner.decode_window", "np.asarray")``.
+    """
 
     path: str
     line: int
@@ -47,13 +53,18 @@ class Finding:
     rule_id: str
     message: str
     hint: str = ""
+    chain: tuple = ()
 
     def to_json(self) -> dict:
-        return dataclasses.asdict(self)
+        out = dataclasses.asdict(self)
+        out["chain"] = list(self.chain)
+        return out
 
     def render(self) -> str:
         loc = f"{self.path}:{self.line}:{self.col}"
         out = f"{loc}: [{self.rule_id}] {self.message}"
+        if self.chain:
+            out += f"\n    chain: {' → '.join(self.chain)}"
         if self.hint:
             out += f"\n    hint: {self.hint}"
         return out
@@ -64,6 +75,7 @@ class Module:
 
     def __init__(self, path: str, source: str, tree: ast.Module):
         self.path = path
+        self.norm_path = path.replace("\\", "/")  # for suffix checks
         self.source = source
         self.lines = source.splitlines()
         self.tree = tree
@@ -90,7 +102,7 @@ class Module:
 
     def is_suppressed(self, line: int, rule_id: str) -> bool:
         """True when the flagged line — or a standalone comment directly
-        above it — carries a matching ``# dtpu: ignore`` directive."""
+        above it — carries a matching suppression directive."""
         for ln in (line, line - 1):
             ids = self.suppressions.get(ln, "missing")
             if ids == "missing":
@@ -144,6 +156,18 @@ class ProjectRule(Rule):
     """Cross-module rule: sees the whole module set at once."""
 
     def check_project(self, modules: list[Module]) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def check(self, module: Module) -> Iterable[Finding]:  # pragma: no cover
+        return ()
+
+
+class CallGraphRule(Rule):
+    """Interprocedural rule: sees the shared project call graph (built
+    once per :func:`analyze` run, whatever the rule count). ``graph`` is
+    a :class:`dynamo_tpu.analysis.callgraph.CallGraph`."""
+
+    def check_graph(self, graph) -> Iterable[Finding]:
         raise NotImplementedError
 
     def check(self, module: Module) -> Iterable[Finding]:  # pragma: no cover
@@ -205,11 +229,23 @@ def load_paths(paths: Iterable[str | Path]) -> tuple[list[Module], list[str]]:
     return modules, failed
 
 
-def analyze(modules: list[Module], rules: list[Rule]) -> list[Finding]:
+def analyze(modules: list[Module], rules: list[Rule],
+            graph=None) -> list[Finding]:
+    """Run every rule over the parsed module set.
+
+    Modules are parsed once (by :func:`load_paths`) and the project call
+    graph is built at most once per run, shared by every
+    :class:`CallGraphRule` — pass a prebuilt ``graph`` to reuse it
+    across runs (the CLI does, for ``--callgraph``/``--stats``)."""
     findings: list[Finding] = []
     by_path = {m.path: m for m in modules}
+    if graph is None and any(isinstance(r, CallGraphRule) for r in rules):
+        from dynamo_tpu.analysis.callgraph import build_callgraph
+        graph = build_callgraph(modules)
     for rule in rules:
-        if isinstance(rule, ProjectRule):
+        if isinstance(rule, CallGraphRule):
+            raw = rule.check_graph(graph)
+        elif isinstance(rule, ProjectRule):
             raw = rule.check_project(modules)
         else:
             raw = (f for m in modules for f in rule.check(m))
@@ -220,3 +256,20 @@ def analyze(modules: list[Module], rules: list[Rule]) -> list[Finding]:
             findings.append(f)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule_id))
     return findings
+
+
+def count_suppressions(modules: list[Module],
+                       rule_ids: Iterable[str]) -> dict[str, int]:
+    """Active suppression-directive counts per rule id across the module
+    set (the ratchet input). Bracketless ``ignore``-everything directives
+    count under ``"*"``; ids that name no known rule are ignored."""
+    known = set(rule_ids)
+    counts: dict[str, int] = {}
+    for m in modules:
+        for ids in m.suppressions.values():
+            if ids is None:
+                counts["*"] = counts.get("*", 0) + 1
+                continue
+            for rid in ids & known:
+                counts[rid] = counts.get(rid, 0) + 1
+    return dict(sorted(counts.items()))

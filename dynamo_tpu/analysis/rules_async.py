@@ -11,27 +11,9 @@ from __future__ import annotations
 import ast
 from typing import Iterable
 
+from dynamo_tpu.analysis.callgraph import BLOCKING_CALLS as _BLOCKING_CALLS
 from dynamo_tpu.analysis.core import (
-    Finding, Module, Rule, iter_scope, qualified_name)
-
-# Calls that park the event loop. Exact dotted names (module-level
-# functions); method names are handled separately because receivers
-# need type inference we approximate with assignment tracking.
-_BLOCKING_CALLS = {
-    "time.sleep": "use `await asyncio.sleep(...)`",
-    "os.system": "use `asyncio.create_subprocess_shell` or run in a thread",
-    "subprocess.run": "use `asyncio.create_subprocess_exec` or `asyncio.to_thread`",
-    "subprocess.call": "use `asyncio.create_subprocess_exec`",
-    "subprocess.check_call": "use `asyncio.create_subprocess_exec`",
-    "subprocess.check_output": "use `asyncio.create_subprocess_exec`",
-    "socket.create_connection": "use `asyncio.open_connection`",
-    "socket.getaddrinfo": "use `loop.getaddrinfo`",
-    "socket.gethostbyname": "use `loop.getaddrinfo`",
-    "urllib.request.urlopen": "use an async HTTP client or `asyncio.to_thread`",
-    "requests.get": "use an async HTTP client or `asyncio.to_thread`",
-    "requests.post": "use an async HTTP client or `asyncio.to_thread`",
-    "requests.request": "use an async HTTP client or `asyncio.to_thread`",
-}
+    CallGraphRule, Finding, Module, Rule, iter_scope, qualified_name)
 
 _QUEUE_CTORS = {"queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
                 "queue.SimpleQueue", "Queue", "LifoQueue", "PriorityQueue",
@@ -49,12 +31,37 @@ def _is_false(node: ast.expr | None) -> bool:
     return isinstance(node, ast.Constant) and node.value is False
 
 
-class BlockingCallInAsync(Rule):
+class BlockingCallInAsync(CallGraphRule):
     rule_id = "blocking-call-in-async"
     description = ("Synchronous blocking call (sleep, subprocess, socket, "
                    "file or thread-queue I/O, Future.result, "
                    "block_until_ready) inside `async def` parks the event "
-                   "loop for every request on it")
+                   "loop — directly, or transitively through a sync helper "
+                   "that blocks frames below the call site")
+
+    def check_graph(self, graph) -> Iterable[Finding]:
+        for mi in graph.modules:
+            yield from self.check(mi.module)
+        # Interprocedural part: an async def calling a *sync* project
+        # function that (transitively) blocks parks the loop exactly the
+        # same — flagged at the call site, with the propagation chain.
+        for fn in graph.functions.values():
+            if not fn.is_async:
+                continue
+            for site in fn.calls:
+                c = site.callee
+                if c is None or c.is_async or not c.blocks:
+                    continue
+                chain = [fn.display] + graph.blocking_chain(c)
+                yield Finding(
+                    fn.module.path, site.node.lineno, site.node.col_offset,
+                    self.rule_id,
+                    f"`{site.raw}(...)` called from async `{fn.node.name}` "
+                    f"blocks the event loop {len(chain) - 2} frame(s) down "
+                    f"(leaf: `{chain[-1]}`)",
+                    "await an async variant, or move the blocking helper "
+                    "behind `asyncio.to_thread`/`run_in_executor`",
+                    chain=tuple(chain))
 
     def check(self, module: Module) -> Iterable[Finding]:
         thread_queues = self._thread_queues(module)
@@ -305,8 +312,7 @@ class UnboundedQueue(Rule):
                    "or suppress with the rationale that bounds it naturally")
 
     def check(self, module: Module) -> Iterable[Finding]:
-        path = module.path.replace("\\", "/")
-        parts = path.split("/")
+        parts = module.norm_path.split("/")
         # Test code is exempt: tests build throwaway queues where the
         # producer is the test itself.
         if "tests" in parts[:-1] or parts[-1].startswith("test_"):

@@ -1,0 +1,95 @@
+"""host-sync-in-hot-path: zero device→host readbacks, statically.
+
+PR 5 proved the chunked-prefill path does no synchronous device→host
+fetches with a *runtime counter* (``runner.sync_prefill_fetches``); this
+rule turns the invariant into a static guarantee. Functions carrying a
+``# dtpu: hotpath`` anchor comment (the engine decode-window dispatch,
+``runner.prefill_chunk_async``) are the declared hot-path entry points;
+every function reachable from one along call-graph edges is hot, and any
+device→host synchronization in a hot function is a finding — carrying
+the full propagation chain
+(``engine._dispatch_window → runner.decode_window → np.asarray``).
+
+Sync leaves (conservative, repo-idiom aware):
+
+- ``np.asarray(x)`` with a SINGLE argument — the repo's device-fetch
+  idiom. ``np.asarray(x, dtype)`` is treated as host-side array
+  construction (the repo packs Python lists that way) and NOT flagged.
+- ``jax.device_get(...)`` / ``jax.block_until_ready(...)`` always.
+- ``.block_until_ready()`` / argless ``.item()`` method calls.
+- ``float(...)``/``int(...)``/``bool(...)`` whose argument is rooted at
+  ``jnp``/``jax`` (a coercion forces the device value to host).
+
+A legitimate cold readback reachable from a hot entry (e.g. the
+``fetch=True`` branch of ``prefill_batch``) gets a line-level
+suppression directive naming this rule, with its why.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from dynamo_tpu.analysis.core import CallGraphRule, Finding, qualified_name
+
+_NP_ASARRAY = {"np.asarray", "numpy.asarray"}
+_SYNC_FUNCS = {"jax.device_get", "jax.block_until_ready"}
+_SYNC_METHODS = {"block_until_ready", "item"}
+_COERCIONS = {"float", "int", "bool"}
+_DEVICE_ROOTS = {"jnp", "jax"}
+
+
+def _device_rooted(expr: ast.expr) -> bool:
+    """The expression's leftmost name chain starts at jnp/jax."""
+    node = expr
+    while isinstance(node, (ast.Call, ast.Subscript, ast.Attribute)):
+        node = (node.func if isinstance(node, ast.Call)
+                else node.value)
+    return isinstance(node, ast.Name) and node.id in _DEVICE_ROOTS
+
+
+def _sync_label(site) -> str | None:
+    """Return a leaf label when this call synchronizes device→host."""
+    node, raw = site.node, site.raw
+    if raw in _SYNC_FUNCS:
+        return raw
+    if raw in _NP_ASARRAY and len(node.args) == 1 and not node.keywords:
+        return raw
+    if raw in _COERCIONS and len(node.args) == 1 \
+            and _device_rooted(node.args[0]):
+        return f"{raw}(<device value>)"
+    func = node.func
+    if isinstance(func, ast.Attribute) and func.attr in _SYNC_METHODS \
+            and not node.args and not node.keywords:
+        recv = qualified_name(func.value)
+        return f"{recv}.{func.attr}()" if recv else f".{func.attr}()"
+    return None
+
+
+class HostSyncInHotPath(CallGraphRule):
+    rule_id = "host-sync-in-hot-path"
+    description = ("device→host transfer (bare np.asarray, jax.device_get, "
+                   ".block_until_ready(), .item(), float/int/bool on device "
+                   "values) reachable from a `# dtpu: hotpath` entry point: "
+                   "a sync readback frames below the decode-window dispatch "
+                   "stalls the engine pipeline exactly like one inside it")
+
+    def check_graph(self, graph) -> Iterable[Finding]:
+        for fn in graph.functions.values():
+            if not fn.is_hot:
+                continue
+            chain_base = graph.hot_chain(fn)
+            for site in fn.calls:
+                label = _sync_label(site)
+                if label is None:
+                    continue
+                chain = (*chain_base, label)
+                yield Finding(
+                    fn.module.path, site.node.lineno, site.node.col_offset,
+                    self.rule_id,
+                    f"device→host sync `{label}` on the hot path "
+                    f"(entry `{chain[0]}`)",
+                    "defer the fetch off the dispatch path "
+                    "(copy_to_host_async + later resolve), or suppress "
+                    "with the invariant that makes this a cold/host-side "
+                    "call", chain=chain)

@@ -181,8 +181,7 @@ class UnregisteredJit(Rule):
     _ALLOWED_SUFFIX = "engine/perf.py"
 
     def check(self, module: Module) -> Iterable[Finding]:
-        path = module.path.replace("\\", "/")
-        if path.endswith(self._ALLOWED_SUFFIX):
+        if module.norm_path.endswith(self._ALLOWED_SUFFIX):
             return
         for node in ast.walk(module.tree):
             if isinstance(node, ast.Call) and _is_jit_ctor(node):

@@ -44,8 +44,7 @@ class UntypedJournalEvent(Rule):
                    "on the closed taxonomy")
 
     def check(self, module: Module) -> Iterable[Finding]:
-        path = module.path.replace("\\", "/")
-        if path.endswith(_ALLOWED_SUFFIX):
+        if module.norm_path.endswith(_ALLOWED_SUFFIX):
             return
         for node in ast.walk(module.tree):
             if not isinstance(node, ast.Call):

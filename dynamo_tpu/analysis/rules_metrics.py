@@ -32,8 +32,7 @@ class DirectPrometheusImport(Rule):
                    "actually appears in /metrics exposition")
 
     def check(self, module: Module) -> Iterable[Finding]:
-        path = module.path.replace("\\", "/")
-        if path.endswith(_ALLOWED_SUFFIX):
+        if module.norm_path.endswith(_ALLOWED_SUFFIX):
             return
         for node in ast.walk(module.tree):
             if isinstance(node, ast.Import):

@@ -1,0 +1,157 @@
+"""impure-jit-program: functions handed to perf.instrumented_jit must
+be trace-pure.
+
+``jax.jit`` runs the Python body ONCE per compile and replays the traced
+graph after — any side effect in the program function (or anything it
+calls, or any nested def it traces inline) executes at trace time only:
+
+- ``time.*`` / ``random.*`` reads bake a single stale value into the
+  compiled program — the PR 9 compile-observatory double-compile bugs
+  were exactly trace-time state leaking into program identity;
+- logging / metrics / ``print`` fire once per compile, silently skewing
+  the observatory's counters and confusing "why did this log line stop";
+- mutating ``self`` or closure state (``global``/``nonlocal``) from
+  inside a traced body runs once, not per call — a correctness trap.
+
+The rule resolves the function argument of every
+``perf.instrumented_jit(program, fn, ...)`` call site through the call
+graph (nested defs included — the repo's jitted programs are almost all
+``def step(...)`` closures) and walks it plus its transitive project
+callees and nested defs. Findings land at the ``instrumented_jit`` call
+site with the chain to the impure leaf.
+
+``jax.random``/``jnp`` are of course fine; only host-side ``random.*``
+is impure. Metric mutation is matched on metric-shaped receivers
+(``m_*``, ``*metric*``, ``*counter*``, ``*gauge*``, ``*hist*``) so
+in-graph ``.at[...].set(...)`` updates never false-positive.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from dynamo_tpu.analysis.core import CallGraphRule, Finding, iter_scope
+
+_IMPURE_PREFIXES = ("time.", "random.", "logging.")
+_LOGGER_ROOTS = {"log", "logger", "logging"}
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+                "critical"}
+_METRIC_METHODS = {"inc", "observe", "set", "labels"}
+_METRIC_HINTS = ("metric", "counter", "gauge", "hist")
+
+
+def _metric_receiver(recv: str) -> bool:
+    leaf = recv.rsplit(".", 1)[-1].lower()
+    return leaf.startswith("m_") or any(h in leaf for h in _METRIC_HINTS)
+
+
+def _impure_call_label(site) -> str | None:
+    raw = site.raw
+    if any(raw.startswith(p) for p in _IMPURE_PREFIXES):
+        return raw
+    if raw == "print":
+        return "print"
+    parts = raw.split(".")
+    if len(parts) >= 2:
+        root, leaf = parts[0], parts[-1]
+        if root in _LOGGER_ROOTS and leaf in _LOG_METHODS:
+            return raw
+        if leaf in _LOG_METHODS and parts[-2] in _LOGGER_ROOTS:
+            return raw
+        if leaf in _METRIC_METHODS and _metric_receiver(
+                ".".join(parts[:-1])):
+            return raw
+    return None
+
+
+def _impure_stmt_label(fn) -> tuple[ast.AST, str] | None:
+    """self-/closure-state mutation inside the function's own scope."""
+    for node in iter_scope(fn.node.body):
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            kind = "global" if isinstance(node, ast.Global) else "nonlocal"
+            return node, f"{kind} {', '.join(node.names)}"
+        targets = ()
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = (node.target,)
+        for t in targets:
+            if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                return node, f"self.{t.attr} = ..."
+    return None
+
+
+class ImpureJitProgram(CallGraphRule):
+    rule_id = "impure-jit-program"
+    description = ("function passed to perf.instrumented_jit (transitively) "
+                   "calls time/random/logging/metrics or mutates "
+                   "self/closure state: trace-time side effects run once "
+                   "per COMPILE, baking stale values into the program and "
+                   "skewing the compile observatory")
+
+    def check_graph(self, graph) -> Iterable[Finding]:
+        for caller in graph.functions.values():
+            for site in caller.calls:
+                if not site.raw.endswith("instrumented_jit") \
+                        or len(site.node.args) < 2:
+                    continue
+                arg = site.node.args[1]
+                if not isinstance(arg, ast.Name):
+                    continue
+                target = self._resolve_local(graph, caller, arg.id)
+                if target is None:
+                    continue
+                hit = self._find_impurity(graph, target)
+                if hit is None:
+                    continue
+                leaf_label, chain = hit
+                yield Finding(
+                    caller.module.path, site.node.lineno,
+                    site.node.col_offset, self.rule_id,
+                    f"program `{arg.id}` passed to instrumented_jit is "
+                    f"impure: `{leaf_label}` runs once per compile, not "
+                    "per call",
+                    "hoist the side effect out of the traced body (record "
+                    "around the dispatch, not inside the program), or "
+                    "suppress with why trace-time execution is intended",
+                    chain=chain)
+
+    @staticmethod
+    def _resolve_local(graph, caller, name: str):
+        """The program argument: a nested def in the calling function (the
+        repo idiom), an enclosing function's nested def, or a module-level
+        function of the same module."""
+        scope = caller
+        while scope is not None:
+            if name in scope.nested:
+                return scope.nested[name]
+            scope = scope.parent
+        for mi in graph.modules:
+            if mi.module is caller.module:
+                return mi.functions.get(name)
+        return None
+
+    @staticmethod
+    def _find_impurity(graph, target):
+        """BFS over target + nested defs + resolved project callees;
+        returns (leaf_label, chain) for the first impurity found."""
+        queue = [(target, (target.display,))]
+        seen = {target.qname}
+        while queue:
+            fn, path = queue.pop(0)
+            stmt_hit = _impure_stmt_label(fn)
+            if stmt_hit is not None:
+                return stmt_hit[1], (*path, stmt_hit[1])
+            for site in fn.calls:
+                label = _impure_call_label(site)
+                if label is not None:
+                    return label, (*path, label)
+            for nxt in (*fn.nested.values(),
+                        *(s.callee for s in fn.calls
+                          if s.callee is not None)):
+                if nxt.qname not in seen:
+                    seen.add(nxt.qname)
+                    queue.append((nxt, (*path, nxt.display)))
+        return None

@@ -1,0 +1,180 @@
+"""engine-thread-shared-state: the poor-man's race detector for the
+engine-thread / asyncio boundary.
+
+The engine runs device work on a dedicated ``threading.Thread`` while
+request handlers, the event plane, and status endpoints run on the
+asyncio loop — two real OS threads sharing ``self``. An attribute
+written from BOTH sides with no lock in scope is a data race: torn
+read-modify-writes on counters, half-published dicts, state machines
+skipping states. (CPython's GIL makes single stores atomic but nothing
+composes — ``self.x += 1`` from two threads still loses updates.)
+
+Scope is deliberately narrow to stay honest:
+
+- only classes that actually *construct* a ``threading.Thread`` whose
+  ``target=self.<method>``;
+- engine side = the thread target(s) plus every same-class method
+  transitively reachable from them via ``self.`` call edges;
+- async side = the class's ``async def`` methods (nested async defs
+  included) plus same-class methods reachable from them;
+- writes in ``__init__``-family methods and in the thread-creating
+  method itself are happens-before the thread start and exempt;
+- a write inside a ``with <lock>``/``async with <lock>`` block counts
+  as guarded (name-based lock-ness, same heuristic as
+  lock-across-await).
+
+A finding names the attribute and one write site from each side. Fix:
+guard both sides with one lock, or funnel the write through a
+single-owner side (e.g. the engine thread publishes, async only reads).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from dynamo_tpu.analysis.core import CallGraphRule, Finding, iter_scope, \
+    qualified_name
+
+_INIT_METHODS = {"__init__", "__post_init__", "__new__"}
+_LOCKISH = ("lock", "mutex", "sem")
+
+
+def _looks_like_lock(expr: ast.expr) -> bool:
+    target = expr.func if isinstance(expr, ast.Call) else expr
+    leaf = qualified_name(target).rsplit(".", 1)[-1].lower()
+    return any(k in leaf for k in _LOCKISH)
+
+
+def _under_lock(module, node: ast.AST, fn_node: ast.AST) -> bool:
+    n = module.parent(node)
+    while n is not None and n is not fn_node:
+        if isinstance(n, (ast.With, ast.AsyncWith)) and any(
+                _looks_like_lock(item.context_expr) for item in n.items):
+            return True
+        n = module.parent(n)
+    return False
+
+
+def _thread_targets(cls) -> list[str]:
+    """Method names used as `threading.Thread(target=self.X)` in any
+    method of the class (the creating method is recorded alongside)."""
+    out = []
+    for name, fn in cls.methods.items():
+        for site in fn.calls:
+            if site.raw.rsplit(".", 1)[-1] != "Thread":
+                continue
+            for kw in site.node.keywords:
+                if kw.arg == "target" and isinstance(kw.value, ast.Attribute) \
+                        and isinstance(kw.value.value, ast.Name) \
+                        and kw.value.value.id == "self":
+                    out.append((kw.value.attr, name))
+    return out
+
+
+class EngineThreadSharedState(CallGraphRule):
+    rule_id = "engine-thread-shared-state"
+    description = ("attribute written both from engine-thread methods and "
+                   "async event-loop methods of the same class with no "
+                   "lock in scope: a cross-thread data race (torn "
+                   "read-modify-writes, half-published state)")
+
+    def check_graph(self, graph) -> Iterable[Finding]:
+        for mi in graph.modules:
+            for cls in mi.classes.values():
+                yield from self._check_class(graph, mi, cls)
+
+    def _check_class(self, graph, mi, cls) -> Iterable[Finding]:
+        targets = _thread_targets(cls)
+        if not targets:
+            return
+        creators = {creator for _, creator in targets}
+        engine = self._closure(cls, [cls.methods[t] for t, _ in targets
+                                     if t in cls.methods])
+        async_roots = [fn for fn in self._class_functions(cls)
+                       if fn.is_async]
+        async_side = self._closure(cls, async_roots)
+        exempt = _INIT_METHODS | creators
+        # attr -> side -> first (fn, node, locked) write site
+        writes: dict[str, dict[str, tuple]] = {}
+        for fn in self._class_functions(cls):
+            root = fn
+            while root.parent is not None:
+                root = root.parent
+            if root.node.name in exempt:
+                continue
+            in_engine = fn.qname in engine or root.qname in engine
+            in_async = fn.qname in async_side or root.qname in async_side
+            if not (in_engine or in_async):
+                continue
+            for node, attr in self._self_writes(fn):
+                locked = _under_lock(fn.module, node, fn.node)
+                slot = writes.setdefault(attr, {})
+                if in_engine:
+                    slot.setdefault("engine", (fn, node, locked))
+                if in_async:
+                    slot.setdefault("async", (fn, node, locked))
+        for attr in sorted(writes):
+            slot = writes[attr]
+            if "engine" not in slot or "async" not in slot:
+                continue
+            e_fn, e_node, e_locked = slot["engine"]
+            a_fn, a_node, a_locked = slot["async"]
+            if e_fn is a_fn and e_node is a_node:
+                continue  # one site reachable from both sides: ambiguous
+            if e_locked and a_locked:
+                continue
+            fn, node = (e_fn, e_node) if not e_locked else (a_fn, a_node)
+            yield Finding(
+                fn.module.path, node.lineno, node.col_offset, self.rule_id,
+                f"`self.{attr}` is written from the engine thread "
+                f"(`{e_fn.display}`) and the event loop "
+                f"(`{a_fn.display}`) with no lock at this site",
+                "guard both writers with one lock, or make a single side "
+                "own the attribute (engine publishes, async reads), or "
+                "suppress with the invariant that serializes the writes",
+                chain=(f"{e_fn.display} [engine thread]",
+                       f"{a_fn.display} [event loop]",
+                       f"self.{attr}"))
+
+    @staticmethod
+    def _class_functions(cls):
+        """Methods plus their nested defs (handlers defined inside
+        methods run wherever they're awaited — usually the loop)."""
+        out = []
+        stack = list(cls.methods.values())
+        while stack:
+            fn = stack.pop()
+            out.append(fn)
+            stack.extend(fn.nested.values())
+        return out
+
+    @staticmethod
+    def _closure(cls, roots) -> set[str]:
+        """Qnames of same-class functions reachable from roots via
+        resolved self-call edges (nested defs included)."""
+        seen = {fn.qname for fn in roots}
+        stack = list(roots)
+        while stack:
+            fn = stack.pop()
+            for nxt in (*fn.nested.values(),
+                        *(s.callee for s in fn.calls
+                          if s.callee is not None and s.callee.cls is cls)):
+                if nxt.qname not in seen:
+                    seen.add(nxt.qname)
+                    stack.append(nxt)
+        return seen
+
+    @staticmethod
+    def _self_writes(fn):
+        for node in iter_scope(fn.node.body):
+            targets = ()
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = (node.target,)
+            for t in targets:
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    yield node, t.attr

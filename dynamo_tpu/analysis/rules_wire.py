@@ -40,10 +40,6 @@ _ROOT_CLASS = "EngineError"
 _ENGINE_SIDE = ("/engine/", "/llm/", "/backends/")
 
 
-def _norm(path: str) -> str:
-    return path.replace("\\", "/")
-
-
 class WireErrorTaxonomy(ProjectRule):
     rule_id = "wire-error-taxonomy"
     description = ("every EngineError subclass raised by engine-side code "
@@ -82,7 +78,7 @@ class WireErrorTaxonomy(ProjectRule):
                     yield Finding(
                         errors_mod.path, line, 0, self.rule_id,
                         f"`{cls}.WIRE_PREFIX` is declared but never "
-                        f"{role} in {_norm(mod.path)}: the typed error "
+                        f"{role} in {mod.norm_path}: the typed error "
                         "cannot survive the request plane",
                         f"reference `{cls}.WIRE_PREFIX` in the "
                         f"{'error handler' if role == 'encoded' else 'stream decoder'}")
@@ -90,7 +86,7 @@ class WireErrorTaxonomy(ProjectRule):
     @staticmethod
     def _find(modules: list[Module], suffix: str) -> Module | None:
         for m in modules:
-            if _norm(m.path).endswith(suffix):
+            if m.norm_path.endswith(suffix):
                 return m
         return None
 
@@ -129,7 +125,7 @@ class WireErrorTaxonomy(ProjectRule):
         """class name -> first engine-side raise site."""
         raised: dict[str, tuple[Module, ast.AST]] = {}
         for mod in modules:
-            path = _norm(mod.path)
+            path = mod.norm_path
             if not any(seg in path for seg in _ENGINE_SIDE):
                 continue
             for node in ast.walk(mod.tree):

@@ -79,6 +79,7 @@ class ModelSpec:
         """Build from a HF config.json (local dir or file)."""
         if os.path.isdir(path):
             path = os.path.join(path, "config.json")
+        # dtpu: ignore[blocking-call-in-async] -- model-load startup I/O (HF config.json), never on the serving path
         with open(path) as fh:
             cfg = json.load(fh)
         return cls(

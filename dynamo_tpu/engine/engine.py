@@ -184,6 +184,12 @@ class TPUEngine(AsyncEngine):
         self.overrides: dict[int, int] = {}  # slot -> first token next window
         self.waiting: queue.Queue[_Request] = queue.Queue()
         self.num_waiting = 0
+        # Queue-accounting counters are read-modify-written from BOTH the
+        # event loop (generate -> _queue_put) and the engine thread
+        # (_admit / requeue): unguarded `+=` loses updates, and these
+        # counters feed the SLA admission gate and TTFT projection
+        # (caught by dtpu-lint engine-thread-shared-state).
+        self._queue_stats_lock = threading.Lock()
         # SLA-aware admission (config.ttft_budget_ms): the measured
         # end-to-end prefill rate (EWMA over batched-prefill dispatch ->
         # first-token-readback intervals, so queueing behind decode
@@ -367,12 +373,15 @@ class TPUEngine(AsyncEngine):
         """Enqueue for admission, tracking the queued cold tokens the
         TTFT projection counts (every put site must come through here)."""
         r.queued_cold = len(r.tokens_all) if cold is None else cold
-        self._waiting_cold += r.queued_cold
+        with self._queue_stats_lock:
+            self._waiting_cold += r.queued_cold
+            self.num_waiting += 1
         self.waiting.put(r)
-        self.num_waiting += 1
 
     def _queue_pop_accounting(self, r: _Request) -> None:
-        self._waiting_cold -= r.queued_cold
+        with self._queue_stats_lock:
+            self._waiting_cold -= r.queued_cold
+            self.num_waiting -= 1
         r.queued_cold = 0
 
     def _note_queue_wait(self, r: _Request) -> None:
@@ -1175,7 +1184,6 @@ class TPUEngine(AsyncEngine):
                     r = self.waiting.get_nowait()
                 except queue.Empty:
                     break
-            self.num_waiting -= 1
             self._queue_pop_accounting(r)
             if r.ctx.is_killed or r.ctx.is_stopped:
                 r.push(LLMEngineOutput(
@@ -1208,8 +1216,9 @@ class TPUEngine(AsyncEngine):
                     # Park at the HEAD (strict FIFO): re-queueing at the
                     # tail would let later small prompts starve this one.
                     r.queued_cold = len(r.tokens_all)
-                    self._waiting_cold += r.queued_cold
-                    self.num_waiting += 1
+                    with self._queue_stats_lock:
+                        self._waiting_cold += r.queued_cold
+                        self.num_waiting += 1
                     self._deferred_head = r
                     self.admission_deferred += 1
                     break
@@ -1725,6 +1734,7 @@ class TPUEngine(AsyncEngine):
         self.overrides[slot] = first_token
 
     # -- decode windows -------------------------------------------------------
+    # dtpu: hotpath -- decode-window dispatch: a sync device->host readback anywhere below stalls the software pipeline
     def _dispatch_window(self) -> _Window:
         cfg = self.config
         page = cfg.page_size

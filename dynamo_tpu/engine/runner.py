@@ -365,6 +365,19 @@ class ModelRunner:
         spec = self.spec
         page = self.config.page_size
         bucket_pages = bucket // page
+        if with_history and self.config.sp > 1 \
+                and self.config.ring_attention \
+                and not getattr(self, "_ring_hist_warned", False):
+            # History chunks (prompts longer than one prefill bucket)
+            # read prior pages via the paged gather — that path still
+            # uses the GSPMD all-gather, so ring attention covers
+            # single-bucket prefills only. Warn at program-build time,
+            # NOT inside the traced body: a trace-time branch runs once
+            # per compile (impure-jit-program).
+            self._ring_hist_warned = True
+            log.info("ring attention: history-chunk prefill uses the "
+                     "all-gather sp path (ring covers single-bucket "
+                     "prefills)")
 
         # All host inputs travel in ONE packed int32 array (floats bitcast):
         # h2d transfers are latency-bound, so one transfer beats ten.
@@ -402,16 +415,6 @@ class ModelRunner:
                          and batch % cfg_pp == 0
                          and spec.num_layers % cfg_pp == 0)
             if with_history:
-                if sp_shard and self.config.ring_attention and \
-                        not getattr(self, "_ring_hist_warned", False):
-                    # History chunks (prompts longer than one prefill
-                    # bucket) read prior pages via the paged gather —
-                    # that path still uses the GSPMD all-gather, so ring
-                    # attention covers single-bucket prefills only.
-                    self._ring_hist_warned = True
-                    log.info("ring attention: history-chunk prefill uses "
-                             "the all-gather sp path (ring covers "
-                             "single-bucket prefills)")
                 logits, k_cache, v_cache = _prefill_with_history(
                     params, spec, k_cache, v_cache, tokens, positions,
                     page_table, seq_lens, hist_table, hist_lens,
@@ -953,8 +956,10 @@ class ModelRunner:
             # (is_ready pacing) — no host copy is even started.
             return sampled
         self.sync_prefill_fetches += 1
+        # dtpu: ignore[host-sync-in-hot-path] -- fetch=True branch only: prefill_chunk_async passes fetch=False and returns at the dispatch-only branch above (runtime twin: sync_prefill_fetches counter)
         return np.asarray(jax.device_get(sampled))[:len(seqs)]
 
+    # dtpu: hotpath -- PR 5 zero-readback invariant, now static: no device->host fetch anywhere below this entry
     def prefill_chunk_async(self, seq: PrefillSeq):
         """Dispatch ONE intermediate prefill chunk with NO host readback
         (the stall-free chunked-prefill path): device-stream order

@@ -55,6 +55,7 @@ def _read_value(fh: BinaryIO, vtype: int) -> Any:
 
 def read_metadata(path: str) -> dict[str, Any]:
     """Parse a GGUF file's metadata KVs (tensor info/data are skipped)."""
+    # dtpu: ignore[blocking-call-in-async] -- model-load startup I/O, never on the serving path (allowed-to-block leaf)
     with open(path, "rb") as fh:
         if fh.read(4) != GGUF_MAGIC:
             raise ValueError(f"{path} is not a GGUF file")

@@ -146,6 +146,7 @@ def jax_transfer_usable() -> bool:
         dev = jax.local_devices()[0]
         srv = transfer.start_transfer_server(dev.client)
         arr = jnp.arange(8, dtype=jnp.float32)
+        # dtpu: ignore[blocking-call-in-async] -- one-shot 8-float capability probe at server construction
         arr.block_until_ready()
         srv.await_pull(0, [arr])
         conn = srv.connect(srv.address())

@@ -38,6 +38,7 @@ def current_namespace(default: str = "default") -> str:
     mount), else ``default`` (reference kube.py
     get_current_k8s_namespace)."""
     try:
+        # dtpu: ignore[blocking-call-in-async] -- one-line service-account mount, read once at connector construction
         with open(os.path.join(SA_DIR, "namespace"), encoding="utf-8") as fh:
             return fh.read().strip()
     except FileNotFoundError:
@@ -67,6 +68,7 @@ class KubernetesAPI:
         self.base_url = base_url.rstrip("/")
         if token is None:
             try:
+                # dtpu: ignore[blocking-call-in-async] -- one-line service-account mount, read once at connector construction
                 with open(os.path.join(SA_DIR, "token"),
                           encoding="utf-8") as fh:
                     token = fh.read().strip()

@@ -134,6 +134,7 @@ class RuntimeConfig:
                 raise RuntimeError(
                     f"config file {toml_path!r} given but no TOML parser is "
                     "available (python < 3.11 without tomli)")
+            # dtpu: ignore[blocking-call-in-async] -- tiny local settings file, read once at process startup (allowed-to-block leaf)
             with open(toml_path, "rb") as fh:
                 data: dict[str, Any] = tomllib.load(fh)
             for field in dataclasses.fields(cls):

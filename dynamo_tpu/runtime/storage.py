@@ -269,11 +269,13 @@ class FileStore:
         return created
 
     async def kv_get(self, key: str) -> Any | None:
-        doc = self._read(self._path(key))
+        # The root may sit on NFS (same rationale as object_get): every
+        # doc read goes through a worker thread, not the event loop.
+        doc = await asyncio.to_thread(self._read, self._path(key))
         return None if doc is None else doc["v"]
 
     async def kv_get_prefix(self, prefix: str) -> list[dict]:
-        docs = self._scan(prefix)
+        docs = await asyncio.to_thread(self._scan, prefix)
         return [{"k": k, "v": d["v"]} for k, d in sorted(docs.items())]
 
     async def kv_delete(self, key: str) -> bool:
@@ -285,12 +287,12 @@ class FileStore:
 
     async def kv_delete_prefix(self, prefix: str) -> int:
         n = 0
-        for key in list(self._scan(prefix)):
+        for key in list(await asyncio.to_thread(self._scan, prefix)):
             n += await self.kv_delete(key)
         return n
 
     async def watch_prefix(self, prefix: str) -> LocalWatch:
-        docs = self._scan(prefix)
+        docs = await asyncio.to_thread(self._scan, prefix)
         watch = LocalWatch([{"k": k, "v": d["v"]}
                             for k, d in sorted(docs.items())], prefix,
                            on_cancel=self._drop_watch)
@@ -336,7 +338,7 @@ class FileStore:
             await asyncio.sleep(self.poll_interval)
             for w in self._watches:
                 try:
-                    docs = self._scan(w.prefix)
+                    docs = await asyncio.to_thread(self._scan, w.prefix)
                     seen = w._seen
                     for k, d in docs.items():
                         if seen.get(k) != d["rev"]:

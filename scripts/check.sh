@@ -6,8 +6,12 @@ set -uo pipefail
 
 cd "$(dirname "$0")/.."
 
-echo "== dtpu-lint (python -m dynamo_tpu.analysis dynamo_tpu) =="
-python -m dynamo_tpu.analysis dynamo_tpu || exit 1
+echo "== dtpu-lint (interprocedural analysis + suppression ratchet) =="
+# --stats prints the module/function/edge/rule counts so gate logs
+# record call-graph size drift; --budget is the suppression ratchet
+# (deploy/lint-budget.json counts may only go down; docs/ANALYSIS.md).
+python -m dynamo_tpu.analysis dynamo_tpu \
+    --budget deploy/lint-budget.json --stats || exit 1
 echo "clean."
 
 echo "== chaos smoke (seeded fault injection, docs/RESILIENCE.md) =="

@@ -605,6 +605,8 @@ def test_default_rules_catalog():
                    "lock-across-await", "swallowed-cancellation",
                    "unbounded-queue", "unbounded-wait",
                    "jit-recompile-hazard", "unregistered-jit",
+                   "host-sync-in-hot-path", "impure-jit-program",
+                   "engine-thread-shared-state",
                    "wire-error-taxonomy", "direct-prometheus-import",
                    "untyped-journal-event"}
 
@@ -703,3 +705,517 @@ def test_untyped_journal_event_suppression(tmp_path):
         '  # dtpu: ignore[untyped-journal-event] -- fixture')
     findings = run_rule(tmp_path, "untyped-journal-event", src)
     assert len(findings) == 2
+
+
+# =============================================================================
+# dtpu-lint v2: call-graph core + interprocedural rules
+# =============================================================================
+
+import time
+
+from dynamo_tpu.analysis import build_callgraph, run_analysis
+from dynamo_tpu.analysis.core import count_suppressions, load_paths
+
+
+def build_tree(tmp_path, files: dict[str, str]):
+    """Write a fixture package tree and return (root, modules, graph)."""
+    root = tmp_path / "pkgroot"
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    modules, failed = load_paths([str(root)])
+    assert failed == []
+    return str(root), modules, build_callgraph(modules)
+
+
+def fn_of(graph, suffix: str):
+    hits = [f for f in graph.functions.values()
+            if f.qname == suffix or f.qname.endswith(suffix)]
+    assert len(hits) == 1, f"{suffix}: {[f.qname for f in hits]}"
+    return hits[0]
+
+
+# -- call-graph core: resolution ----------------------------------------------
+
+def test_callgraph_import_resolution(tmp_path):
+    _, _, graph = build_tree(tmp_path, {
+        "app/util.py": "def helper():\n    pass\n",
+        "app/sub/deep.py": "def deep_fn():\n    pass\n",
+        "app/main.py": (
+            "from app.util import helper\n"
+            "from app import util\n"
+            "from app.util import helper as h2\n"
+            "import app.sub.deep\n"
+            "def a():\n    helper()\n"
+            "def b():\n    util.helper()\n"
+            "def c():\n    h2()\n"
+            "def d():\n    app.sub.deep.deep_fn()\n"),
+    })
+    helper = fn_of(graph, "app.util:helper")
+    deep = fn_of(graph, "app.sub.deep:deep_fn")
+    for name, target in (("a", helper), ("b", helper), ("c", helper),
+                         ("d", deep)):
+        fn = fn_of(graph, f"app.main:{name}")
+        assert [s.callee for s in fn.calls] == [target], name
+
+
+def test_callgraph_self_method_and_attr_edges(tmp_path):
+    _, _, graph = build_tree(tmp_path, {
+        "app/runner.py": (
+            "class Runner:\n"
+            "    def fetch(self):\n        pass\n"),
+        "app/engine.py": (
+            "from app.runner import Runner\n"
+            "class Engine:\n"
+            "    def __init__(self):\n"
+            "        self.runner = Runner()\n"
+            "    def helper(self):\n        pass\n"
+            "    def step(self):\n"
+            "        self.helper()\n"
+            "        self.runner.fetch()\n"),
+    })
+    step = fn_of(graph, "app.engine:Engine.step")
+    callees = {s.callee.qname for s in step.calls if s.callee}
+    assert any(q.endswith("app.engine:Engine.helper") for q in callees)
+    assert any(q.endswith("app.runner:Runner.fetch") for q in callees)
+
+
+def test_callgraph_base_class_method_edge(tmp_path):
+    _, _, graph = build_tree(tmp_path, {
+        "app/base.py": ("class Base:\n"
+                        "    def shared(self):\n        pass\n"),
+        "app/impl.py": ("from app.base import Base\n"
+                        "class Impl(Base):\n"
+                        "    def go(self):\n"
+                        "        self.shared()\n"),
+    })
+    go = fn_of(graph, "app.impl:Impl.go")
+    callees = [s.callee.qname for s in go.calls if s.callee]
+    assert len(callees) == 1
+    assert callees[0].endswith("app.base:Base.shared")
+
+
+def test_callgraph_cycle_tolerance(tmp_path):
+    _, _, graph = build_tree(tmp_path, {
+        "app/loop.py": (
+            "import time\n"
+            "def a():\n    b()\n"
+            "def b():\n    a()\n    c()\n"
+            "def c():\n    time.sleep(1)\n"),
+    })
+    a, b = fn_of(graph, "app.loop:a"), fn_of(graph, "app.loop:b")
+    assert a.blocks and b.blocks
+    chain = graph.blocking_chain(a)
+    assert chain[-1] == "time.sleep"
+
+
+def test_callgraph_hot_propagation_and_anchor(tmp_path):
+    _, _, graph = build_tree(tmp_path, {
+        "app/hot.py": (
+            "# dtpu: hotpath\n"
+            "def entry():\n    middle()\n"
+            "def middle():\n    leaf()\n"
+            "def leaf():\n    pass\n"
+            "def cold():\n    pass\n"),
+    })
+    leaf, cold = fn_of(graph, "app.hot:leaf"), fn_of(graph, "app.hot:cold")
+    assert fn_of(graph, "app.hot:entry").hot_anchor
+    assert leaf.is_hot and not cold.is_hot
+    assert graph.hot_chain(leaf) == ["hot.entry", "hot.middle", "hot.leaf"]
+
+
+# -- blocking-call-in-async: transitive ---------------------------------------
+
+def test_blocking_transitive_flags_call_site(tmp_path):
+    root, *_ = build_tree(tmp_path, {
+        "app/svc.py": (
+            "import time\n"
+            "def outer():\n    inner()\n"
+            "def inner():\n    time.sleep(1)\n"
+            "async def handler():\n    outer()\n"),
+    })
+    found = analyze_paths([root], select=["blocking-call-in-async"])
+    assert len(found) == 1
+    f = found[0]
+    assert f.line == 7 and "outer" in f.message  # the handler's call site
+    assert f.chain == ("svc.handler", "svc.outer", "svc.inner", "time.sleep")
+
+
+def test_blocking_transitive_leaf_suppression_stops_propagation(tmp_path):
+    root, *_ = build_tree(tmp_path, {
+        "app/svc.py": (
+            "import time\n"
+            "def inner():\n"
+            "    time.sleep(1)  # dtpu: ignore[blocking-call-in-async] -- startup only\n"
+            "async def handler():\n    inner()\n"),
+    })
+    assert analyze_paths([root], select=["blocking-call-in-async"]) == []
+
+
+def test_blocking_transitive_skips_async_callees(tmp_path):
+    # Calling an async def just builds a coroutine: not a blocking edge.
+    root, *_ = build_tree(tmp_path, {
+        "app/svc.py": (
+            "import time\n"
+            "async def inner():\n    time.sleep(1)\n"
+            "async def handler():\n    await inner()\n"),
+    })
+    found = analyze_paths([root], select=["blocking-call-in-async"])
+    # only the direct per-file finding inside inner()
+    assert len(found) == 1 and found[0].line == 3
+
+
+# -- host-sync-in-hot-path ----------------------------------------------------
+
+HOTPATH_BAD = """\
+import jax
+import numpy as np
+
+class Runner:
+    # dtpu: hotpath -- decode dispatch
+    def dispatch(self):
+        self.pack()
+
+    def pack(self):
+        self.fetch()
+
+    def fetch(self):
+        return np.asarray(self.dev_array)
+"""
+
+
+def test_host_sync_in_hot_path_fires_with_chain(tmp_path):
+    root, *_ = build_tree(tmp_path, {"app/runner.py": HOTPATH_BAD})
+    found = analyze_paths([root], select=["host-sync-in-hot-path"])
+    assert len(found) == 1
+    f = found[0]
+    assert f.line == 13
+    assert f.chain == ("runner.dispatch", "runner.pack", "runner.fetch",
+                       "np.asarray")
+
+
+def test_host_sync_quiet_without_anchor_and_on_host_side_asarray(tmp_path):
+    src = HOTPATH_BAD.replace("    # dtpu: hotpath -- decode dispatch\n", "")
+    root, *_ = build_tree(tmp_path, {"app/runner.py": src})
+    assert analyze_paths([root], select=["host-sync-in-hot-path"]) == []
+    # dtype'd asarray = host-side list packing, never flagged even hot
+    src2 = HOTPATH_BAD.replace("np.asarray(self.dev_array)",
+                               "np.asarray(self.tokens, np.int32)")
+    root2, *_ = build_tree(tmp_path / "b", {"app/runner.py": src2})
+    assert analyze_paths([root2], select=["host-sync-in-hot-path"]) == []
+
+
+def test_host_sync_suppression_at_leaf(tmp_path):
+    src = HOTPATH_BAD.replace(
+        "        return np.asarray(self.dev_array)\n",
+        "        # dtpu: ignore[host-sync-in-hot-path] -- cold branch\n"
+        "        return np.asarray(self.dev_array)\n")
+    root, *_ = build_tree(tmp_path, {"app/runner.py": src})
+    assert analyze_paths([root], select=["host-sync-in-hot-path"]) == []
+
+
+def test_host_sync_other_leaves(tmp_path):
+    src = ("import jax, jax.numpy as jnp\n"
+           "# dtpu: hotpath\n"
+           "def entry(arr):\n"
+           "    jax.device_get(arr)\n"
+           "    arr.block_until_ready()\n"
+           "    arr.item()\n"
+           "    float(jnp.sum(arr))\n"
+           "    int(len(arr))\n")     # host-side: not flagged
+    root, *_ = build_tree(tmp_path, {"app/m.py": src})
+    found = analyze_paths([root], select=["host-sync-in-hot-path"])
+    assert [f.line for f in found] == [4, 5, 6, 7]
+
+
+def test_host_sync_real_engine_decode_loop_is_clean():
+    """Acceptance: the real decode-window dispatch closure passes (and
+    the anchors are actually present — the pass is not vacuous)."""
+    import dynamo_tpu
+    from pathlib import Path
+
+    pkg = Path(dynamo_tpu.__file__).parent
+    run = run_analysis([str(pkg)], select=["host-sync-in-hot-path"])
+    assert [f for f in run.findings if f.rule_id != "parse-error"] == []
+    anchors = [f.qname for f in run.graph.functions.values() if f.hot_anchor]
+    assert any("_dispatch_window" in q for q in anchors)
+    assert any("prefill_chunk_async" in q for q in anchors)
+    hot = [f for f in run.graph.functions.values() if f.is_hot]
+    assert any("decode_window" in f.qname for f in hot)  # engine->runner edge
+
+
+# -- impure-jit-program -------------------------------------------------------
+
+IMPURE_JIT = """\
+import time
+from myproj.engine import perf
+
+class Runner:
+    def build(self):
+        def step(params, x):
+            {body}
+            return x
+        fn = perf.instrumented_jit("decode", step, key="k")
+        return fn
+"""
+
+
+def _impure_fixture(tmp_path, body: str, sub="a"):
+    root, *_ = build_tree(tmp_path / sub, {
+        "myproj/engine/perf.py": (
+            "def instrumented_jit(program, fun, *, key=None, **kw):\n"
+            "    return fun\n"),
+        "myproj/engine/runner.py": IMPURE_JIT.replace("{body}", body),
+    })
+    return analyze_paths([root], select=["impure-jit-program"])
+
+
+def test_impure_jit_time_call_fires(tmp_path):
+    found = _impure_fixture(tmp_path, "t = time.monotonic()")
+    assert len(found) == 1
+    assert "time.monotonic" in found[0].message
+    assert found[0].chain == ("runner.step", "time.monotonic")
+    assert found[0].line == 9  # at the instrumented_jit call site
+
+
+def test_impure_jit_self_mutation_fires(tmp_path):
+    found = _impure_fixture(tmp_path, "self.warned = True", sub="b")
+    assert len(found) == 1 and "self.warned" in found[0].message
+
+
+def test_impure_jit_transitive_through_helper_and_nested(tmp_path):
+    root, *_ = build_tree(tmp_path / "c", {
+        "myproj/engine/perf.py": (
+            "def instrumented_jit(program, fun, *, key=None, **kw):\n"
+            "    return fun\n"),
+        "myproj/engine/runner.py": (
+            "import logging\n"
+            "from myproj.engine import perf\n"
+            "log = logging.getLogger()\n"
+            "def helper(x):\n"
+            "    log.info('traced!')\n"
+            "    return x\n"
+            "def build():\n"
+            "    def outer(x):\n"
+            "        def inner(y):\n"
+            "            return helper(y)\n"
+            "        return inner(x)\n"
+            "    return perf.instrumented_jit('p', outer, key='k')\n"),
+    })
+    found = analyze_paths([root], select=["impure-jit-program"])
+    assert len(found) == 1
+    assert found[0].chain[-1] == "log.info"
+
+
+def test_impure_jit_quiet_on_pure_program(tmp_path):
+    found = _impure_fixture(
+        tmp_path, "x = x + 1", sub="d")
+    assert found == []
+
+
+def test_impure_jit_jax_random_is_pure(tmp_path):
+    # jax.random is in-graph randomness; only host random.* is impure.
+    found = _impure_fixture(
+        tmp_path, "key = jax.random.fold_in(params, 0)", sub="e")
+    assert found == []
+
+
+def test_impure_jit_suppression(tmp_path):
+    root, *_ = build_tree(tmp_path / "f", {
+        "myproj/engine/perf.py": (
+            "def instrumented_jit(program, fun, *, key=None, **kw):\n"
+            "    return fun\n"),
+        "myproj/engine/runner.py": IMPURE_JIT.replace(
+            "{body}", "t = time.monotonic()").replace(
+            '        fn = perf.instrumented_jit("decode", step, key="k")',
+            "        # dtpu: ignore[impure-jit-program] -- fixture\n"
+            '        fn = perf.instrumented_jit("decode", step, key="k")'),
+    })
+    assert analyze_paths([root], select=["impure-jit-program"]) == []
+
+
+# -- engine-thread-shared-state -----------------------------------------------
+
+SHARED_STATE = """\
+import threading
+
+class Engine:
+    def __init__(self):
+        self.counter = 0
+        self._lock = threading.Lock()
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop)
+        self._thread.start()
+
+    def _loop(self):
+        {engine_write}
+
+    async def generate(self):
+        {async_write}
+"""
+
+
+def _shared_fixture(tmp_path, engine_write, async_write, sub="a"):
+    root, *_ = build_tree(tmp_path / sub, {
+        "app/engine.py": SHARED_STATE.format(engine_write=engine_write,
+                                             async_write=async_write),
+    })
+    return analyze_paths([root], select=["engine-thread-shared-state"])
+
+
+def test_shared_state_unlocked_both_sides_fires(tmp_path):
+    found = _shared_fixture(tmp_path, "self.counter += 1",
+                            "self.counter = 0")
+    assert len(found) == 1
+    f = found[0]
+    assert "self.counter" in f.message or "counter" in f.message
+    assert any("[engine thread]" in c for c in f.chain)
+    assert any("[event loop]" in c for c in f.chain)
+
+
+def test_shared_state_locked_both_sides_quiet(tmp_path):
+    found = _shared_fixture(
+        tmp_path,
+        "with self._lock:\n            self.counter += 1",
+        "with self._lock:\n            self.counter = 0", sub="b")
+    assert found == []
+
+
+def test_shared_state_single_side_quiet(tmp_path):
+    found = _shared_fixture(tmp_path, "self.counter += 1", "pass", sub="c")
+    assert found == []
+
+
+def test_shared_state_no_thread_class_quiet(tmp_path):
+    src = ("class Plain:\n"
+           "    def sync_side(self):\n        self.counter = 1\n"
+           "    async def async_side(self):\n        self.counter = 2\n")
+    root, *_ = build_tree(tmp_path / "d", {"app/plain.py": src})
+    assert analyze_paths([root],
+                         select=["engine-thread-shared-state"]) == []
+
+
+def test_shared_state_init_writes_exempt(tmp_path):
+    # __init__ and the thread-creating method happen-before the start.
+    found = _shared_fixture(tmp_path, "pass",
+                            "self._thread = None", sub="e")
+    assert found == []
+
+
+def test_shared_state_suppression(tmp_path):
+    found = _shared_fixture(
+        tmp_path,
+        "self.counter += 1  # dtpu: ignore[engine-thread-shared-state] -- why",
+        "self.counter = 0  # dtpu: ignore[engine-thread-shared-state] -- why",
+        sub="f")
+    assert found == []
+
+
+# -- suppression budget (ratchet) ---------------------------------------------
+
+def test_count_suppressions(tmp_path):
+    root, modules_g = build_tree(tmp_path, {
+        "app/a.py": (
+            "import time\n"
+            "async def f():\n"
+            "    time.sleep(1)  # dtpu: ignore[blocking-call-in-async] -- x\n"
+            "    time.sleep(2)  # dtpu: ignore -- silence all\n"),
+    })[:2]
+    counts = count_suppressions(modules_g, ["blocking-call-in-async"])
+    assert counts == {"*": 1, "blocking-call-in-async": 1}
+
+
+def run_cli(*argv):
+    return subprocess.run(
+        [sys.executable, "-m", "dynamo_tpu.analysis", *argv],
+        capture_output=True, text=True)
+
+
+def test_budget_gate_pass_and_fail(tmp_path):
+    src = ("import time\n"
+           "async def f():\n"
+           "    time.sleep(1)  # dtpu: ignore[blocking-call-in-async] -- x\n")
+    mod = tmp_path / "m.py"
+    mod.write_text(src)
+    ok = tmp_path / "budget_ok.json"
+    ok.write_text(json.dumps({"blocking-call-in-async": 1}))
+    tight = tmp_path / "budget_tight.json"
+    tight.write_text(json.dumps({"blocking-call-in-async": 0}))
+    assert run_cli(str(mod), "--budget", str(ok)).returncode == 0
+    proc = run_cli(str(mod), "--budget", str(tight))
+    assert proc.returncode == 1
+    assert "suppression budget exceeded" in proc.stderr
+
+
+def test_repo_budget_file_matches_reality():
+    """The committed ratchet file must stay exactly at the real counts:
+    lower is a stale file (ratchet down properly), higher silently
+    grants headroom."""
+    import dynamo_tpu
+    from pathlib import Path
+
+    budget_path = Path(__file__).parent.parent / "deploy" / "lint-budget.json"
+    budget = json.loads(budget_path.read_text())
+    budget.pop("_comment", None)
+    run = run_analysis([str(Path(dynamo_tpu.__file__).parent)])
+    assert run.suppression_counts() == budget
+
+
+# -- CLI: --format json stability, --callgraph, --stats -----------------------
+
+def test_format_json_schema_pinned(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\nasync def f():\n    time.sleep(1)\n")
+    proc = run_cli(str(bad), "--format", "json")
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert sorted(doc.keys()) == ["budget_errors", "findings", "stats",
+                                  "suppressions", "version"]
+    assert doc["version"] == 1
+    f = doc["findings"][0]
+    assert sorted(f.keys()) == ["chain", "col", "hint", "line", "message",
+                                "path", "rule_id"]
+    # stable ordering: two runs byte-identical
+    proc2 = run_cli(str(bad), "--format", "json")
+    assert proc.stdout == proc2.stdout
+
+
+def test_cli_callgraph_dump(tmp_path):
+    mod = tmp_path / "pkg" / "svc.py"
+    mod.parent.mkdir()
+    mod.write_text("def a():\n    b()\ndef b():\n    pass\n")
+    proc = run_cli(str(mod.parent), "--callgraph", "pkg.svc")
+    assert proc.returncode == 0
+    assert "pkg.svc:a" in proc.stdout
+    assert "-> " in proc.stdout and "pkg.svc:b" in proc.stdout
+
+
+def test_cli_callgraph_unknown_module_is_usage_error(tmp_path):
+    proc = run_cli(str(tmp_path), "--callgraph", "no.such.module")
+    assert proc.returncode == 2
+
+
+def test_cli_stats_line(tmp_path):
+    mod = tmp_path / "ok.py"
+    mod.write_text("def a():\n    pass\n")
+    proc = run_cli(str(mod), "--stats")
+    assert proc.returncode == 0
+    assert "dtpu-lint:" in proc.stderr and "edges=" in proc.stderr
+
+
+# -- analyzer performance budget ----------------------------------------------
+
+def test_full_repo_lint_under_budget():
+    """Single-pass sharing keeps the full-repo interprocedural run fast
+    (parse once, one call graph for all 14 rules). Generous bound for
+    the 1-core CI box; locally this is ~3-4 s."""
+    import dynamo_tpu
+    from pathlib import Path
+
+    t0 = time.perf_counter()
+    run = run_analysis([str(Path(dynamo_tpu.__file__).parent)])
+    elapsed = time.perf_counter() - t0
+    assert run.graph is not None
+    assert elapsed < 10.0, f"full-repo lint took {elapsed:.1f}s"

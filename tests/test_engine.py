@@ -444,3 +444,47 @@ async def test_sla_rejection_503():
         assert finish == "length" and len(got) == 2
     finally:
         eng.stop()
+
+
+def test_queue_accounting_thread_safe():
+    """Regression for the dtpu-lint engine-thread-shared-state finding:
+    num_waiting/_waiting_cold are read-modify-written from both the
+    event loop (generate -> _queue_put) and the engine thread (_admit);
+    unguarded += lost updates and skewed the SLA admission gate. The
+    counters must come back to exactly zero after a producer/consumer
+    hammer (the static guard is tests/test_analysis_clean.py)."""
+    import queue as queue_mod
+    import threading
+
+    from dynamo_tpu.engine.engine import TPUEngine
+
+    eng = TPUEngine.__new__(TPUEngine)  # accounting state only, no device
+    eng.waiting = queue_mod.Queue()
+    eng.num_waiting = 0
+    eng._waiting_cold = 0
+    eng._queue_stats_lock = threading.Lock()
+
+    class Req:
+        def __init__(self):
+            self.tokens_all = list(range(7))
+            self.queued_cold = 0
+
+    n, producers = 500, 4
+
+    def produce():
+        for _ in range(n):
+            TPUEngine._queue_put(eng, Req())
+
+    def consume():
+        for _ in range(n * producers):
+            r = eng.waiting.get(timeout=5)
+            TPUEngine._queue_pop_accounting(eng, r)
+
+    threads = [threading.Thread(target=produce) for _ in range(producers)]
+    consumer = threading.Thread(target=consume)
+    for t in (*threads, consumer):
+        t.start()
+    for t in (*threads, consumer):
+        t.join(timeout=30)
+    assert eng.num_waiting == 0
+    assert eng._waiting_cold == 0
