@@ -210,6 +210,12 @@ async def main_async(mode: str = "serve"):
     if quant and quant != "none":
         import dataclasses
         spec = dataclasses.replace(spec, quant=quant)
+    # KV-cache quantization (engine/kv_quant.py): BENCH_QUANT_KV=int8
+    # opts in; "none"/unset keeps bf16 KV so committed baselines stay
+    # like-for-like. The kv-quant config is embedded in detail either
+    # way so scripts/perf_gate.py can tell the configurations apart.
+    quant_kv = os.environ.get("BENCH_QUANT_KV", "none")
+    quant_kv = None if quant_kv in ("", "none") else quant_kv
     page = 16
     maxp = 64  # up to 1024 tokens/seq
     seqs = BATCH
@@ -232,7 +238,8 @@ async def main_async(mode: str = "serve"):
         pipeline_depth=int(os.environ.get("BENCH_DEPTH", "4")),
         prefill_chunk_tokens=os.environ.get("BENCH_CHUNK_TOKENS", "auto")
         if not os.environ.get("BENCH_CHUNK_TOKENS", "auto").isdigit()
-        else int(os.environ["BENCH_CHUNK_TOKENS"]))
+        else int(os.environ["BENCH_CHUNK_TOKENS"]),
+        quant_kv=quant_kv)
     engine = TPUEngine(config)
     engine.start()
     rng = np.random.default_rng(0)
@@ -261,7 +268,11 @@ async def main_async(mode: str = "serve"):
                                          "(stability; 1.0 = no outliers)",
                 "rounds": [round(BATCH * ISL / e, 1) for e in by_el],
                 "ttft_p99_ms": round(med_round["ttft_p99_ms"], 1),
-                "platform": jax.devices()[0].platform,
+                "quant": spec.quant,
+                "quant_kv": config.quant_kv,
+                "quant": spec.quant,
+            "quant_kv": config.quant_kv,
+            "platform": jax.devices()[0].platform,
                 "device": str(jax.devices()[0]),
                 "perf": perf,
             },
@@ -303,7 +314,11 @@ async def main_async(mode: str = "serve"):
                 "warmup_round": {k: round(v, 3) for k, v in warm.items()},
                 "prefill_chunk_tokens": engine.prefill_chunk_tokens,
                 "decode_window": config.decode_window,
-                "platform": jax.devices()[0].platform,
+                "quant": spec.quant,
+                "quant_kv": config.quant_kv,
+                "quant": spec.quant,
+            "quant_kv": config.quant_kv,
+            "platform": jax.devices()[0].platform,
                 "device": str(jax.devices()[0]),
                 "perf": perf,
             },
@@ -381,6 +396,8 @@ async def main_async(mode: str = "serve"):
             "ref_example_ratio": round(tok_s / 51.22, 1),
             "decode_window": config.decode_window,
             "pipeline_depth": config.pipeline_depth,
+            "quant": spec.quant,
+            "quant_kv": config.quant_kv,
             "platform": jax.devices()[0].platform,
             "device": str(jax.devices()[0]),
             "perf": perf,

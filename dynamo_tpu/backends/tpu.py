@@ -106,6 +106,13 @@ def parse_args(argv=None) -> argparse.Namespace:
                         help="weight-only quantization: int8 storage, "
                              "bf16 MXU compute (halves weight HBM — fits "
                              "full llama-3-8b on one 16 GB v5e)")
+    parser.add_argument("--quant-kv", default=None, choices=["int8"],
+                        help="KV-cache quantization: int8 pages with "
+                             "per-token scales, dequant fused into the "
+                             "attention kernels — ~2x KV pages per HBM "
+                             "GB and ~half the attention/transfer bytes; "
+                             "composes with --quant (DTPU_QUANT_KV "
+                             "overrides)")
     parser.add_argument("--host-cache-pages", type=int, default=0,
                         help="G2 host-DRAM KV block cache capacity in "
                              "pages (0 = disabled); evicted HBM pages "
@@ -210,6 +217,7 @@ def build_engine_config(args) -> EngineConfig:
             getattr(args, "prefill_chunk_tokens", "auto")),
         warmup_windows=True,
         warmup_prefill_ladder=getattr(args, "warmup_prefill_ladder", False),
+        quant_kv=getattr(args, "quant_kv", None),
         host_cache_pages=args.host_cache_pages,
         kv_disk_cache_dir=args.kv_disk_cache_dir,
         spec_decode=getattr(args, "spec_decode", None),

@@ -36,8 +36,15 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dynamo_tpu.engine.kv_quant import QuantKV
+
 PAGES_PER_CHUNK = 8  # tokens per chunk = 8 * page_size (128 for 16-tok pages)
 NEG_INF = -1e30
+
+# jax renamed pltpu.TPUCompilerParams -> CompilerParams across releases;
+# accept either so the kernel imports on every toolchain the repo targets.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
 
 
 class _ChunkCopy:
@@ -64,9 +71,18 @@ class _ChunkCopy:
 
 def _decode_kernel(layer_ref, page_table_ref, seq_lens_ref,  # SMEM prefetch
                    q_ref, k_hbm, v_hbm,  # q2 VMEM block; k/v packed (ANY)
-                   acc_ref, m_ref, l_ref,  # outputs (unnormalized flash)
-                   k_buf, v_buf, sems,  # scratch
-                   *, page_size: int, max_pages: int, tpr: int, qpk: int):
+                   *rest,  # [ks_hbm, vs_hbm if quantized], outputs, scratch
+                   page_size: int, max_pages: int, tpr: int, qpk: int,
+                   quantized: bool = False):
+    if quantized:
+        # int8 pages + per-token f32 scale rows ([L, Nkv, P, page] in
+        # HBM): the scale chunks ride their own DMAs beside the pages and
+        # dequantization happens in-register below — no bf16 copy of the
+        # history is ever materialized.
+        (ks_hbm, vs_hbm, acc_ref, m_ref, l_ref,
+         k_buf, v_buf, ks_buf, vs_buf, sems) = rest
+    else:
+        acc_ref, m_ref, l_ref, k_buf, v_buf, sems = rest
     b = pl.program_id(0)
     h = pl.program_id(1)
     layer = layer_ref[0]
@@ -81,20 +97,42 @@ def _decode_kernel(layer_ref, page_table_ref, seq_lens_ref,  # SMEM prefetch
     scale = 1.0 / (d ** 0.5)
 
     def make_copies(c, slot):
-        kc = _ChunkCopy(k_hbm, k_buf.at[slot], sems.at[0, slot], layer,
-                        page_table_ref, b, h, c, max_pages)
-        vc = _ChunkCopy(v_hbm, v_buf.at[slot], sems.at[1, slot], layer,
-                        page_table_ref, b, h, c, max_pages)
-        return kc, vc
+        copies = [
+            _ChunkCopy(k_hbm, k_buf.at[slot], sems.at[0, slot], layer,
+                       page_table_ref, b, h, c, max_pages),
+            _ChunkCopy(v_hbm, v_buf.at[slot], sems.at[1, slot], layer,
+                       page_table_ref, b, h, c, max_pages)]
+        if quantized:
+            copies.append(_ChunkCopy(ks_hbm, ks_buf.at[slot],
+                                     sems.at[2, slot], layer,
+                                     page_table_ref, b, h, c, max_pages))
+            copies.append(_ChunkCopy(vs_hbm, vs_buf.at[slot],
+                                     sems.at[3, slot], layer,
+                                     page_table_ref, b, h, c, max_pages))
+        return copies
 
-    kc0, vc0 = make_copies(0, 0)
-    kc0.start()
-    vc0.start()
+    for cp in make_copies(0, 0):
+        cp.start()
 
     # token index of (row-group t, packed row r) is chunk_start + r*tpr + t
     # where t = sublane // qpk.
     group = jax.lax.broadcasted_iota(jnp.int32, (n, rows), 0) // qpk
     row = jax.lax.broadcasted_iota(jnp.int32, (n, rows), 1)
+
+    def dequant_expand(sbuf_slot):
+        # Scale chunk [PAGES_PER_CHUNK, page_size] -> lane-expanded
+        # [rows, 128]: packed row r lane-group t holds token r*tpr+t, so
+        # its scale is flat[r*tpr+t] = reshape(rows, tpr)[r, t]. The
+        # [rows, 1] -> [rows, 128] lane broadcast per group keeps the
+        # expansion Mosaic-friendly (no cross-sublane relayout).
+        s2 = sbuf_slot.reshape(rows, tpr)
+        lane_t = jax.lax.broadcasted_iota(jnp.int32, (rows, 128), 1) // d
+        out = jnp.zeros((rows, 128), jnp.float32)
+        for t in range(tpr):
+            out = out + jnp.where(
+                lane_t == t,
+                jnp.broadcast_to(s2[:, t:t + 1], (rows, 128)), 0.0)
+        return out
 
     def body(c, carry):
         m, l, acc = carry
@@ -102,15 +140,16 @@ def _decode_kernel(layer_ref, page_table_ref, seq_lens_ref,  # SMEM prefetch
 
         @pl.when(c + 1 < num_chunks)
         def _():
-            kc, vc = make_copies(c + 1, jax.lax.rem(c + 1, 2))
-            kc.start()
-            vc.start()
+            for cp in make_copies(c + 1, jax.lax.rem(c + 1, 2)):
+                cp.start()
 
-        kc, vc = make_copies(c, slot)
-        kc.wait()
-        vc.wait()
+        for cp in make_copies(c, slot):
+            cp.wait()
         k2 = k_buf[slot].astype(jnp.float32).reshape(rows, 128)
         v2 = v_buf[slot].astype(jnp.float32).reshape(rows, 128)
+        if quantized:
+            k2 = k2 * dequant_expand(ks_buf[slot])
+            v2 = v2 * dequant_expand(vs_buf[slot])
         scores = jax.lax.dot_general(
             q2, k2, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [n, rows]
@@ -161,10 +200,15 @@ def _hist_flash_pallas(q, k_cache, v_cache, layer, page_table, hist_lens,
     rows_per_page = page_size * d // 128
 
     # Pack the caches: view each page as [rows_per_page, 128] (zero-cost
-    # reshape: same row-major layout).
+    # reshape: same row-major layout). int8 pools (QuantKV) pack their
+    # data pages the same way and additionally ship the per-token scale
+    # rows; the kernel dequantizes in-register after the HBM->VMEM DMA.
+    quantized = isinstance(k_cache, QuantKV)
     L = k_cache.shape[0]
-    kp = k_cache.reshape(L, nkv, num_pages, rows_per_page, 128)
-    vp = v_cache.reshape(L, nkv, num_pages, rows_per_page, 128)
+    k_pages = k_cache.data if quantized else k_cache
+    v_pages = v_cache.data if quantized else v_cache
+    kp = k_pages.reshape(L, nkv, num_pages, rows_per_page, 128)
+    vp = v_pages.reshape(L, nkv, num_pages, rows_per_page, 128)
     layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
 
     # Expand q: group t occupies rows [t*qpk,(t+1)*qpk) and lanes
@@ -178,36 +222,44 @@ def _hist_flash_pallas(q, k_cache, v_cache, layer, page_table, hist_lens,
             q2 = q2.at[:, :, t * qpk:(t + 1) * qpk, t * d:(t + 1) * d].set(qg)
 
     blk = pl.BlockSpec((1, 1, n, tpr * d), lambda i, j, *_: (i, j, 0, 0))
+    any_spec = pl.BlockSpec(memory_space=pltpu.ANY)
+    in_specs = [blk, any_spec, any_spec]
+    operands = [q2, kp, vp]
+    scratch = [
+        pltpu.VMEM((2, PAGES_PER_CHUNK, rows_per_page, 128), kp.dtype),
+        pltpu.VMEM((2, PAGES_PER_CHUNK, rows_per_page, 128), vp.dtype),
+    ]
+    if quantized:
+        # Scale rows [L, Nkv, P, page] ride their own chunk DMAs; the
+        # extra semaphore pairs below fence them independently.
+        in_specs += [any_spec, any_spec]
+        operands += [k_cache.scale, v_cache.scale]
+        scratch += [
+            pltpu.VMEM((2, PAGES_PER_CHUNK, page_size), jnp.float32),
+            pltpu.VMEM((2, PAGES_PER_CHUNK, page_size), jnp.float32),
+        ]
+    scratch.append(pltpu.SemaphoreType.DMA((4 if quantized else 2, 2)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(b, nkv),
-        in_specs=[
-            blk,
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-        ],
+        in_specs=in_specs,
         out_specs=(blk, blk, blk),
-        scratch_shapes=[
-            pltpu.VMEM((2, PAGES_PER_CHUNK, rows_per_page, 128),
-                       k_cache.dtype),
-            pltpu.VMEM((2, PAGES_PER_CHUNK, rows_per_page, 128),
-                       v_cache.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
-        ],
+        scratch_shapes=scratch,
     )
     kernel = functools.partial(_decode_kernel, page_size=page_size,
-                               max_pages=maxp, tpr=tpr, qpk=qpk)
+                               max_pages=maxp, tpr=tpr, qpk=qpk,
+                               quantized=quantized)
     shape = jax.ShapeDtypeStruct((b, nkv, n, tpr * d), jnp.float32)
     acc, m, l = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=(shape, shape, shape),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         # CPU (CI / the virtual test mesh) runs the TPU kernel through the
         # Pallas interpreter; Mosaic compiles it on real chips.
         interpret=jax.default_backend() == "cpu",
-    )(layer_arr, page_table, seq_lens, q2, kp, vp)
+    )(layer_arr, page_table, seq_lens, *operands)
     m = m[..., :1]  # broadcast lanes -> scalar stat per row
     l = l[..., :1]
     if tpr == 1:

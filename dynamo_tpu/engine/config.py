@@ -59,6 +59,9 @@ class ModelSpec:
         return self.num_layers * per_layer + embed + h
 
     def kv_bytes_per_token(self, dtype_bytes: int = 2) -> int:
+        """bf16-pool bytes per token (k+v, all layers/heads). Quantized
+        KV pools add per-token scales — use EngineConfig.kv_token_bytes()
+        for pool sizing so the int8 accounting stays honest."""
         return (2 * self.num_layers * self.num_kv_heads * self.head_dim
                 * dtype_bytes)
 
@@ -215,6 +218,14 @@ class EngineConfig:
     sp: int = 1
     # Numerics
     dtype: str = "bfloat16"
+    # KV-cache quantization (engine/kv_quant.py): None (bf16 pages) or
+    # "int8" — paged K/V stored int8 with per-token-per-head f32 scales,
+    # dequant fused into the attention reads and quantize fused into the
+    # page/window commit scatters. ~1.9x pool compression at head_dim
+    # 64–128 => ~2x resident pages per HBM GB, and attention HBM traffic
+    # at long context roughly halves. Composes with weight-only
+    # ModelSpec.quant. Env DTPU_QUANT_KV overrides ("none" disables).
+    quant_kv: str | None = None
     # Attention backend: "auto" | "pallas" | "xla"
     attention_backend: str = "auto"
     # KV tiering (reference KVBM G1..G3, block_manager.rs:72-82):
@@ -268,6 +279,27 @@ class EngineConfig:
     # below it. None (default) disables the comparison; env
     # DTPU_EXPECTED_ROOFLINE_FRAC overrides at serving time.
     expected_roofline_frac: float | None = None
+
+    def resolve_quant_kv(self) -> str | None:
+        """The effective KV-pool quantization mode, with the DTPU_QUANT_KV
+        env override applied (same layering as prefill_chunk_tokens)."""
+        env = os.environ.get("DTPU_QUANT_KV")
+        if env is not None:
+            env = env.strip().lower()
+            return None if env in ("", "none", "off", "bf16") else env
+        return self.quant_kv
+
+    def kv_token_bytes(self) -> int:
+        """Per-token bytes in the device KV pool (k+v, all layers/heads):
+        bf16 = 2 bytes/value; int8 = 1 byte/value + a 4-byte f32 scale
+        per (layer, head, token). The single source for pool sizing and
+        the perf plane's HBM KV ledger."""
+        m = self.model
+        if self.resolve_quant_kv() == "int8":
+            per_head = m.head_dim + 4  # KV_SCALE_BYTES
+        else:
+            per_head = 2 * m.head_dim
+        return 2 * m.num_layers * m.num_kv_heads * per_head
 
     def bucket_for(self, length: int) -> int:
         for b in self.prefill_buckets:

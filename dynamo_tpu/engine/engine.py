@@ -606,12 +606,16 @@ class TPUEngine(AsyncEngine):
         spec = self.runner.spec
         page = self.config.page_size
         n = -(-len(req.token_ids) // page)
+        quant = self.runner.quant_kv == "int8"
         # The jax device-path needs the staged array to be EXACTLY the
         # advertised shape; the gather output is bucket-padded and
-        # kv-head-replicated, so only offer it when neither applies.
+        # kv-head-replicated, so only offer it when neither applies —
+        # and quantized parcels are host-packed (int8+scales -> uint8),
+        # so they always take the socket path.
         dev_ok = (getattr(plane, "_use_jax", False)
                   and self.runner.kv_rep == 1
-                  and self.runner._page_bucket(n) == n)
+                  and self.runner._page_bucket(n) == n
+                  and not quant)
         # Socket-path grouping only helps when per-fetch D2H latency is
         # small (local attachment); a tunneled chip pays its ~100 ms RTT
         # floor PER GROUP (measured 0.21x — profile_kv_transfer.py), so
@@ -620,9 +624,19 @@ class TPUEngine(AsyncEngine):
                    and self.runner.d2h_fetch_floor_ms() < 10.0 and n > 1)
         first_token, handle, prompt_len = self._prefill_for_extract(
             req, grouped=grouped)
-        shape = [2, spec.num_layers, self.runner.canonical_nkv, n,
-                 self.config.page_size, spec.head_dim]
-        meta = {"shape": shape, "dtype": "bfloat16"}
+        if quant:
+            # Packed int8+scales parcel (engine/kv_quant.py): the wire
+            # carries ~half the bf16 bytes — the disagg transfer tax
+            # (PERF_NOTES, 15–20 ms/prompt on real attachments) halves
+            # with it.
+            from dynamo_tpu.engine.kv_quant import KV_SCALE_BYTES
+            shape = [2, spec.num_layers, self.runner.canonical_nkv, n,
+                     self.config.page_size, spec.head_dim + KV_SCALE_BYTES]
+            meta = {"shape": shape, "dtype": "uint8"}
+        else:
+            shape = [2, spec.num_layers, self.runner.canonical_nkv, n,
+                     self.config.page_size, spec.head_dim]
+            meta = {"shape": shape, "dtype": "bfloat16"}
         if grouped:
             groups = [(h[1], (lambda hh=h:
                               self.runner.finalize_extract(hh)))
@@ -942,6 +956,16 @@ class TPUEngine(AsyncEngine):
                 time.sleep(0.002)  # fully idle
 
     # -- KV tiering (G2/G3 offload + onboard) ---------------------------------
+    def _to_local_parcel(self, kv):
+        """Convert a KV block to this worker's parcel form: packed
+        int8+scales (uint8) when the pool is quantized, bf16 otherwise
+        (engine/kv_quant.py codec; mixed-dtype fleets interoperate)."""
+        from dynamo_tpu.engine.kv_quant import (parcel_to_bf16,
+                                                parcel_to_packed)
+        if self.runner.quant_kv == "int8":
+            return parcel_to_packed(kv)
+        return parcel_to_bf16(kv)
+
     def _on_evict(self, block_hash: int, page: int) -> None:
         self._evict_buffer.append((block_hash, page))
 
@@ -967,6 +991,8 @@ class TPUEngine(AsyncEngine):
             return
         for entry in list(self._pending_spills):
             dev, _ = entry["handle"]
+            if isinstance(dev, tuple):  # quantized extract: (data, scale)
+                dev = dev[0]
             ready = getattr(dev, "is_ready", lambda: True)()
             if not (ready or force):
                 continue
@@ -1014,6 +1040,11 @@ class TPUEngine(AsyncEngine):
                     log.exception("G4 remote fetch failed")
                     remote = []
                 for h, kv in remote:
+                    # Peers may run the other KV dtype: normalize fetched
+                    # blocks to THIS worker's parcel form (packed uint8
+                    # for int8 pools, bf16 otherwise) so tier entries and
+                    # the onboard stack below stay uniform.
+                    kv = self._to_local_parcel(kv)
                     blocks.append((h, kv))
                     if self.host_cache is not None:
                         # Promote into the local G2 so the next hit is

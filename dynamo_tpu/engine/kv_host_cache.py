@@ -11,8 +11,10 @@ instead of recomputing the prefill.
 Blocks are keyed by the chained block hash (llm/tokens.py), so a block's
 content is immutable for its key: tiers never need invalidation, only
 capacity eviction (LRU). Entries are canonical-nkv host arrays
-[2, L, Nkv, page, D] (bf16), portable across tp configurations like the
-disaggregation parcels.
+[2, L, Nkv, page, D] bf16 — or, with ``--quant-kv int8``, the packed
+int8+scales parcel [2, L, Nkv, page, D+4] uint8 (engine/kv_quant.py) at
+~half the bytes, i.e. ~2x blocks per GB of tier budget. Both forms are
+portable across tp configurations like the disaggregation parcels.
 """
 
 from __future__ import annotations
@@ -69,8 +71,10 @@ class DiskKVCache:
         path = os.path.join(self.dir, f"{block_hash & (2**64 - 1):016x}.npy")
         self.puts += 1
         self.block_nbytes = kv.nbytes
-        # View bf16 as uint16 for npy portability.
-        np.save(path, kv.view(np.uint16))
+        # View bf16 as uint16 for npy portability; packed int8+scales
+        # parcels (uint8, --quant-kv — engine/kv_quant.py) save natively
+        # at ~half the bytes.
+        np.save(path, kv if kv.dtype == np.uint8 else kv.view(np.uint16))
         evicted: list[str] = []
         with self._lock:
             self._index[block_hash] = path
@@ -91,7 +95,9 @@ class DiskKVCache:
             self.misses += 1
             return None
         try:
-            arr = np.load(path).view(ml_dtypes.bfloat16)
+            arr = np.load(path)
+            if arr.dtype == np.uint16:  # bf16 stored as uint16
+                arr = arr.view(ml_dtypes.bfloat16)
         except (OSError, ValueError):
             with self._lock:
                 self._index.pop(block_hash, None)

@@ -29,6 +29,11 @@ class KvMetricsUpdater:
             "sequences")
         self.g_cached_blocks = registry.gauge(
             "kv_cached_blocks", "Registered (reusable) KV blocks in HBM")
+        self.g_pool_bytes = registry.gauge(
+            "kv_pool_bytes", "Device KV pool bytes at the ACTUAL pool "
+            "dtype (int8 pages + scales under --quant-kv, bf16 "
+            "otherwise) — halves when the pool quantizes, while kv_pages "
+            "doubles at equal HBM budget")
         self.c_reuse = registry.counter(
             "kv_reuse_blocks_total", "Prefix blocks reused instead of "
             "recomputed, by serving tier", ["tier"])
@@ -70,6 +75,7 @@ class KvMetricsUpdater:
         for tier in ("hbm", "host", "peer"):
             self.c_reuse.ensure(tier=tier)
         for bound in (self.g_occupancy, self.g_cached_blocks,
+                      self.g_pool_bytes,
                       self.c_reuse_lookup, self.c_evicted, self.c_cleared,
                       self.c_plane_pulls, self.c_plane_pull_seconds,
                       self.c_plane_blocks_served):
@@ -96,6 +102,9 @@ class KvMetricsUpdater:
         self.g_pages.set(alloc["pages_inactive"], state="inactive")
         self.g_occupancy.set(alloc["occupancy"])
         self.g_cached_blocks.set(alloc["cached_blocks"])
+        runner = getattr(engine, "runner", None)
+        if runner is not None:
+            self.g_pool_bytes.set(getattr(runner, "kv_pool_bytes", 0))
         self._delta(self.c_reuse_lookup, ("lookup",),
                     alloc["reuse_lookup_blocks"])
         self._delta(self.c_evicted, ("evicted",), alloc["evicted_blocks"])

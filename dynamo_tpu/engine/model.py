@@ -19,6 +19,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from dynamo_tpu.engine.config import ModelSpec
+from dynamo_tpu.engine.kv_quant import (gather_pages, scatter_pages,
+                                        scatter_tokens)
 from dynamo_tpu.engine.quant import QTensor
 
 Params = dict[str, Any]
@@ -412,9 +414,10 @@ def paged_window_attention_xla(q: jax.Array, k_cache: jax.Array,
     maxp = page_table.shape[1]
     M = k_win.shape[2]
     idx_l = jnp.broadcast_to(layer, page_table.shape)
-    k_all = (k_cache[idx_l, :, page_table]
+    # gather_pages dequantizes int8 pools inside the gather expression.
+    k_all = (gather_pages(k_cache, idx_l, page_table)
              .transpose(2, 0, 1, 3, 4).reshape(nkv, b, maxp * page, d))
-    v_all = (v_cache[idx_l, :, page_table]
+    v_all = (gather_pages(v_cache, idx_l, page_table)
              .transpose(2, 0, 1, 3, 4).reshape(nkv, b, maxp * page, d))
     qg = q.reshape(b, nkv, q_per_kv, d)
     scale = 1.0 / jnp.sqrt(jnp.float32(d))
@@ -520,8 +523,9 @@ def prefill_forward(params: Params, spec: ModelSpec,
     v_blocks = (v_new.reshape(L, b * (s // page), page, nkv, d)
                 .transpose(0, 3, 1, 2, 4))
     flat_pages = page_table.reshape(-1)
-    k_cache = k_cache.at[:, :, flat_pages].set(k_blocks)
-    v_cache = v_cache.at[:, :, flat_pages].set(v_blocks)
+    # scatter_pages quantizes int8 pools in the same fused commit.
+    k_cache = scatter_pages(k_cache, k_blocks, flat_pages)
+    v_cache = scatter_pages(v_cache, v_blocks, flat_pages)
     x = rms_norm(x, params["final_norm"], spec.rms_norm_eps)
     # Last valid token per sequence.
     last_idx = jnp.maximum(seq_lens - 1, 0)
@@ -672,8 +676,9 @@ def prefill_forward_pipelined(params: Params, spec: ModelSpec,
     v_blocks = (v_new.reshape(L, B * (s // page), page, nkv, d)
                 .transpose(0, 3, 1, 2, 4))
     flat_pages = page_table.reshape(-1)
-    k_cache = k_cache.at[:, :, flat_pages].set(k_blocks)
-    v_cache = v_cache.at[:, :, flat_pages].set(v_blocks)
+    # scatter_pages quantizes int8 pools in the same fused commit.
+    k_cache = scatter_pages(k_cache, k_blocks, flat_pages)
+    v_cache = scatter_pages(v_cache, v_blocks, flat_pages)
 
     x = xout[:G].reshape(B, s, -1)
     x = rms_norm(x, params["final_norm"], spec.rms_norm_eps)
@@ -746,10 +751,10 @@ def decode_forward(params: Params, spec: ModelSpec,
     x, (k_new, v_new) = jax.lax.scan(
         layer_fn, x, (params["layers"], jnp.arange(L)))
     # One in-place scatter: [L,Nkv,B,D] at (dest_page[b], page_off[b]).
-    k_cache = k_cache.at[:, :, dest_page, page_off].set(
-        k_new.transpose(0, 2, 1, 3))
-    v_cache = v_cache.at[:, :, dest_page, page_off].set(
-        v_new.transpose(0, 2, 1, 3))
+    k_cache = scatter_tokens(k_cache, k_new.transpose(0, 2, 1, 3),
+                             dest_page, page_off)
+    v_cache = scatter_tokens(v_cache, v_new.transpose(0, 2, 1, 3),
+                             dest_page, page_off)
     x = rms_norm(x, params["final_norm"], spec.rms_norm_eps)
     logits = lm_logits(x, params, spec)
     return logits, k_cache, v_cache
@@ -802,9 +807,9 @@ def decode_window_multi_step(params: Params, spec: ModelSpec,
         qg = q.reshape(b, s, nkv, spec.q_per_kv, d)
         # Paged history (layer-folded gather, same as the window impl).
         idx_l = jnp.broadcast_to(layer, page_table.shape)
-        k_all = (k_cache[idx_l, :, page_table]
+        k_all = (gather_pages(k_cache, idx_l, page_table)
                  .transpose(2, 0, 1, 3, 4).reshape(nkv, b, maxp * page, d))
-        v_all = (v_cache[idx_l, :, page_table]
+        v_all = (gather_pages(v_cache, idx_l, page_table)
                  .transpose(2, 0, 1, 3, 4).reshape(nkv, b, maxp * page, d))
         s_hist = jnp.einsum("bsngd,nbld->bnsgl", qg, k_all,
                             preferred_element_type=jnp.float32) * scale

@@ -42,13 +42,24 @@ QUANT_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
                     "moe_w_gate", "moe_w_up", "moe_w_down")
 
 
+def _safe_scale(amax: np.ndarray) -> np.ndarray:
+    """amax/127 with two guards: all-zero channels take s=1 (exact
+    round trip), and channels near float32-max step s DOWN one ulp when
+    the division rounded up — otherwise the saturated code dequantizes
+    to 127*s = inf (caught by the max-magnitude edge-case test)."""
+    s = (amax / 127.0).astype(np.float32)
+    s = np.where(s == 0.0, np.float32(1.0), s)
+    with np.errstate(over="ignore"):
+        over = ~np.isfinite(np.float32(127.0) * s)
+    return np.where(over, np.nextafter(s, np.float32(0.0)), s)
+
+
 def quantize_weight(w: np.ndarray) -> QTensor:
     """Symmetric per-out-channel int8 over the last axis (reduce over the
     contraction axis -2). Host-side, float32 math."""
     wf = np.asarray(w, np.float32)
     amax = np.max(np.abs(wf), axis=-2, keepdims=True)
-    s = (amax / 127.0).astype(np.float32)
-    s = np.where(s == 0.0, 1.0, s)
+    s = _safe_scale(amax)
     q = np.clip(np.rint(wf / s), -127, 127).astype(np.int8)
     return QTensor(q=q, s=s)
 
@@ -59,8 +70,7 @@ def quantize_embedding(w: np.ndarray) -> QTensor:
     (scale folds into the activations before the contraction)."""
     wf = np.asarray(w, np.float32)
     amax = np.max(np.abs(wf), axis=0, keepdims=True)
-    s = (amax / 127.0).astype(np.float32)
-    s = np.where(s == 0.0, 1.0, s)
+    s = _safe_scale(amax)
     q = np.clip(np.rint(wf / s), -127, 127).astype(np.int8)
     return QTensor(q=q, s=s)
 

@@ -37,6 +37,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dynamo_tpu.engine import perf
 from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.kv_quant import (KV_SCALE_BYTES, QuantKV, pack_parcel,
+                                        parcel_to_bf16, quantize_np,
+                                        scatter_tokens, unpack_parcel)
 from dynamo_tpu.engine.model import (
     dense_causal_attention,
     init_params,
@@ -142,6 +145,13 @@ class ModelRunner:
                  devices: list | None = None, seed: int = 0):
         self.config = config
         spec = config.model
+        # KV-pool quantization (engine/kv_quant.py): resolved ONCE here —
+        # pool sizing, allocation, parcels and the HBM ledger all key off
+        # this field.
+        self.quant_kv = config.resolve_quant_kv()
+        if self.quant_kv not in (None, "int8"):
+            raise ValueError(
+                f"quant_kv must be None or 'int8', got {self.quant_kv!r}")
         # TP feasibility + KV-head replication (the role of vLLM's KV-head
         # replication for tp > num_kv_heads): each canonical KV head is
         # duplicated tp/nkv times so the cache's head axis shards evenly
@@ -235,15 +245,37 @@ class ModelRunner:
         self.kv_sharding = NamedSharding(self.mesh, kv_spec)
         kv_shape = (spec.num_layers, spec.num_kv_heads, self.num_pages,
                     config.page_size, spec.head_dim)
-        self.k_cache = _mh_zeros(kv_shape, jnp.bfloat16, self.kv_sharding)
-        self.v_cache = _mh_zeros(kv_shape, jnp.bfloat16, self.kv_sharding)
+        if self.quant_kv == "int8":
+            # int8 pages + per-token-per-head f32 scales (zero-init: an
+            # unwritten page dequantizes to 0, same as the bf16 pool;
+            # every real write goes through kv_quantize, whose scales
+            # are never 0).
+            scale_sharding = NamedSharding(self.mesh,
+                                           P("pp", "tp", None, None))
+            self.k_cache = QuantKV(
+                _mh_zeros(kv_shape, jnp.int8, self.kv_sharding),
+                _mh_zeros(kv_shape[:-1], jnp.float32, scale_sharding))
+            self.v_cache = QuantKV(
+                _mh_zeros(kv_shape, jnp.int8, self.kv_sharding),
+                _mh_zeros(kv_shape[:-1], jnp.float32, scale_sharding))
+        else:
+            self.k_cache = _mh_zeros(kv_shape, jnp.bfloat16,
+                                     self.kv_sharding)
+            self.v_cache = _mh_zeros(kv_shape, jnp.bfloat16,
+                                     self.kv_sharding)
         # Byte ledgers for the perf plane's HBM breakdown (/debug/perf):
         # this process's per-device share of params and the KV pool —
         # workspace is whatever memory_stats says is in use beyond them.
+        # The KV ledger reports the ACTUAL pool dtype bytes (int8 + scale
+        # vs bf16), so workspace attribution never silently absorbs the
+        # quantization savings.
         per_weight = 1 if spec.quant == "int8" else 2
         shard = max(1, config.tp * config.pp)
         self.param_bytes = spec.num_params() * per_weight // shard
-        self.kv_pool_bytes = (2 * int(np.prod(kv_shape)) * 2) // shard
+        self.kv_pool_bytes = (
+            2 * self.num_pages * config.page_size
+            * self._kv_token_head_bytes() * spec.num_layers
+            * spec.num_kv_heads) // shard
 
         self._prefill_cache: dict = {}
         self._decode_fn = None
@@ -295,6 +327,12 @@ class ModelRunner:
             self._pick_attention()
 
     # -- setup ---------------------------------------------------------------
+    def _kv_token_head_bytes(self) -> int:
+        """Pool bytes per (layer, kv-head, token): bf16 values, or int8
+        values + the f32 scale (engine/kv_quant.py)."""
+        d = self.spec.head_dim
+        return (d + KV_SCALE_BYTES) if self.quant_kv == "int8" else 2 * d
+
     def _sized_pages(self, device) -> None:
         cfg = self.config
         if cfg.num_pages is not None:
@@ -312,9 +350,12 @@ class ModelRunner:
         param_bytes = (self.spec.num_params() * per_weight
                        // max(1, cfg.tp * cfg.pp))
         budget = max(64 << 20, int((free - param_bytes) * cfg.hbm_kv_budget_frac))
-        # The cache shards over tp (heads) AND pp (layers).
-        page_bytes = (self.spec.kv_bytes_per_token() * cfg.page_size
-                      // max(1, cfg.tp * cfg.pp))
+        # The cache shards over tp (heads) AND pp (layers). int8 pages
+        # (+ scales) cost ~half the bf16 bytes, so the same budget holds
+        # ~2x pages — directly more resident sequences per chip.
+        token_bytes = (2 * self.spec.num_layers * self.spec.num_kv_heads
+                       * self._kv_token_head_bytes())
+        page_bytes = token_bytes * cfg.page_size // max(1, cfg.tp * cfg.pp)
         self.num_pages = max(16, budget // max(1, page_bytes))
         log.info("KV pool: %d pages of %d tokens (%.1f GiB)", self.num_pages,
                  cfg.page_size, self.num_pages * page_bytes / (1 << 30))
@@ -618,10 +659,11 @@ class ModelRunner:
             dest = jnp.where(live_m, dest, 0)
             off = jnp.where(live_m, pos_m % page, 0)
             # kbuf [L,Nkv,B,M,D] -> [L,Nkv,M,B,D] matching index arrays.
-            k_cache = k_cache.at[:, :, dest, off].set(
-                kbuf.transpose(0, 1, 3, 2, 4))
-            v_cache = v_cache.at[:, :, dest, off].set(
-                vbuf.transpose(0, 1, 3, 2, 4))
+            # scatter_tokens quantizes int8 pools inside the same commit.
+            k_cache = scatter_tokens(k_cache, kbuf.transpose(0, 1, 3, 2, 4),
+                                     dest, off)
+            v_cache = scatter_tokens(v_cache, vbuf.transpose(0, 1, 3, 2, 4),
+                                     dest, off)
             if penalized:
                 return (toks, lps, top_vs, top_is, tokens, k_cache,
                         v_cache, rng, counts_out)
@@ -747,8 +789,8 @@ class ModelRunner:
             dest = jnp.take_along_axis(page_table, pidx, axis=1)
             dest = jnp.where(valid, dest, 0)
             off = jnp.where(valid, abspos % page, 0)
-            k_cache = k_cache.at[:, :, dest, off].set(kbuf)
-            v_cache = v_cache.at[:, :, dest, off].set(vbuf)
+            k_cache = scatter_tokens(k_cache, kbuf, dest, off)
+            v_cache = scatter_tokens(v_cache, vbuf, dest, off)
             return (outs, emits, ndrafts, tokens, pos, hist,
                     k_cache, v_cache)
 
@@ -1080,6 +1122,13 @@ class ModelRunner:
         fn = self._window_cache.get(key)
         if fn is None:
             def gather(k_cache, v_cache, pages):
+                if isinstance(k_cache, QuantKV):
+                    # Compressed extract: (data int8, scale f32) — packed
+                    # into the uint8 wire parcel host-side.
+                    return (jnp.stack([k_cache.data[:, :, pages],
+                                       v_cache.data[:, :, pages]]),
+                            jnp.stack([k_cache.scale[:, :, pages],
+                                       v_cache.scale[:, :, pages]]))
                 return jnp.stack([k_cache[:, :, pages], v_cache[:, :, pages]])
             if jax.process_count() > 1:
                 # Multi-controller: the pool shards over (pp, tp) across
@@ -1100,10 +1149,20 @@ class ModelRunner:
         key = ("insert", n)
         fn = self._window_cache.get(key)
         if fn is None:
-            def scatter(k_cache, v_cache, kv, pages):
-                k_cache = k_cache.at[:, :, pages].set(kv[0])
-                v_cache = v_cache.at[:, :, pages].set(kv[1])
-                return k_cache, v_cache
+            if self.quant_kv == "int8":
+                def scatter(k_cache, v_cache, kvq, kvs, pages):
+                    k_cache = QuantKV(
+                        k_cache.data.at[:, :, pages].set(kvq[0]),
+                        k_cache.scale.at[:, :, pages].set(kvs[0]))
+                    v_cache = QuantKV(
+                        v_cache.data.at[:, :, pages].set(kvq[1]),
+                        v_cache.scale.at[:, :, pages].set(kvs[1]))
+                    return k_cache, v_cache
+            else:
+                def scatter(k_cache, v_cache, kv, pages):
+                    k_cache = k_cache.at[:, :, pages].set(kv[0])
+                    v_cache = v_cache.at[:, :, pages].set(kv[1])
+                    return k_cache, v_cache
             fn = perf.instrumented_jit("insert", scatter, key=key,
                                        donate_argnums=(0, 1))
             self._window_cache[key] = fn
@@ -1189,14 +1248,25 @@ class ModelRunner:
         # full-parcel D2H copies would fight the offload path for host
         # bandwidth.
         if jax.process_index() == 0:
-            try:
-                out.copy_to_host_async()
-            except Exception:  # noqa: BLE001
-                pass
+            for leaf in (out if isinstance(out, tuple) else (out,)):
+                try:
+                    leaf.copy_to_host_async()
+                except Exception:  # noqa: BLE001
+                    pass
         return out, n
 
     def finalize_extract(self, handle) -> np.ndarray:
         out, n = handle
+        if isinstance(out, tuple):
+            # Quantized pool: pack (data, scale) into the uint8 parcel
+            # (engine/kv_quant.py wire format) — ~half the bf16 bytes on
+            # every tier/wire path downstream.
+            data = np.asarray(jax.device_get(out[0]))[:, :, :, :n]
+            scale = np.asarray(jax.device_get(out[1]))[:, :, :, :n]
+            if self.kv_rep > 1:
+                data = data[:, :, ::self.kv_rep]
+                scale = scale[:, :, ::self.kv_rep]
+            return pack_parcel(data, scale)
         out = np.asarray(jax.device_get(out))[:, :, :, :n]
         if self.kv_rep > 1:
             out = out[:, :, ::self.kv_rep]
@@ -1204,17 +1274,22 @@ class ModelRunner:
 
     def extract_pages(self, pages: list[int]) -> np.ndarray:
         """Gather the given pages' K/V to host: [2, L, Nkv, n, page, D]
-        (bf16, canonical heads — replicas deduplicated so parcels are
-        portable across tp configurations). The disaggregation data
-        plane's source side (role of the reference's NIXL reads,
-        host-staged v0 — SURVEY.md §5.8)."""
+        bf16, or with --quant-kv the PACKED int8+scales parcel
+        [2, L, Nkv, n, page, D+4] uint8 at ~half the bytes (canonical
+        heads either way — replicas deduplicated so parcels are portable
+        across tp configurations). The disaggregation data plane's
+        source side (role of the reference's NIXL reads, host-staged v0
+        — SURVEY.md §5.8)."""
         return self.finalize_extract(self.extract_pages_async(pages))
 
     def insert_pages(self, kv: np.ndarray, pages: list[int]) -> None:
-        """Write transferred K/V pages into this runner's cache. kv
-        [2, L, Nkv, n, page, D]; the mesh re-shards on upload, so
-        TP-mismatched prefill->decode transfers work without a transpose
-        kernel (the role of block_copy.cu)."""
+        """Write transferred K/V pages into this runner's cache. kv is a
+        bf16 parcel [2, L, Nkv, n, page, D] or a PACKED int8+scales
+        parcel [2, L, Nkv, n, page, D+4] uint8 (engine/kv_quant.py);
+        either form converts to this runner's pool dtype on upload, so
+        mixed bf16/int8 fleets interoperate. The mesh re-shards on
+        upload, so TP-mismatched prefill->decode transfers work without
+        a transpose kernel (the role of block_copy.cu)."""
         n = len(pages)
         assert kv.shape[3] == n, (kv.shape, n)
         if kv.shape[2] == self.canonical_nkv and self.kv_rep > 1:
@@ -1222,14 +1297,36 @@ class ModelRunner:
         assert kv.shape[2] == self.spec.num_kv_heads, (
             kv.shape, self.spec.num_kv_heads)
         nb = self._page_bucket(n)
+        idx = np.zeros(nb, np.int32)
+        idx[:n] = pages
+        if self.quant_kv == "int8":
+            if kv.dtype == np.uint8:
+                data, scale = unpack_parcel(kv)
+            else:
+                # bf16 parcel from an unquantized peer: quantize host-side
+                # (numpy twin of the in-graph kv_quantize — same rounding).
+                data, scale = quantize_np(kv)
+            if nb != n:
+                # Pad toward the scratch page target (duplicate scatters
+                # to page 0 are unordered but all-garbage).
+                data = np.concatenate([data, np.zeros(
+                    (*data.shape[:3], nb - n, *data.shape[4:]), np.int8)],
+                    axis=3)
+                scale = np.concatenate([scale, np.zeros(
+                    (*scale.shape[:3], nb - n, scale.shape[4]),
+                    np.float32)], axis=3)
+            with self.mesh:
+                self.k_cache, self.v_cache = self._get_insert(nb)(
+                    self.k_cache, self.v_cache, jnp.asarray(data),
+                    jnp.asarray(scale), jnp.asarray(idx))
+            return
+        kv = parcel_to_bf16(kv)  # packed parcels from int8 peers dequant
         if nb != n:
             # Pad with copies of the scratch page target (duplicate scatters
             # to page 0 are unordered but all-garbage).
             pad_kv = np.zeros(
                 (*kv.shape[:3], nb - n, *kv.shape[4:]), kv.dtype)
             kv = np.concatenate([kv, pad_kv], axis=3)
-        idx = np.zeros(nb, np.int32)
-        idx[:n] = pages
         with self.mesh:
             self.k_cache, self.v_cache = self._get_insert(nb)(
                 self.k_cache, self.v_cache, jnp.asarray(kv),
@@ -1341,9 +1438,10 @@ def _prefill_with_history(params, spec, k_cache, v_cache, tokens, positions,
         # cache (hist pages are disjoint from this chunk's pages, whose
         # writes are deferred out of the scan).
         idx_l = jnp.broadcast_to(layer, hist_table.shape)
-        k_hist = (k_cache[idx_l, :, hist_table]
+        from dynamo_tpu.engine.kv_quant import gather_pages
+        k_hist = (gather_pages(k_cache, idx_l, hist_table)
                   .transpose(2, 0, 1, 3, 4).reshape(nkv, b, maxp * page, d))
-        v_hist = (v_cache[idx_l, :, hist_table]
+        v_hist = (gather_pages(v_cache, idx_l, hist_table)
                   .transpose(2, 0, 1, 3, 4).reshape(nkv, b, maxp * page, d))
         hist_scores = jnp.einsum("bqngd,nbld->bngql", qg, k_hist,
                                  preferred_element_type=jnp.float32)
@@ -1369,8 +1467,9 @@ def _prefill_with_history(params, spec, k_cache, v_cache, tokens, positions,
     v_blocks = (v_new.reshape(L, b * (s // page), page, nkv, d)
                 .transpose(0, 3, 1, 2, 4))
     flat = page_table.reshape(-1)
-    k_cache = k_cache.at[:, :, flat].set(k_blocks)
-    v_cache = v_cache.at[:, :, flat].set(v_blocks)
+    from dynamo_tpu.engine.kv_quant import scatter_pages
+    k_cache = scatter_pages(k_cache, k_blocks, flat)
+    v_cache = scatter_pages(v_cache, v_blocks, flat)
     x = rms_norm(x, params["final_norm"], spec.rms_norm_eps)
     last_idx = jnp.maximum(seq_lens - 1, 0)
     x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]

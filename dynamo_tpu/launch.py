@@ -76,6 +76,13 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument("--warmup-prefill-ladder", action="store_true",
                         help="pre-compile every prefill bucket (incl. "
                              "chunk/history variants) at startup")
+    parser.add_argument("--quant", default=None, choices=["int8"],
+                        help="weight-only int8 quantization (halves "
+                             "weight HBM reads)")
+    parser.add_argument("--quant-kv", default=None, choices=["int8"],
+                        help="int8 KV cache: ~2x pages per HBM GB, "
+                             "dequant fused into attention; composes "
+                             "with --quant (DTPU_QUANT_KV overrides)")
     parser.add_argument("--host-cache-pages", type=int, default=0)
     parser.add_argument("--kv-disk-cache-dir", default=None)
     parser.add_argument("--coordinator-url", default=None,

@@ -63,6 +63,16 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_journal.py -q -m 'not slow' -k 'smoke or chain or canary' \
     -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
 
+echo "== quant-kv smoke (int8 KV cache parity + capacity) =="
+# Tiny CPU model, --quant-kv int8 vs bf16 KV: greedy/seeded/chunked
+# golden parity gates, prefill-logit cosine, and the ~2x page-capacity
+# accounting (tests/test_kv_quant.py; docs/PERF_NOTES.md "Quantized KV
+# cache").
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_kv_quant.py -q -m 'not slow' \
+    -k 'parity or agrees or capacity or teacher' \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+
 echo "== chunked-prefill smoke (stall-free scheduling) =="
 # Tiny CPU model: one long prompt prefilling in chunks with concurrent
 # short decoders — asserts completion, decode windows interleaved between

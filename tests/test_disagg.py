@@ -104,7 +104,11 @@ class _Stack:
     pass
 
 
-async def start_stack(prefill_tp=1, decode_tp=1, max_local=8, plane=False):
+async def start_stack(prefill_tp=1, decode_tp=1, max_local=8, plane=False,
+                      engine_kw=None):
+    """``engine_kw`` (dict) forwards extra EngineConfig fields to BOTH
+    engines (e.g. quant_kv="int8" for the quantized-KV disagg e2e)."""
+    engine_kw = engine_kw or {}
     s = _Stack()
     s.coord = Coordinator()
     await s.coord.start()
@@ -123,13 +127,13 @@ async def start_stack(prefill_tp=1, decode_tp=1, max_local=8, plane=False):
         from dynamo_tpu.llm.kv_plane import KvPlaneServer
         s.plane = KvPlaneServer()
         s.plane.start()
-    s.p_engine = TPUEngine(tiny_config(tp=prefill_tp))
+    s.p_engine = TPUEngine(tiny_config(tp=prefill_tp, **engine_kw))
     p_ep = s.p_rt.namespace("test").component("prefill").endpoint("generate")
     s.p_server = await p_ep.serve_endpoint(
         make_prefill_handler(s.p_engine, plane=s.plane),
         graceful_shutdown=True)
 
-    s.d_engine = TPUEngine(tiny_config(tp=decode_tp))
+    s.d_engine = TPUEngine(tiny_config(tp=decode_tp, **engine_kw))
     pc_ep = s.d_rt.namespace("test").component("prefill").endpoint("generate")
     s.prefill_client = await pc_ep.client()
     s.disagg_cfg = await DisaggRouterConfig.from_coordinator_with_watch(

@@ -215,9 +215,17 @@ async def test_eos_stop(engine):
     # SAME computation path (cold vs cached prefill can flip bf16 near-ties).
     await collect(engine, prompt, 2)
     ref, _ = await collect(engine, prompt, 12)
-    got, finish = await collect(engine, prompt, 12, eos_token_ids=[ref[2]])
+    # Pick an EOS token whose FIRST occurrence is past index 0: the tiny
+    # model's greedy output repeats tokens (e.g. ref[0] == ref[2]), and
+    # blindly choosing ref[2] made the engine — correctly — stop at the
+    # earlier occurrence, failing the old `got == ref[:3]` assert.
+    idx = next((i for i in range(1, len(ref)) if ref[i] not in ref[:i]),
+               None)
+    if idx is None:  # degenerate all-one-token output: stop at the start
+        idx = 0
+    got, finish = await collect(engine, prompt, 12, eos_token_ids=[ref[idx]])
     assert finish == "eos"
-    assert got == ref[:3]
+    assert got == ref[:idx + 1]
 
 
 @async_test

@@ -215,6 +215,33 @@ async def test_no_provider_returns_empty(plane):
     assert hashes == [] and blocks is None
 
 
+@async_test
+async def test_quant_parcel_stage_pull_roundtrip(plane):
+    """Packed int8+scales parcels (--quant-kv, engine/kv_quant.py) ride
+    the plane as uint8 and round-trip byte-identical through stage ->
+    pull — at (D+4)/(2D) of the bf16 parcel bytes."""
+    from dynamo_tpu.engine.kv_quant import pack_parcel, unpack_parcel
+
+    server, client = plane
+    rng = np.random.default_rng(6)
+    d = 32
+    data = rng.integers(-127, 128, size=(2, 2, 2, 3, 16, d), dtype=np.int8)
+    scale = rng.random((2, 2, 2, 3, 16)).astype(np.float32)
+    kv = pack_parcel(data, scale)
+    assert kv.dtype == np.uint8
+    ticket = server.stage(kv=kv, prompt_len=48)
+    assert ticket["dtype"] == "uint8"
+    assert ticket["nbytes"] == kv.nbytes
+    bf16_nbytes = data.size * 2
+    assert kv.nbytes / bf16_nbytes == (d + 4) / (2 * d)
+    out = await client.pull(ticket)
+    assert out.dtype == np.uint8
+    np.testing.assert_array_equal(out, kv)
+    d2, s2 = unpack_parcel(out)
+    np.testing.assert_array_equal(d2, data)
+    np.testing.assert_array_equal(s2, scale)
+
+
 # ---------------------------------------------------------------------------
 # e2e: disagg over the plane
 # ---------------------------------------------------------------------------
@@ -234,6 +261,36 @@ async def test_disagg_over_plane_token_identical():
         assert s.handler.plane_client.transfers == 1
         ref = await run_agg(prompt, 10)
         assert got == ref
+    finally:
+        await stop_stack(s)
+
+
+@async_test(timeout=240)
+async def test_disagg_over_plane_quantized_kv():
+    """1P+1D with --quant-kv int8 on BOTH ends: the parcel crosses the
+    plane as the packed uint8 form at ~half the bf16 bulk bytes, and the
+    greedy output matches the quantized aggregated engine exactly."""
+    from dynamo_tpu.engine.kv_quant import KV_SCALE_BYTES
+
+    s = await start_stack(max_local=8, plane=True,
+                          engine_kw={"quant_kv": "int8"})
+    try:
+        prompt = _prompt(30, 24)
+        got = await run_request(s.caller, prompt, 10)
+        assert s.handler.remote_prefills == 1
+        assert s.handler.remote_failures == 0
+        assert s.plane.transfers == 1
+        ref = await run_agg(prompt, 10, quant_kv="int8")
+        assert got == ref
+        # Bulk bytes ≈ halved: the packed parcel is (D+4)/(2D) of bf16.
+        spec = s.p_engine.runner.spec
+        n_pages = -(-len(prompt) // s.p_engine.config.page_size)
+        bf16_bytes = (2 * spec.num_layers * spec.num_kv_heads * n_pages
+                      * s.p_engine.config.page_size * spec.head_dim * 2)
+        expected = bf16_bytes * (spec.head_dim + KV_SCALE_BYTES) \
+            // (2 * spec.head_dim)
+        assert s.plane.bytes_out == expected
+        assert s.plane.bytes_out < 0.6 * bf16_bytes
     finally:
         await stop_stack(s)
 

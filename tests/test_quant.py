@@ -56,6 +56,72 @@ def test_quantize_embedding_scale_axis():
     assert float(np.abs(deq - w).max()) <= float(qt.s.max()) / 2 + 1e-6
 
 
+def test_quantize_weight_zero_rows_and_columns():
+    """All-zero output channels take the s=1 convention (no 0/0) and
+    round-trip exactly; zero INPUT rows quantize to code 0."""
+    w = np.zeros((8, 6), np.float32)
+    w[:, :3] = np.linspace(-1, 1, 24).reshape(8, 3)  # cols 3..5 all-zero
+    w[0, :] = 0.0
+    qt = quantize_weight(w)
+    assert np.all(qt.s[:, 3:] == 1.0)
+    assert np.all(qt.q[:, 3:] == 0)
+    assert np.all(qt.q[0] == 0)
+    deq = qt.q.astype(np.float32) * qt.s
+    np.testing.assert_array_equal(deq[:, 3:], 0.0)
+    assert float(np.abs(deq - w).max()) <= float(qt.s.max()) / 2 + 1e-6
+
+
+def test_quantize_weight_near_subnormal_scales():
+    """Channels of ~1e-38 magnitude produce near-subnormal scales; the
+    round trip must stay finite and within half a step (no inf/nan from
+    the division, no flush-to-zero surprises)."""
+    rng = np.random.default_rng(3)
+    w = (rng.standard_normal((32, 8)) * 1e-38).astype(np.float32)
+    qt = quantize_weight(w)
+    assert np.all(np.isfinite(qt.s)) and np.all(qt.s > 0)
+    deq = qt.q.astype(np.float32) * qt.s
+    assert np.all(np.isfinite(deq))
+    assert float(np.abs(deq - w).max()) <= float(qt.s.max()) / 2 + 1e-40
+    # Exactly-subnormal inputs likewise never divide by zero.
+    tiny = np.full((4, 2), np.float32(1e-45))
+    qtt = quantize_weight(tiny)
+    assert np.all(np.isfinite(qtt.q.astype(np.float32) * qtt.s))
+
+
+def test_quantize_weight_max_magnitude_values():
+    """float32-max magnitudes must not overflow: scale = amax/127, codes
+    saturate at +-127, and the extreme value round-trips to itself."""
+    fmax = np.finfo(np.float32).max
+    w = np.zeros((4, 3), np.float32)
+    w[0, 0] = fmax
+    w[1, 1] = -fmax
+    w[2, 2] = fmax / 2
+    qt = quantize_weight(w)
+    assert np.all(np.isfinite(qt.s))
+    assert qt.q[0, 0] == 127 and qt.q[1, 1] == -127
+    deq = qt.q.astype(np.float32) * qt.s
+    assert np.all(np.isfinite(deq))
+    np.testing.assert_allclose(deq[0, 0], fmax, rtol=1e-6)
+
+
+def test_quantize_embedding_edge_cases():
+    """Same three edges on the per-hidden-channel embedding quantizer:
+    zero rows/channels, near-subnormal and max-magnitude columns."""
+    fmax = np.finfo(np.float32).max
+    w = np.zeros((6, 4), np.float32)
+    w[1, 0] = fmax            # max-magnitude channel
+    w[2, 1] = np.float32(1e-38)  # near-subnormal channel
+    # channels 2,3 all-zero; row 0 all-zero
+    qt = quantize_embedding(w)
+    assert np.all(np.isfinite(qt.s)) and np.all(qt.s > 0)
+    assert np.all(qt.s[0, 2:] == 1.0) and np.all(qt.q[:, 2:] == 0)
+    assert np.all(qt.q[0] == 0)
+    deq = qt.q.astype(np.float32) * qt.s
+    assert np.all(np.isfinite(deq))
+    np.testing.assert_allclose(deq[1, 0], fmax, rtol=1e-6)
+    assert abs(deq[2, 1] - 1e-38) <= float(qt.s[0, 1]) / 2
+
+
 def test_quantize_params_leaves():
     from dynamo_tpu.engine.model import init_params
     import jax
