@@ -44,6 +44,14 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument("--block-size", type=int, default=16)
     parser.add_argument("--max-num-seqs", type=int, default=64)
     parser.add_argument("--speedup-ratio", type=float, default=1.0)
+    parser.add_argument("--host-blocks", type=int, default=0,
+                        help="simulated host (G2) tier capacity: evicted "
+                             "blocks demote here, stay in the inventory "
+                             "digest, and serve peer pulls over the KV "
+                             "plane (federation testing without TPUs)")
+    parser.add_argument("--kv-plane", action="store_true",
+                        help="run a KV plane server + G4 remote source "
+                             "on this mocker (peer block pulls)")
     parser.add_argument("--migration-limit", type=int, default=0)
     parser.add_argument("--coordinator-url", default=None)
     parser.add_argument("--mode", default="agg", choices=list(ROLES),
@@ -100,7 +108,8 @@ async def run(args: argparse.Namespace) -> None:
                      else make_test_tokenizer())
         mocker_cfg = MockerConfig(
             num_kv_blocks=args.num_kv_blocks, block_size=args.block_size,
-            max_num_seqs=args.max_num_seqs, speedup_ratio=args.speedup_ratio)
+            max_num_seqs=args.max_num_seqs, speedup_ratio=args.speedup_ratio,
+            host_blocks=args.host_blocks)
         ns = cfg.namespace
         kv_pub = KvEventPublisher(runtime, ns, args.component,
                                   runtime.instance_id)
@@ -111,6 +120,41 @@ async def run(args: argparse.Namespace) -> None:
         engine = MockerEngine(mocker_cfg, kv_pub, metrics_pub,
                               inventory_publisher=inventory_pub)
         inventory_pub.start_periodic(engine.inventory_digest)
+        plane = None
+        peer_watch_task = None
+        if args.kv_plane:
+            # Same kvplane/ registration + peer-watch contract as the
+            # TPU worker (backends/tpu.py), mocker-scale: the plane
+            # serves this worker's sim blocks, the remote source pulls
+            # peers' — KV federation end to end with zero TPUs.
+            from dynamo_tpu.llm.kv_plane import (KvPlaneServer,
+                                                 RemoteBlockSource)
+            plane = KvPlaneServer(block_provider=engine.host_block_provider)
+            plane.start()
+            coordinator = runtime.require_coordinator()
+            await coordinator.kv_put(
+                f"kvplane/{ns}/{runtime.instance_id:x}",
+                {"addr": plane.address, "model": args.model_name},
+                lease_id=coordinator.primary_lease_id)
+            engine.remote_source = RemoteBlockSource(self_addr=plane.address)
+
+            async def watch_peers() -> None:
+                watch = await coordinator.watch_prefix(f"kvplane/{ns}/")
+                peers = {item["k"]: item["v"]["addr"]
+                         for item in watch.snapshot
+                         if item["v"].get("model") == args.model_name}
+                engine.remote_source.peers = [
+                    a for a in peers.values() if a != plane.address]
+                async for event in watch:
+                    if event["event"] == "put" and \
+                            event["value"].get("model") == args.model_name:
+                        peers[event["key"]] = event["value"]["addr"]
+                    elif event["event"] == "delete":
+                        peers.pop(event["key"], None)
+                    engine.remote_source.peers = [
+                        a for a in peers.values() if a != plane.address]
+
+            peer_watch_task = asyncio.create_task(watch_peers())
         # Decision plane: this worker's journal (role flips, preempts,
         # breaker views) rides the event plane into the frontend's
         # merged /debug/timeline.
@@ -154,6 +198,10 @@ async def run(args: argparse.Namespace) -> None:
         await runtime.wait_for_shutdown()
         journal_pub.stop_periodic()
         inventory_pub.stop_periodic()
+        if peer_watch_task is not None:
+            peer_watch_task.cancel()
+        if plane is not None:
+            plane.close()
         await engine.stop()
         if status_server is not None:
             await status_server.stop()

@@ -119,6 +119,14 @@ def parse_args(argv=None) -> argparse.Namespace:
                              "offload here and onboard on prefix hits")
     parser.add_argument("--kv-disk-cache-dir", default=None,
                         help="G3 disk tier directory behind the host cache")
+    parser.add_argument("--kv-watermarks", default=None,
+                        help="KVBM proactive demotion watermarks "
+                             "'low,high' as fractions of the HBM pool "
+                             "free list (engine/kvbm.py): below low, LRU "
+                             "inactive blocks demote to the host tier "
+                             "until high (hysteresis); needs "
+                             "--host-cache-pages (DTPU_KV_WATERMARKS "
+                             "overrides)")
     parser.add_argument("--spec-decode", default=None, choices=["ngram"],
                         help="speculative decoding: 'ngram' = prompt-"
                              "lookup self-drafting verified in-window "
@@ -220,12 +228,26 @@ def build_engine_config(args) -> EngineConfig:
         quant_kv=getattr(args, "quant_kv", None),
         host_cache_pages=args.host_cache_pages,
         kv_disk_cache_dir=args.kv_disk_cache_dir,
+        kv_demote_low_watermark=_watermark_arg(
+            getattr(args, "kv_watermarks", None))[0],
+        kv_demote_high_watermark=_watermark_arg(
+            getattr(args, "kv_watermarks", None))[1],
         spec_decode=getattr(args, "spec_decode", None),
         spec_k=getattr(args, "spec_k", 3),
         ttft_budget_ms=getattr(args, "ttft_budget_ms", None),
         admission_reject_factor=(
             getattr(args, "admission_reject_factor", 0.0)
             if getattr(args, "ttft_budget_ms", None) else 0.0))
+
+
+def _watermark_arg(value) -> tuple[float, float]:
+    """Parse --kv-watermarks 'low[,high]' (None -> disabled)."""
+    if not value:
+        return 0.0, 0.0
+    parts = [p for p in str(value).replace(",", " ").split() if p]
+    low = float(parts[0])
+    high = float(parts[1]) if len(parts) > 1 else 0.0
+    return low, high
 
 
 def _window_arg(value) -> int | str:

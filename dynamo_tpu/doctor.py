@@ -391,6 +391,75 @@ async def check_fleet_kv(rep: Report, url: str) -> None:
         rep.add(FAIL, "fleet kv pane", f"{url}: {exc}")
 
 
+async def check_kv_federation(rep: Report, url: str) -> None:
+    """KV federation (docs/OBSERVABILITY.md "KV federation"): is the
+    router scoring with inventory overlap, and is the tier/peer plane
+    healthy? WARNs when federation is off, when peer breakers are
+    open, and when the tier walk keeps falling back to recompute."""
+    import aiohttp
+    url = url.rstrip("/")
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"{url}/debug/kv",
+                                   timeout=aiohttp.ClientTimeout(5)) as r:
+                if r.status == 404:
+                    rep.add(WARN, "kv federation",
+                            "frontend has no KV pane (round_robin/random "
+                            "router): federated routing inactive")
+                    return
+                if r.status != 200:
+                    rep.add(FAIL, "kv federation", f"HTTP {r.status}")
+                    return
+                body = await r.json()
+            for model, view in (body.get("routers") or {}).items():
+                if view.get("federation") is False:
+                    rep.add(WARN, f"federation {model}",
+                            "inventory-overlap scoring DISABLED "
+                            "(--no-kv-federation): prefixes cached in "
+                            "peer tiers recompute locally")
+                else:
+                    fleet_view = view.get("fleet") or {}
+                    totals = fleet_view.get("totals") or {}
+                    rep.add(OK, f"federation {model}",
+                            f"{totals.get('workers', 0)} inventories, "
+                            f"{totals.get('blocks', 0)} fleet blocks, "
+                            f"{totals.get('stale', 0)} stale digests")
+            async with session.get(f"{url}/debug/fleet",
+                                   timeout=aiohttp.ClientTimeout(15)) as r:
+                if r.status != 200:
+                    return
+                fleet = await r.json()
+            for worker, res in (fleet.get("workers") or {}).items():
+                kv = res.get("kv") if res.get("ok") else None
+                if not isinstance(kv, dict):
+                    continue
+                kvbm = kv.get("kvbm") or {}
+                remote = kv.get("remote") or {}
+                open_breakers = remote.get("breakers_open", 0)
+                if open_breakers:
+                    rep.add(WARN, f"peer tier {worker}",
+                            f"{open_breakers} peer breaker(s) open "
+                            f"({remote.get('fetch_failures', 0)} pull "
+                            "failures): cross-worker reuse degraded")
+                fallbacks = kvbm.get("recompute_fallbacks", 0)
+                promotions = kvbm.get("promotions", 0)
+                if fallbacks > max(10, 3 * max(1, promotions)):
+                    rep.add(WARN, f"kvbm {worker}",
+                            f"{fallbacks} tier-walk recompute fallbacks "
+                            f"vs {promotions} promotions: the ladder "
+                            "rarely holds what requests need (budget or "
+                            "watermark tuning?)")
+                elif kvbm:
+                    rep.add(OK, f"kvbm {worker}",
+                            f"{kvbm.get('watermark_demotions', 0)} "
+                            "watermark demotions, "
+                            f"{promotions} promotions, "
+                            f"{kvbm.get('peer_pull_blocks', 0)} peer "
+                            "blocks pulled")
+    except (aiohttp.ClientError, OSError, asyncio.TimeoutError) as exc:
+        rep.add(FAIL, "kv federation", f"{url}: {exc}")
+
+
 def _perf_views(body: dict, fleet: dict | None) -> list[tuple[str, dict]]:
     """Flatten one /debug/perf body (+ optional /debug/fleet per-worker
     perf views) into named engine-grade views to judge."""
@@ -581,6 +650,7 @@ async def run(args) -> int:
         await check_frontend(rep, args.frontend_url)
         await check_observability(rep, args.frontend_url)
         await check_fleet_kv(rep, args.frontend_url)
+        await check_kv_federation(rep, args.frontend_url)
         await check_perf(rep, args.frontend_url)
         await check_timeline(rep, args.frontend_url)
     n_fail = sum(1 for s, _, _ in rep.rows if s == FAIL)

@@ -236,6 +236,15 @@ class EngineConfig:
     host_cache_pages: int = 0
     kv_disk_cache_dir: str | None = None
     disk_cache_pages: int = 4096
+    # KVBM placement policy (engine/kvbm.py): with a low watermark set,
+    # the engine proactively demotes LRU inactive blocks to the host
+    # tier whenever the HBM free list drops below low_watermark of the
+    # pool, stopping at high_watermark (hysteresis; 0 = demote only
+    # under allocation pressure, the pre-KVBM behavior). Needs
+    # host_cache_pages > 0 to have somewhere to demote to. Env
+    # DTPU_KV_WATERMARKS="low,high" overrides both.
+    kv_demote_low_watermark: float = 0.0
+    kv_demote_high_watermark: float = 0.0
     # Speculative decoding (reference SpecDecodeStats protocols.rs:32-56;
     # the reference delegates spec decode to its engines — here the
     # engine IS ours). "ngram" = prompt-lookup self-drafting: the window
@@ -288,6 +297,20 @@ class EngineConfig:
             env = env.strip().lower()
             return None if env in ("", "none", "off", "bf16") else env
         return self.quant_kv
+
+    def kvbm_policy(self):
+        """The KVBM tier policy for this config (engine/kvbm.py), with
+        the DTPU_KV_WATERMARKS="low,high" env override applied (same
+        layering as the other engine knobs)."""
+        from dynamo_tpu.engine.kvbm import KvbmPolicy
+        low, high = (self.kv_demote_low_watermark,
+                     self.kv_demote_high_watermark)
+        env = os.environ.get("DTPU_KV_WATERMARKS")
+        if env:
+            parts = [p for p in env.replace(",", " ").split() if p]
+            low = float(parts[0])
+            high = float(parts[1]) if len(parts) > 1 else 0.0
+        return KvbmPolicy(low_watermark=low, high_watermark=high)
 
     def kv_token_bytes(self) -> int:
         """Per-token bytes in the device KV pool (k+v, all layers/heads):

@@ -148,14 +148,19 @@ class TPUEngine(AsyncEngine):
             capacity = config.host_cache_pages or 16
             self.host_cache = HostKVCache(capacity, disk)
             self.allocator.evict_hook = self._on_evict
+        # KVBM (engine/kvbm.py): the placement/eviction POLICY across
+        # HBM -> host -> disk -> peer as one auditable object — watermark
+        # demotion, pinning, promote-on-hit accounting, the G4 peer walk.
+        # The engine keeps the device work (extracts/uploads); the
+        # manager decides what moves where and journals it.
+        from dynamo_tpu.engine.kvbm import KvBlockManager
+        self.kvbm = KvBlockManager(self.allocator, self.host_cache,
+                                   config.kvbm_policy())
         self._evict_buffer: list[tuple[int, int]] = []
         self._pending_spills: list[dict] = []
         self.onboard_blocks = 0
-        # G4 remote tier (kv_plane.RemoteBlockSource, set by the worker):
-        # prefix extensions that miss G1/G2/G3 consult peer workers' host
-        # tiers over the data plane before recomputing.
-        self.remote_source = None
         self.g4_blocks = 0
+        self.streamed_extracts = 0  # chunk-streamed disagg tickets staged
         self.kv_publisher = kv_publisher
         self.metrics_publisher = metrics_publisher
         # Set by the worker main when the KV data plane runs: the plane
@@ -593,7 +598,8 @@ class TPUEngine(AsyncEngine):
             r.pages = []
         return first_token, handle, len(r.tokens_all)
 
-    def prefill_extract_staged(self, req: PreprocessedRequest, plane):
+    def prefill_extract_staged(self, req: PreprocessedRequest, plane,
+                               on_ticket=None):
         """ENGINE-THREAD ONLY (call via run_job). Disaggregated prefill
         over the direct KV data plane: prefill, stage the extract with
         the plane (host fetches resolve lazily on the plane thread,
@@ -602,7 +608,14 @@ class TPUEngine(AsyncEngine):
         the KV bytes take the plane's direct path (llm/kv_plane.py) —
         the jax device path when the parcel shape allows it, else the
         socket path with PIPELINED page groups (extract was ~97% of the
-        round-4 transfer tax; reference offload.rs overlap role)."""
+        round-4 transfer tax; reference offload.rs overlap role).
+
+        ``on_ticket`` (threadsafe callable) enables CHUNK-STREAMED
+        extract: the ticket is staged and delivered BEFORE prefill
+        completes, with one page group per prefill chunk gated on that
+        chunk's extract — the decode worker pulls KV while later chunks
+        are still computing, hiding the per-prompt transfer tax
+        (PERF_NOTES' 15-20 ms projection) behind prefill compute."""
         spec = self.runner.spec
         page = self.config.page_size
         n = -(-len(req.token_ids) // page)
@@ -622,8 +635,6 @@ class TPUEngine(AsyncEngine):
         # gate on the measured floor.
         grouped = (not dev_ok
                    and self.runner.d2h_fetch_floor_ms() < 10.0 and n > 1)
-        first_token, handle, prompt_len = self._prefill_for_extract(
-            req, grouped=grouped)
         if quant:
             # Packed int8+scales parcel (engine/kv_quant.py): the wire
             # carries ~half the bf16 bytes — the disagg transfer tax
@@ -637,6 +648,18 @@ class TPUEngine(AsyncEngine):
             shape = [2, spec.num_layers, self.runner.canonical_nkv, n,
                      self.config.page_size, spec.head_dim]
             meta = {"shape": shape, "dtype": "bfloat16"}
+        if on_ticket is not None and not dev_ok and \
+                self.runner.d2h_fetch_floor_ms() < 10.0:
+            # Chunk-streamed path: stage BEFORE prefilling (the jax
+            # device path can't stream — it registers one finished
+            # device array — so it keeps the stage-after-prefill
+            # order). Same per-group D2H floor gate as `grouped`: a
+            # tunneled chip pays its ~100 ms RTT once per page group,
+            # which would swamp the overlap win.
+            return self._prefill_extract_streamed(req, plane, meta,
+                                                  on_ticket)
+        first_token, handle, prompt_len = self._prefill_for_extract(
+            req, grouped=grouped)
         if grouped:
             groups = [(h[1], (lambda hh=h:
                               self.runner.finalize_extract(hh)))
@@ -649,7 +672,127 @@ class TPUEngine(AsyncEngine):
                 resolve=lambda: self.runner.finalize_extract(handle),
                 device_array=handle[0] if dev_ok else None,
                 prompt_len=prompt_len)
+        if on_ticket is not None:
+            on_ticket(ticket)
         return first_token, ticket, prompt_len
+
+    # Backstop for streamed-extract group resolvers: the plane thread
+    # waits on the chunk's extract event at most this long before
+    # failing the pull (an aborted prefill sets the events, so only a
+    # wedged engine thread ever reaches it).
+    STREAM_RESOLVE_TIMEOUT_S = 120.0
+
+    def _prefill_extract_streamed(self, req: PreprocessedRequest, plane,
+                                  meta: dict, on_ticket):
+        """ENGINE-THREAD ONLY. Chunk-streamed disagg extract: stage the
+        transfer ticket FIRST — one page group per prefill chunk, each
+        gated on a threading.Event its extract dispatch sets — deliver
+        it through ``on_ticket`` (the handler yields it to the decode
+        worker immediately), THEN run the chunk loop. The plane thread
+        streams group i to the sink while chunk i+1 is still computing,
+        so by the time the first token resolves most of the parcel is
+        already across the wire. A whole-prompt (non-chunked) plan
+        degenerates to one group staged before its single dispatch —
+        same contract, no special casing downstream.
+
+        Failure mid-loop marks every pending group failed (resolvers
+        raise, the sink's pull errors, the decode worker falls back to
+        local prefill) and re-raises to the handler."""
+        self._validate(req)
+        r = _Request(req=req, ctx=Context(), out_q=None, loop=None,  # type: ignore[arg-type]
+                     tokens_all=list(req.token_ids))
+        plan = self._plan_prefill(r)
+        if plan is None:
+            raise RuntimeError("prefill worker KV pool exhausted")
+        cfg = self.config
+        page = cfg.page_size
+        prompt = r.tokens_all
+        max_chunk = min(cfg.max_prefill_tokens, cfg.prefill_buckets[-1])
+        # Page-group boundaries are known at PLAN time: the reused
+        # prefix extracts immediately; each chunk's pages extract as its
+        # program dispatches (device-stream order: the gather reads the
+        # chunk's writes).
+        first_page = r.reuse_tokens // page
+        bounds: list[tuple[int, int]] = []
+        chunks: list[tuple[int, int, bool]] = []  # (start, n_tok, final)
+        if first_page:
+            bounds.append((0, first_page))
+        start = r.reuse_tokens
+        while start < len(prompt):
+            n_tok = min(max_chunk, len(prompt) - start)
+            bounds.append((start // page, -(-(start + n_tok) // page)))
+            chunks.append((start, n_tok, start + n_tok >= len(prompt)))
+            start += n_tok
+        state: dict = {"handles": {}, "error": None}
+        events = [threading.Event() for _ in bounds]
+        timeout_s = self.STREAM_RESOLVE_TIMEOUT_S
+
+        def _resolver(idx: int):
+            def resolve():
+                if not events[idx].wait(timeout=timeout_s):
+                    raise RuntimeError(
+                        f"streamed extract group {idx} never became "
+                        "ready (prefill wedged?)")
+                if state["error"] is not None:
+                    raise RuntimeError(
+                        f"chunked prefill failed: {state['error']}")
+                return self.runner.finalize_extract(state["handles"][idx])
+            return resolve
+
+        groups = [(hi - lo, _resolver(i))
+                  for i, (lo, hi) in enumerate(bounds)]
+        ticket = plane.stage(meta=meta, resolve_groups=groups,
+                             prompt_len=len(prompt))
+        self.streamed_extracts += 1
+        on_ticket(ticket)
+        gi = 0
+        try:
+            if first_page:
+                state["handles"][0] = self.runner.extract_pages_async(
+                    r.pages[:first_page])
+                events[0].set()
+                gi = 1
+            if plan != "chunked":
+                # Whole-prompt plan: one dispatch, one streamed group.
+                first_token = int(self.runner.prefill_batch([plan])[0])
+                lo, hi = bounds[gi]
+                state["handles"][gi] = self.runner.extract_pages_async(
+                    r.pages[lo:hi])
+                events[gi].set()
+            else:
+                first_token = None
+                for ci, (c_start, n_tok, final) in enumerate(chunks):
+                    seq = self._chunk_seq(r, c_start, n_tok, final)
+                    if final:
+                        pen = self._penalties_of(r)
+                        rows = (self._count_row_of(r)[None]
+                                if any(pen) else None)
+                        first_token = int(self.runner.prefill_batch(
+                            [seq], count_rows=rows)[0])
+                    else:
+                        self.runner.prefill_chunk_async(seq)
+                    lo, hi = bounds[gi]
+                    state["handles"][gi] = \
+                        self.runner.extract_pages_async(r.pages[lo:hi])
+                    events[gi].set()
+                    gi += 1
+            if not r.no_cache:
+                for idx, h in enumerate(r.blocks.block_hashes):
+                    self.allocator.register(r.pages[idx], h)
+            return first_token, ticket, len(prompt)
+        except BaseException as exc:
+            # Pending resolvers must fail fast, not wait out the
+            # backstop: mark, wake, re-raise to the handler.
+            state["error"] = f"{type(exc).__name__}: {exc}"
+            for ev in events:
+                ev.set()
+            raise
+        finally:
+            # Every extract is dispatched (or the parcel is failed):
+            # device-stream order protects the pages, so release now —
+            # same fencing argument as _prefill_for_extract.
+            self.allocator.release(r.pages)
+            r.pages = []
 
     async def embed(self, token_lists: list[list[int]],
                     pooling: str = "last") -> list[list[float]]:
@@ -716,6 +859,7 @@ class TPUEngine(AsyncEngine):
             "plane": self.plane.stats() if self.plane is not None else None,
             "remote": (self.remote_source.stats()
                        if self.remote_source is not None else None),
+            "kvbm": self.kvbm.status(),
             "digest": self.inventory_digest().to_wire(),
         }
         return status
@@ -883,6 +1027,7 @@ class TPUEngine(AsyncEngine):
             self._run_jobs()
             self._resolve_ready_first()
             self._resolve_spills()
+            self._maintain_kvbm()
             self._retire_chunks()
             try:
                 admitted = self._admit()
@@ -956,6 +1101,26 @@ class TPUEngine(AsyncEngine):
                 time.sleep(0.002)  # fully idle
 
     # -- KV tiering (G2/G3 offload + onboard) ---------------------------------
+    @property
+    def remote_source(self):
+        """G4 remote tier (kv_plane.RemoteBlockSource, set by the worker
+        main once the KV plane is up). Lives on the KVBM so the peer
+        tier is part of the one placement-policy object; this property
+        keeps every existing call site working."""
+        return self.kvbm.remote_source
+
+    @remote_source.setter
+    def remote_source(self, source) -> None:
+        self.kvbm.remote_source = source
+
+    def _maintain_kvbm(self) -> None:
+        """Watermark sweep, once per engine-loop iteration: proactive
+        LRU demotions queue their extracts through the evict hook; the
+        flush dispatches them before any later program can overwrite
+        the freed pages."""
+        if self.kvbm.maintain():
+            self._flush_spills()
+
     def _to_local_parcel(self, kv):
         """Convert a KV block to this worker's parcel form: packed
         int8+scales (uint8) when the pool is quantized, bf16 otherwise
@@ -1003,7 +1168,7 @@ class TPUEngine(AsyncEngine):
                 log.exception("spill fetch failed; blocks dropped")
                 continue
             for i, h in enumerate(entry["hashes"]):
-                self.host_cache.put(h, kv[:, :, :, i])
+                self.kvbm.offload(h, kv[:, :, :, i])
 
     def _try_onboard(self, r: _Request, hashes: list[int],
                      cached_pages: list[int]) -> tuple[list[int], int, int]:
@@ -1019,39 +1184,28 @@ class TPUEngine(AsyncEngine):
         # Never reuse past the second-to-last block (the last token must
         # always be recomputed for logits), matching the G1 rule.
         allowed = (len(r.tokens_all) - 1) // page - len(cached_pages)
-        blocks: list[tuple[int, np.ndarray]] = []
-        if self.host_cache is not None:
-            for h in hashes[len(cached_pages):]:
-                if len(blocks) >= allowed:
-                    break
-                kv = self.host_cache.get(h)
-                if kv is None:
-                    break
-                blocks.append((h, kv))
-        n_peer = 0
-        if self.remote_source is not None and len(blocks) < allowed:
-            # G4: one bounded peer round trip for the rest of the run.
-            start = len(cached_pages) + len(blocks)
-            want = hashes[start:start + (allowed - len(blocks))]
-            if want:
-                try:
-                    remote = self.remote_source.fetch(want, len(want))
-                except Exception:  # noqa: BLE001 — peers are best-effort
-                    log.exception("G4 remote fetch failed")
-                    remote = []
-                for h, kv in remote:
-                    # Peers may run the other KV dtype: normalize fetched
-                    # blocks to THIS worker's parcel form (packed uint8
-                    # for int8 pools, bf16 otherwise) so tier entries and
-                    # the onboard stack below stay uniform.
-                    kv = self._to_local_parcel(kv)
-                    blocks.append((h, kv))
-                    if self.host_cache is not None:
-                        # Promote into the local G2 so the next hit is
-                        # one NIC hop shorter.
-                        self.host_cache.put(h, kv, promotion=True)
-                n_peer = len(remote)
-                self.g4_blocks += n_peer
+        if allowed <= 0:
+            return [], 0, 0
+        # KVBM tier walk: host/disk first, then one bounded peer consult
+        # (engine/kvbm.py owns the policy; device uploads stay here).
+        blocks, n_peer = self.kvbm.onboard_walk(
+            hashes, len(cached_pages), allowed, trace_id=r.ctx.trace_id)
+        if n_peer:
+            n_host = len(blocks) - n_peer
+            normalized = []
+            for h, kv in blocks[n_host:]:
+                # Peers may run the other KV dtype: normalize fetched
+                # blocks to THIS worker's parcel form (packed uint8 for
+                # int8 pools, bf16 otherwise) so tier entries and the
+                # onboard stack below stay uniform.
+                kv = self._to_local_parcel(kv)
+                normalized.append((h, kv))
+                if self.host_cache is not None:
+                    # Promote into the local G2 so the next hit is one
+                    # NIC hop shorter.
+                    self.host_cache.put(h, kv, promotion=True)
+            blocks = blocks[:n_host] + normalized
+            self.g4_blocks += n_peer
         if not blocks:
             return [], 0, 0
         pages = self.allocator.allocate(len(blocks))
@@ -1068,6 +1222,8 @@ class TPUEngine(AsyncEngine):
         for (h, _), p in zip(blocks, pages):
             self.allocator.register(p, h)
         self.onboard_blocks += len(blocks)
+        self.kvbm.note_promoted(len(blocks) - n_peer, n_peer,
+                                trace_id=r.ctx.trace_id)
         return pages, len(blocks) * page, n_peer * page
 
     def _release_ready_pages(self) -> None:

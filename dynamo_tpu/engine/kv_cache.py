@@ -52,6 +52,7 @@ class PageAllocator:
         self.reuse_hit_blocks = 0      # cached pages pinned on prefix hits
         self.reuse_lookup_blocks = 0   # blocks probed by acquire_cached
         self.evicted_blocks = 0        # LRU evictions under allocation
+        self.demoted_blocks = 0        # proactive watermark demotions (KVBM)
         self.cleared_blocks = 0        # pages reclaimed by clear_inactive
         self.clear_inactive_calls = 0
         # Offload hook (G2 tiering): called as hook(block_hash, page) when
@@ -178,6 +179,32 @@ class PageAllocator:
             else:
                 self.inactive[h] = page
 
+    def demote_lru(self, count: int,
+                   skip: frozenset | set = frozenset()) -> list[int]:
+        """Proactively demote up to ``count`` LRU *inactive* blocks out of
+        HBM (the KVBM watermark sweep, engine/kvbm.py): the pages return
+        to the free list and the evict hook offloads their contents to
+        the host tier, exactly like allocation-pressure eviction — but
+        BEFORE an allocation burst has to pay the evict+extract ordering.
+        Hashes in ``skip`` (the KVBM pin set) and ACTIVE pages are never
+        taken. Returns the demoted block hashes."""
+        out: list[int] = []
+        for h in list(self.inactive):
+            if len(out) >= count:
+                break
+            if h in skip:
+                continue
+            page = self.inactive.pop(h)
+            del self.cached[h]
+            del self.cached_by_page[page]
+            self.removed_events.append(h)
+            self.demoted_blocks += 1
+            if self.evict_hook is not None:
+                self.evict_hook(h, page)
+            self.free.append(page)
+            out.append(h)
+        return out
+
     def clear_inactive(self) -> int:
         """Drop every INACTIVE prefix-cache registration (pages held by
         live sequences are untouched) — the reference's clear_kv_blocks
@@ -208,6 +235,7 @@ class PageAllocator:
             "reuse_hit_blocks": self.reuse_hit_blocks,
             "reuse_lookup_blocks": self.reuse_lookup_blocks,
             "evicted_blocks": self.evicted_blocks,
+            "demoted_blocks": self.demoted_blocks,
             "cleared_blocks": self.cleared_blocks,
             "clear_inactive_calls": self.clear_inactive_calls,
         }

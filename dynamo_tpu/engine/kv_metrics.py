@@ -72,13 +72,37 @@ class KvMetricsUpdater:
         self.c_plane_blocks_served = registry.counter(
             "kv_plane_blocks_served_total", "G4 blocks served to peers "
             "from this worker's host tiers")
+        # KV federation (engine/kvbm.py; docs/OBSERVABILITY.md "KV
+        # federation"): the placement-policy counters, distinct from the
+        # mechanism counters above — watermark demotions are proactive
+        # (vs kv_evicted_blocks_total's allocation-pressure evictions),
+        # promotions count blocks moved UP the ladder into HBM.
+        self.c_fed_demotions = registry.counter(
+            "kv_federation_demotions_total", "Blocks proactively demoted "
+            "by the KVBM watermark sweep (HBM free-list hysteresis)")
+        self.c_fed_promotions = registry.counter(
+            "kv_federation_promotions_total", "Tier blocks promoted into "
+            "HBM pages (host/disk/peer onboards)")
+        self.c_fed_recompute = registry.counter(
+            "kv_federation_recompute_fallbacks_total", "Tier walks that "
+            "ran dry before the request's full prefix (remainder "
+            "recomputed — the always-safe fallback)")
+        self.c_fed_peer_failures = registry.counter(
+            "kv_federation_peer_pull_failures_total", "Peer block pulls "
+            "that failed (breaker-open peers, timeouts, transport "
+            "errors); the request recomputed instead")
+        self.g_fed_pinned = registry.gauge(
+            "kv_federation_pinned_blocks", "Blocks pinned against "
+            "watermark demotion (KVBM pin set)")
         for tier in ("hbm", "host", "peer"):
             self.c_reuse.ensure(tier=tier)
         for bound in (self.g_occupancy, self.g_cached_blocks,
                       self.g_pool_bytes,
                       self.c_reuse_lookup, self.c_evicted, self.c_cleared,
                       self.c_plane_pulls, self.c_plane_pull_seconds,
-                      self.c_plane_blocks_served):
+                      self.c_plane_blocks_served, self.c_fed_demotions,
+                      self.c_fed_promotions, self.c_fed_recompute,
+                      self.c_fed_peer_failures, self.g_fed_pinned):
             bound.ensure()
 
     def _delta(self, bound, key: tuple, current: float, **labels) -> None:
@@ -135,8 +159,21 @@ class KvMetricsUpdater:
                         tiers.get("g2_spills_in", 0), tier="g2")
             self._delta(self.c_tier_spills, ("spills", "g3"),
                         tiers.get("g2_demotions", 0), tier="g3")
+        kvbm = getattr(engine, "kvbm", None)
+        if kvbm is not None:
+            self._delta(self.c_fed_demotions, ("fed_demote",),
+                        kvbm.watermark_demotions)
+            self._delta(self.c_fed_promotions, ("fed_promote",),
+                        kvbm.promotions)
+            self._delta(self.c_fed_recompute, ("fed_recompute",),
+                        kvbm.recompute_fallbacks)
+            self._delta(self.c_fed_peer_failures, ("fed_peer_fail",),
+                        kvbm.peer_pull_failures)
+            self.g_fed_pinned.set(len(kvbm.pinned))
         remote = getattr(engine, "remote_source", None)
         if remote is not None:
+            self._delta(self.c_fed_peer_failures, ("peer_fetch_fail",),
+                        remote.fetch_failures)
             client = remote.client
             self._delta(self.c_plane_pulls, ("pulls",), client.transfers)
             self._delta(self.c_plane_pull_seconds, ("pull_s",),

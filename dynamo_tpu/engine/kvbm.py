@@ -1,0 +1,273 @@
+"""KVBM: one auditable policy object for KV block placement across tiers.
+
+The reference manages its KV hierarchy through a dedicated block manager
+(lib/llm/src/block_manager.rs G1..G4: device, host, disk, remote) with
+explicit offload/onboard policy (block_manager/offload.rs). Before this
+module, our tier ladder existed but the POLICY was scattered: the
+allocator evicted under allocation pressure only (never proactively),
+HostKVCache cascaded to disk as a side effect of put(), promote-on-hit
+was implicit in get(), and the G4 peer consult lived inline in
+engine._try_onboard. ``KvBlockManager`` centralizes those decisions:
+
+- **Watermark-driven demotion** (``maintain()``): when the HBM free
+  list drops below ``low_watermark`` of the pool, LRU inactive blocks
+  are demoted to the host tier until ``high_watermark`` is restored —
+  hysteresis, so the sweep doesn't thrash around one threshold. An
+  allocation burst then finds pages on the free list instead of paying
+  evict+extract ordering inside the allocation.
+- **Pinned-while-active**: ACTIVE pages are never demotable (the
+  allocator's lifecycle invariant), and ``pin()`` additionally protects
+  registered-but-inactive blocks (e.g. a fleet-shared system prompt)
+  from both the watermark sweep and — by prior onboarding — repeated
+  recompute.
+- **Promote-on-hit**: a hit in a lower tier moves the block up one
+  level (disk→DRAM inside HostKVCache.get; host/peer→HBM via
+  ``onboard()``; peer blocks also land in local G2 so the next hit is
+  one NIC hop shorter), refreshing LRU recency at each level.
+- **Peer tier** (G4): the walk past the local tiers consults
+  ``RemoteBlockSource`` (llm/kv_plane.py) — bounded wall-clock budget,
+  per-peer breaker discipline — and falls back to recompute, never
+  failing the request.
+
+Every demotion sweep, promotion batch, and peer pull emits a typed
+journal event (``kv_demote`` / ``kv_promote`` / ``kv_peer_pull``,
+runtime/journal.py) with a cause ref, so ``/debug/timeline`` shows tier
+churn as part of the fleet's decision history, and ``status()`` is the
+single occupancy/counter surface the ``dynamo_tpu_kv_*`` gauges and
+``/debug/kv`` read (docs/OBSERVABILITY.md "KV federation").
+
+The manager owns NO device work: uploads/extracts stay in the engine
+(the engine thread owns the runner); KVBM decides *what* moves *where*.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from dynamo_tpu.runtime import journal
+from dynamo_tpu.runtime.journal import EventKind
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("kvbm")
+
+
+@dataclasses.dataclass
+class KvbmPolicy:
+    """Tier policy knobs (EngineConfig.kvbm_policy(); all per-tier
+    budgets live on EngineConfig/HostKVCache — this object holds the
+    *decisions* layered on top of those budgets)."""
+
+    # Free-list watermarks as fractions of the HBM pool. The sweep
+    # starts when len(free) / num_pages < low and stops at >= high
+    # (hysteresis). 0 disables proactive demotion — eviction then only
+    # happens under allocation pressure, the pre-KVBM behavior.
+    low_watermark: float = 0.0
+    high_watermark: float = 0.0
+    # At most this many blocks demoted per maintain() call: the sweep
+    # runs on the engine thread between windows, and each demotion
+    # queues an extract — bound the per-window burst.
+    max_demotions_per_sweep: int = 16
+    # Journal throttle: tier churn is per-block; one event per sweep /
+    # onboard batch, and no more than one per key per this interval.
+    journal_min_interval_s: float = 1.0
+
+    def __post_init__(self):
+        if self.low_watermark and not self.high_watermark:
+            self.high_watermark = min(1.0, self.low_watermark + 0.05)
+        if self.high_watermark < self.low_watermark:
+            raise ValueError(
+                f"kv watermarks inverted: high {self.high_watermark} < "
+                f"low {self.low_watermark}")
+
+
+class KvBlockManager:
+    """Placement + eviction policy across HBM → host → disk → peer.
+
+    Wraps the existing mechanism objects (PageAllocator, HostKVCache
+    with its DiskKVCache, RemoteBlockSource) without changing their
+    storage semantics; the engine delegates its tier decisions here.
+    ENGINE THREAD ONLY for maintain()/onboard_walk(); pin/unpin/status
+    are safe from any thread (plain reads + set ops under the GIL).
+    """
+
+    def __init__(self, allocator, host_cache=None, policy: KvbmPolicy |
+                 None = None):
+        self.allocator = allocator
+        self.host_cache = host_cache
+        self.policy = policy or KvbmPolicy()
+        # G4 remote tier; assigned by the worker main after the KV plane
+        # starts (engine.remote_source property delegates here).
+        self.remote_source = None
+        # Registered-but-inactive blocks the watermark sweep must not
+        # demote (system prompts, shared document prefixes).
+        self.pinned: set[int] = set()
+        # Policy counters (plain ints, engine thread; exported by
+        # engine/kv_metrics.py as deltas).
+        self.watermark_demotions = 0
+        self.demotion_sweeps = 0
+        self.promotions = 0          # blocks moved UP a tier (any rung)
+        self.peer_pull_blocks = 0
+        self.peer_pull_failures = 0
+        self.recompute_fallbacks = 0  # tier walk ended short of the goal
+        self.pinned_skips = 0         # sweep passes over pinned blocks
+        self._journal_next: dict[str, float] = {}
+
+    # -- pinning --------------------------------------------------------------
+    def pin(self, block_hashes) -> None:
+        self.pinned.update(block_hashes)
+
+    def unpin(self, block_hashes) -> None:
+        self.pinned.difference_update(block_hashes)
+
+    # -- watermark demotion ---------------------------------------------------
+    def free_fraction(self) -> float:
+        alloc = self.allocator
+        return (len(alloc.free) / alloc.num_pages) if alloc.num_pages else 1.0
+
+    def maintain(self) -> int:
+        """One engine-loop sweep: demote LRU inactive blocks while the
+        free list is under the low watermark, until the high watermark
+        (or the sweep budget / the inactive pool) is exhausted. The
+        evict hook queues the extracts; the engine's existing
+        _flush_spills() dispatches them. Returns blocks demoted."""
+        p = self.policy
+        if not p.low_watermark or self.host_cache is None:
+            return 0
+        alloc = self.allocator
+        if self.free_fraction() >= p.low_watermark:
+            return 0
+        target = int(p.high_watermark * alloc.num_pages)
+        want = min(p.max_demotions_per_sweep,
+                   max(0, target - len(alloc.free)))
+        if want <= 0:
+            return 0
+        before = len(alloc.inactive)
+        demoted = alloc.demote_lru(want, skip=self.pinned)
+        took = len(demoted)
+        # Count pinned passes only when pins actually blocked the sweep
+        # (inactive entries remained that demote_lru skipped).
+        if took < want and before - took > 0 and self.pinned:
+            self.pinned_skips += 1
+        if took:
+            self.watermark_demotions += took
+            self.demotion_sweeps += 1
+            if self._journal_due("demote"):
+                journal.emit(
+                    EventKind.KV_DEMOTE, blocks=took,
+                    tier_from="g1", tier_to="g2",
+                    free_frac=round(self.free_fraction(), 4),
+                    cause=journal.recent_ref(EventKind.KV_DEMOTE,
+                                             EventKind.PREEMPT))
+        return took
+
+    # -- tier walk (host → disk → peer) ---------------------------------------
+    def onboard_walk(self, hashes: list[int], start: int, allowed: int,
+                     trace_id: str | None = None):
+        """Collect up to ``allowed`` consecutive blocks starting at
+        ``hashes[start]`` from the tiers below HBM. Returns
+        (blocks [(hash, parcel)], n_peer): host/disk first (HostKVCache
+        promotes disk hits to DRAM internally), then one bounded peer
+        consult for the remainder. The caller (engine) uploads them into
+        HBM pages — that upload IS the promotion to G1, journaled
+        here."""
+        blocks: list[tuple[int, object]] = []
+        if self.host_cache is not None:
+            for h in hashes[start:]:
+                if len(blocks) >= allowed:
+                    break
+                kv = self.host_cache.get(h)
+                if kv is None:
+                    break
+                blocks.append((h, kv))
+        n_peer = 0
+        if self.remote_source is not None and len(blocks) < allowed:
+            at = start + len(blocks)
+            want = hashes[at:at + (allowed - len(blocks))]
+            if want:
+                try:
+                    remote = self.remote_source.fetch(
+                        want, len(want), trace_id=trace_id)
+                except Exception:  # noqa: BLE001 — peers are best-effort
+                    log.exception("G4 remote fetch failed")
+                    self.peer_pull_failures += 1
+                    remote = []
+                blocks.extend(remote)
+                n_peer = len(remote)
+                self.peer_pull_blocks += n_peer
+        if len(blocks) < allowed:
+            # The ladder ran dry before the request's full prefix: the
+            # remainder recomputes (always the cheap safe fallback).
+            self.recompute_fallbacks += 1
+        return blocks, n_peer
+
+    def note_promoted(self, n_host: int, n_peer: int,
+                      trace_id: str | None = None) -> None:
+        """The engine uploaded ``n_host + n_peer`` tier blocks into HBM
+        pages (promote-on-hit completing): account + journal, with the
+        peer share attributed to the pull that sourced it."""
+        n = n_host + n_peer
+        if n <= 0:
+            return
+        self.promotions += n
+        if self._journal_due("promote"):
+            journal.emit(
+                EventKind.KV_PROMOTE, blocks=n, peer_blocks=n_peer,
+                tier_to="g1", trace_id=trace_id,
+                cause=journal.recent_ref(EventKind.KV_PEER_PULL,
+                                         EventKind.KV_DEMOTE))
+
+    def offload(self, block_hash: int, kv) -> None:
+        """Store one extracted block in the host tier (the demotion's
+        data movement, called from the engine's spill resolution)."""
+        if self.host_cache is not None:
+            self.host_cache.put(block_hash, kv)
+
+    # -- observability --------------------------------------------------------
+    def _journal_due(self, key: str) -> bool:
+        """Per-key journal throttle: tier churn is per-block, the
+        timeline wants one event per burst, not thousands."""
+        now = time.monotonic()
+        if now < self._journal_next.get(key, 0.0):
+            return False
+        self._journal_next[key] = now + self.policy.journal_min_interval_s
+        return True
+
+    def status(self) -> dict:
+        """The one auditable surface: policy, pins, counters, and
+        per-tier occupancy consistent with the dynamo_tpu_kv_tier_*
+        gauges (/debug/kv "kvbm" block)."""
+        alloc = self.allocator
+        tiers = {"g1": {"blocks": len(alloc.cached),
+                        "pages_free": len(alloc.free),
+                        "pages_inactive": len(alloc.inactive),
+                        "capacity": alloc.num_pages}}
+        if self.host_cache is not None:
+            hs = self.host_cache.stats()
+            tiers["g2"] = {"blocks": hs["g2_blocks"],
+                           "capacity": hs["g2_capacity"]}
+            if "g3_blocks" in hs:
+                tiers["g3"] = {"blocks": hs["g3_blocks"],
+                               "capacity": hs["g3_capacity"]}
+        if self.remote_source is not None:
+            rs = self.remote_source.stats()
+            tiers["peer"] = {"peers": rs["peers"],
+                             "fetched_blocks": rs["fetched_blocks"]}
+        return {
+            "policy": {
+                "low_watermark": self.policy.low_watermark,
+                "high_watermark": self.policy.high_watermark,
+                "max_demotions_per_sweep":
+                    self.policy.max_demotions_per_sweep,
+            },
+            "free_fraction": round(self.free_fraction(), 4),
+            "pinned_blocks": len(self.pinned),
+            "tiers": tiers,
+            "watermark_demotions": self.watermark_demotions,
+            "demotion_sweeps": self.demotion_sweeps,
+            "promotions": self.promotions,
+            "peer_pull_blocks": self.peer_pull_blocks,
+            "peer_pull_failures": self.peer_pull_failures,
+            "recompute_fallbacks": self.recompute_fallbacks,
+            "pinned_skips": self.pinned_skips,
+        }

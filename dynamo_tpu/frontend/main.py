@@ -54,6 +54,11 @@ def parse_args(argv=None) -> argparse.Namespace:
                              "requires dynamo_tpu.llm.kv_router)")
     parser.add_argument("--kv-overlap-score-weight", type=float, default=1.0)
     parser.add_argument("--kv-router-temperature", type=float, default=0.0)
+    parser.add_argument("--no-kv-federation", action="store_true",
+                        help="score candidates by the local radix index "
+                             "only (disable the inventory-sketch overlap "
+                             "union; docs/OBSERVABILITY.md 'KV "
+                             "federation')")
     parser.add_argument("--busy-threshold", type=float, default=None,
                         help="reject (503) when all workers exceed this load")
     # Overload defense (runtime/overload.py; docs/RESILIENCE.md):
@@ -128,7 +133,8 @@ async def run(args: argparse.Namespace) -> None:
         kv_router_factory = make_kv_router_factory(
             overlap_score_weight=args.kv_overlap_score_weight,
             temperature=args.kv_router_temperature,
-            busy_threshold=args.busy_threshold)
+            busy_threshold=args.busy_threshold,
+            federation=not args.no_kv_federation)
 
     manager = ModelManager()
     watcher = ModelWatcher(runtime, manager, router_mode=args.router_mode,

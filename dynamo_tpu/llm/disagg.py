@@ -25,6 +25,7 @@ updates, mirroring DisaggRouterConf::from_etcd_with_watcher.
 from __future__ import annotations
 
 import asyncio
+import inspect
 import time
 from typing import AsyncIterator
 
@@ -145,6 +146,10 @@ def make_prefill_handler(engine, plane=None):
     frames, then the first token — the role of the reference's
     kv_transfer_params response, handlers.py:195-199)."""
 
+    supports_streaming = "on_ticket" in getattr(
+        inspect.signature(engine.prefill_extract_staged), "parameters", {}) \
+        if hasattr(engine, "prefill_extract_staged") else False
+
     async def handle(request, context: Context) -> AsyncIterator[dict]:
         if isinstance(request, dict) and request.get("clear_kv_blocks"):
             yield {"cleared": await engine.clear_kv_blocks()}
@@ -153,21 +158,55 @@ def make_prefill_handler(engine, plane=None):
                else PreprocessedRequest.from_wire(request))
         phase = getattr(engine, "phase", None)  # tracing.PhaseMetrics
         if plane is not None:
+            # Chunk-streamed extract (engine._prefill_extract_streamed):
+            # the engine stages the ticket BEFORE prefilling and delivers
+            # it via on_ticket — yield it to the decode worker right
+            # away so its plane pull overlaps the remaining chunks; the
+            # first token follows when the job completes. Engines
+            # without the on_ticket parameter (scripted test engines,
+            # older queue workers) keep the stage-after-prefill order.
+            loop = asyncio.get_running_loop()
+            ticket_fut: asyncio.Future = loop.create_future()
+            staged: list[dict] = []  # the delivered ticket, loop-side
+
+            def _deliver(t: dict) -> None:
+                staged.append(t)
+                if not ticket_fut.done():
+                    ticket_fut.set_result(True)
+
+            def on_ticket(t: dict) -> None:
+                loop.call_soon_threadsafe(_deliver, t)
+
             with span("kv.transfer.send", ctx=context, path="plane") as sp:
                 t0 = time.monotonic()
-                first_token, ticket, prompt_len = await engine.run_job(
-                    lambda: engine.prefill_extract_staged(req, plane))
+                if supports_streaming:
+                    job = asyncio.ensure_future(engine.run_job(
+                        lambda: engine.prefill_extract_staged(
+                            req, plane, on_ticket=on_ticket)))
+                else:
+                    job = asyncio.ensure_future(engine.run_job(
+                        lambda: engine.prefill_extract_staged(req, plane)))
+                await asyncio.wait({job, ticket_fut},
+                                   return_when=asyncio.FIRST_COMPLETED)
+                streamed = bool(staged) and not job.done()
+                if streamed:
+                    # Ticket ahead of the first token: ship it now.
+                    yield LLMEngineOutput(disagg_params={
+                        "ticket": staged[0]}).to_wire()
+                first_token, ticket, prompt_len = await job
                 sp.set(nbytes=int(ticket.get("nbytes", 0)),
-                       prompt_tokens=prompt_len)
+                       prompt_tokens=prompt_len, streamed=streamed)
                 if phase is not None:
                     phase.kv_transfer.observe(time.monotonic() - t0,
                                               direction="send")
                     phase.kv_transfer_bytes.observe(
                         ticket.get("nbytes", 0), direction="send")
-            log.info("prefill parcel staged: %d tokens, ticket %d",
+            log.info("prefill parcel staged%s: %d tokens, ticket %d",
+                     " (chunk-streamed)" if streamed else "",
                      prompt_len, ticket["id"])
-            yield LLMEngineOutput(
-                disagg_params={"ticket": ticket}).to_wire()
+            if not streamed:
+                yield LLMEngineOutput(
+                    disagg_params={"ticket": ticket}).to_wire()
             yield LLMEngineOutput(token_ids=[first_token]).to_wire()
             return
         with span("kv.transfer.send", ctx=context, path="inline") as sp:

@@ -718,50 +718,82 @@ class KvPlaneClient:
 class RemoteBlockSource:
     """G4 remote tier: fetch KV blocks from PEER workers' host tiers by
     block hash (reference CacheLevel G4, block_manager.rs:76-82 + the
-    distributed leader/worker's cross-worker reuse role). The engine
-    consults it when a prefix extension misses G1/G2/G3 — one bounded
-    round trip per peer, first hit wins; content-hashed blocks make the
-    result trustworthy regardless of which worker computed them.
+    distributed leader/worker's cross-worker reuse role). The engine's
+    KVBM consults it when a prefix extension misses G1/G2/G3 — one
+    bounded round trip per peer, first hit wins; content-hashed blocks
+    make the result trustworthy regardless of which worker computed
+    them.
 
     ``peers`` is swapped wholesale by the worker's coordinator watcher
     (kvplane/ registrations), so the engine thread only ever reads a
-    consistent list."""
+    consistent list.
+
+    Per-peer breaker discipline (runtime/retry.py): a failing peer
+    opens for a cooldown that walks the G4_PEER_BREAKER policy curve —
+    successive failures back off exponentially, a post-cooldown consult
+    is the half-open probe, and one success resets the curve. Every
+    consult outcome journals as a ``kv_peer_pull`` event
+    (runtime/journal.py) so /debug/timeline shows cross-worker reuse —
+    and its failures — as part of the fleet's decision history."""
 
     # G4 fetches run on the ENGINE thread between windows: the WHOLE
     # consult — every peer together — gets one sub-window budget, so
     # neither a dead peer nor a slow-but-alive one can stall unrelated
-    # in-flight decode streams for more than ~one window period. A peer
-    # that errors OR overruns the budget cools down and stops costing
-    # anything until the cooldown expires (its lease usually expires
-    # first). Recomputing the prefix is always the cheap safe fallback.
+    # in-flight decode streams for more than ~one window period.
+    # Recomputing the prefix is always the cheap safe fallback.
     G4_BUDGET_S = 0.1
-    PEER_COOLDOWN_S = 60.0
 
     def __init__(self, client: KvPlaneClient | None = None,
                  self_addr: str | None = None, max_peers: int = 4,
                  budget_s: float | None = None):
+        from dynamo_tpu.runtime.retry import policies
         self.budget_s = self.G4_BUDGET_S if budget_s is None else budget_s
         self.client = client or KvPlaneClient(timeout=self.budget_s)
         self.self_addr = self_addr
         self.max_peers = max_peers
         self.peers: list[str] = []
-        self._cooldown: dict[str, float] = {}  # addr -> retry-after
+        self.breaker_policy = policies.G4_PEER_BREAKER
+        self._cooldown: dict[str, float] = {}  # addr -> half-open time
+        self._fail_streak: dict[str, int] = {}  # addr -> breaker curve pos
         self.fetched_blocks = 0
         self.fetch_failures = 0
         self.slow_peer_cooldowns = 0
+        self.breaker_open_skips = 0   # consults skipped on open breakers
 
     def stats(self) -> dict:
+        now = time.monotonic()
         return {"peers": len(self.peers),
                 "fetched_blocks": self.fetched_blocks,
                 "fetch_failures": self.fetch_failures,
                 "slow_peer_cooldowns": self.slow_peer_cooldowns,
+                "breaker_open_skips": self.breaker_open_skips,
+                "breakers_open": sum(1 for t in self._cooldown.values()
+                                     if t > now),
                 **{f"client_{k}": v for k, v in self.client.stats().items()}}
 
-    def fetch(self, hashes: list[int], max_blocks: int
-              ) -> list[tuple[int, np.ndarray]]:
+    def _open_breaker(self, addr: str, reason: str) -> None:
+        """One more failure on this peer: advance its breaker curve and
+        cool it down for the policy's delay at that position (no jitter
+        rng threading needed — the curve IS the discipline)."""
+        streak = self._fail_streak.get(addr, 0)
+        delay = self.breaker_policy.delay(streak)
+        self._fail_streak[addr] = streak + 1
+        self._cooldown[addr] = time.monotonic() + delay
+        log.warning("G4 peer %s %s; breaker open %.1fs (streak %d)",
+                    addr, reason, delay, streak + 1)
+
+    def _note_success(self, addr: str) -> None:
+        self._cooldown.pop(addr, None)
+        self._fail_streak.pop(addr, None)
+
+    def fetch(self, hashes: list[int], max_blocks: int,
+              trace_id: str | None = None) -> list[tuple[int, np.ndarray]]:
         """SYNC (engine thread, between windows): returns the longest
         consecutive run of requested blocks any single peer holds,
         giving the whole consult ``budget_s`` of wall clock."""
+        from dynamo_tpu.runtime import journal
+        from dynamo_tpu.runtime.journal import EventKind
+
         deadline = time.monotonic() + self.budget_s
         for addr in list(self.peers)[:self.max_peers]:
             if addr == self.self_addr or not addr:
@@ -771,6 +803,7 @@ class RemoteBlockSource:
             if remaining <= 0:
                 break
             if self._cooldown.get(addr, 0.0) > now:
+                self.breaker_open_skips += 1
                 continue
             t0 = now
             try:
@@ -778,22 +811,29 @@ class RemoteBlockSource:
                     addr, hashes, max_blocks, timeout=remaining)
             except (ConnectionError, OSError) as exc:
                 self.fetch_failures += 1
-                self._cooldown[addr] = time.monotonic() + self.PEER_COOLDOWN_S
                 slow = isinstance(exc, (socket.timeout, TimeoutError))
                 if slow:
                     self.slow_peer_cooldowns += 1
-                log.warning("G4 peer %s %s; cooling down %.0fs", addr,
-                            "too slow" if slow else "unreachable",
-                            self.PEER_COOLDOWN_S)
+                self._open_breaker(addr,
+                                   "too slow" if slow else "unreachable")
+                journal.emit(
+                    EventKind.KV_PEER_PULL, trace_id=trace_id,
+                    outcome="timeout" if slow else "error", peer=addr,
+                    cause=journal.recent_ref(EventKind.CHAOS_INJECT))
                 continue
             if time.monotonic() - t0 > self.budget_s:
                 # Answered, but ate the whole consult budget: treat as
                 # slow and stop consulting it for a while.
                 self.slow_peer_cooldowns += 1
-                self._cooldown[addr] = time.monotonic() + self.PEER_COOLDOWN_S
+                self._open_breaker(addr, "consult overran budget")
             else:
-                self._cooldown.pop(addr, None)
+                self._note_success(addr)
             if found:
                 self.fetched_blocks += len(found)
+                journal.emit(
+                    EventKind.KV_PEER_PULL, trace_id=trace_id,
+                    outcome="ok", peer=addr, blocks=len(found),
+                    nbytes=int(arr.nbytes),
+                    cause=journal.recent_ref(EventKind.KV_DEMOTE))
                 return [(h, arr[i]) for i, h in enumerate(found)]
         return []

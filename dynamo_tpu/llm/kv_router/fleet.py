@@ -25,6 +25,7 @@ import time
 from dynamo_tpu.llm.kv_router.protocols import (
     KvInventoryDigest,
     sketch_overlap,
+    sketch_prefix_blocks,
 )
 
 #: A digest older than this is reported stale (worker dead or its
@@ -60,6 +61,33 @@ class FleetInventory:
     def digest(self, worker_id: int) -> KvInventoryDigest | None:
         entry = self._digests.get(worker_id)
         return entry[1] if entry else None
+
+    def prefix_overlap(self, worker_id: int,
+                       block_hashes: list[int]) -> int:
+        """Federated overlap estimate for one worker: how many of the
+        request's leading blocks this worker's INVENTORY provably holds
+        — including host/disk tier blocks the radix index dropped when
+        they left HBM (their removed events fired, but the digest sketch
+        still covers them). Stale digests score 0: routing on a dead
+        worker's inventory would send traffic at a ghost."""
+        entry = self._digests.get(worker_id)
+        if entry is None:
+            return 0
+        t, digest = entry
+        if time.monotonic() - t > self.stale_s:
+            return 0
+        return sketch_prefix_blocks(digest.sketch, block_hashes)
+
+    def prefix_overlaps(self, workers, block_hashes: list[int]):
+        """Per-worker federated overlap (same shape as the radix
+        OverlapScores) for the scheduler's union scoring; zero scores
+        are omitted."""
+        out: dict[int, int] = {}
+        for w in workers:
+            n = self.prefix_overlap(w, block_hashes)
+            if n > 0:
+                out[w] = n
+        return out
 
     def overlap_matrix(self) -> dict[str, float]:
         """Pairwise sketch-estimated inventory overlap, keyed

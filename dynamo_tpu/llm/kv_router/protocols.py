@@ -41,6 +41,37 @@ def sketch_overlap(a: list[int], b: list[int], k: int = SKETCH_K) -> float:
     return inter / len(merged)
 
 
+def sketch_prefix_blocks(sketch: list[int],
+                         block_hashes: list[int]) -> int:
+    """How many of a request's leading block hashes a sketched inventory
+    provably holds — the federated-routing overlap estimate.
+
+    Sound by construction: a k-min sketch stores ACTUAL hash values, so
+    membership has no false positives — every counted block really is
+    (or very recently was) on that worker. Two regimes:
+
+    - inventory <= k blocks: the sketch IS the complete inventory, so
+      this is the exact longest-prefix match (the common case for
+      per-model inventories under ~SKETCH_K blocks, and for every
+      CI-scale fleet).
+    - larger inventories: the sketch is the k smallest hashes — a
+      uniform sample of the hash space. A miss is then inconclusive, so
+      the walk stops at the first miss and the result is a LOWER bound:
+      federated routing degrades gracefully toward the local radix view
+      instead of ever overclaiming a prefix a worker doesn't hold.
+    """
+    if not sketch or not block_hashes:
+        return 0
+    members = set(sketch)
+    n = 0
+    for h in block_hashes:
+        if (h & _HASH_MASK) in members:
+            n += 1
+        else:
+            break
+    return n
+
+
 class KvStoredBlock(BaseModel):
     block_hash: int
     # tokens are optional diagnostics; the hash is authoritative.

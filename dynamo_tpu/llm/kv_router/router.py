@@ -92,6 +92,16 @@ class KvPushRouter(AsyncEngine):
             "awareness", ["outcome"])
         for outcome in ("best", "suboptimal"):
             self._c_decisions.ensure(outcome=outcome)
+        # Federation telemetry: which knowledge source produced the
+        # winning overlap — "radix" (local index; the pre-federation
+        # signal), "inventory" (a digest sketch knew about tier blocks
+        # the radix had dropped), or "none" (cold prefix everywhere).
+        self._c_federation = m.counter(
+            "kv_federation_decisions_total", "Routing decisions by the "
+            "source of the chosen worker's overlap score",
+            ["source"])
+        for source in ("radix", "inventory", "none"):
+            self._c_federation.ensure(source=source)
 
     async def start(self) -> None:
         coord = self._runtime.require_coordinator()
@@ -213,6 +223,7 @@ class KvPushRouter(AsyncEngine):
         return {
             "role": "kv_router",
             "component": self.component,
+            "federation": self.config.federation,
             "index": {"blocks": self.indexer.tree.num_blocks,
                       "workers": sorted(f"{w:x}" for w in
                                         self.indexer.tree.workers())},
@@ -238,15 +249,39 @@ class KvPushRouter(AsyncEngine):
             block_hashes = compute_block_hashes(req.token_ids,
                                                 self.config.block_size)
             request_blocks = max(1, len(block_hashes))
-            overlaps = self.indexer.tree.find_matches(block_hashes)
+            radix = self.indexer.tree.find_matches(block_hashes)
             workers = self.client.instance_ids()
-            worker_id, overlap = self.scheduler.select(
-                workers, request_blocks, overlaps)
+            # Federated scoring: union the exact radix view (HBM blocks)
+            # with the inventory-sketch view (host/disk tier blocks that
+            # left the radix on eviction but are one onboard away on
+            # their holder) — per worker, take the larger claim. The
+            # sketch estimate never overclaims (sketch_prefix_blocks),
+            # so a federated win is a real prefix somewhere in that
+            # worker's ladder.
+            union = dict(radix)
+            for w, est in self.fleet.prefix_overlaps(
+                    workers, block_hashes).items():
+                if est > union.get(w, 0):
+                    union[w] = est
+            scoring = union if self.config.federation else radix
+            worker_id, _ = self.scheduler.select(
+                workers, request_blocks, scoring)
+            # The chosen worker's REAL overlap is the union view even
+            # when scoring was radix-only (--no-kv-federation): the
+            # worker will still onboard from its own tiers on arrival.
+            overlap = union.get(worker_id, 0)
+            source = ("none" if overlap <= 0
+                      else "radix" if radix.get(worker_id, 0) >= overlap
+                      else "inventory")
+            self._c_federation.inc(source=source)
             # Decision telemetry: chosen-vs-best overlap — how
             # cache-aware this decision actually was. "Best" is over the
-            # candidates that COULD have been chosen, so breaker/busy
-            # exclusions count as (visible) regret, not noise.
-            best_overlap = max(overlaps.values(), default=0)
+            # candidates that COULD have been chosen and over the FLEET
+            # view, so both breaker/busy exclusions and federation-off
+            # routing count as (visible) regret, not noise — turning
+            # federation on makes cache_aware_rate rise on the same
+            # workload, which is the ROADMAP item-3 success metric.
+            best_overlap = max(union.values(), default=0)
             self.decisions.note(worker_id, overlap, best_overlap,
                                 request_blocks)
             self._h_overlap.observe(overlap, kind="chosen")
@@ -255,7 +290,7 @@ class KvPushRouter(AsyncEngine):
                                            else "suboptimal"))
             sp.set(worker_id=f"{worker_id:x}", overlap_blocks=overlap,
                    best_overlap_blocks=best_overlap,
-                   request_blocks=request_blocks)
+                   request_blocks=request_blocks, overlap_source=source)
             new_blocks = request_blocks - overlap
             request_id = context.id
             prefill_tokens = max(0, len(req.token_ids)
@@ -297,7 +332,8 @@ class KvPushRouter(AsyncEngine):
 
 def make_kv_router_factory(overlap_score_weight: float = 1.0,
                            temperature: float = 0.0,
-                           busy_threshold: float | None = None):
+                           busy_threshold: float | None = None,
+                           federation: bool = True):
     """Factory used by ModelWatcher when --router-mode kv is selected."""
 
     async def factory(runtime, entry, client) -> KvPushRouter:
@@ -305,6 +341,7 @@ def make_kv_router_factory(overlap_score_weight: float = 1.0,
             overlap_score_weight=overlap_score_weight,
             temperature=temperature,
             busy_threshold=busy_threshold,
+            federation=federation,
             block_size=entry.card.kv_cache_block_size)
         router = KvPushRouter(runtime, entry.namespace, entry.component,
                               client, config)

@@ -36,6 +36,14 @@ class KvRouterConfig:
     temperature: float = 0.0
     busy_threshold: float | None = None  # fraction of KV blocks in use
     block_size: int = 16
+    # Federated routing (docs/OBSERVABILITY.md "KV federation"): score
+    # each candidate by the UNION of its radix-index overlap (HBM
+    # blocks, exact) and its inventory-sketch overlap (host/disk tier
+    # blocks the radix dropped on eviction) — so a prompt whose prefix
+    # lives anywhere in a worker's tier ladder routes to that worker
+    # instead of recomputing elsewhere. False = radix-only (the pre-
+    # federation behavior).
+    federation: bool = True
 
 
 class KvScheduler:

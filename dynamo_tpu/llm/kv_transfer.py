@@ -63,31 +63,54 @@ async def collect_prefill_response(stream: AsyncIterator[dict],
     plane-less workers). ``metrics`` (a tracing.PhaseMetrics) feeds the
     kv_transfer_seconds/bytes histograms; the recv span records either
     way."""
+    import asyncio
+
     t0 = time.monotonic()
     with span("kv.transfer.recv") as sp:
         chunks: list[bytes] = []
         meta = None
         ticket = None
         first_token = None
-        async for out in stream:
-            dp = out.get("disagg_params") or {}
-            if "ticket" in dp:
-                ticket = dp["ticket"]
-            if "kv_chunk" in dp:
-                chunks.append(dp["kv_chunk"])
-            if "shape" in dp:
-                meta = dp
-            toks = out.get("token_ids") or []
-            if toks:
-                first_token = toks[0]
+        pull_task: asyncio.Task | None = None
+        try:
+            async for out in stream:
+                dp = out.get("disagg_params") or {}
+                if "ticket" in dp and pull_task is None \
+                        and plane_client is not None:
+                    # Start pulling the MOMENT the ticket lands: with a
+                    # chunk-streamed prefill worker the ticket precedes
+                    # the first token, so the bulk KV bytes cross the
+                    # wire while the remaining chunks still compute —
+                    # the transfer tax hides behind prefill instead of
+                    # serializing after it.
+                    ticket = dp["ticket"]
+                    pull_task = asyncio.ensure_future(
+                        plane_client.pull(ticket))
+                elif "ticket" in dp:
+                    ticket = dp["ticket"]
+                if "kv_chunk" in dp:
+                    chunks.append(dp["kv_chunk"])
+                if "shape" in dp:
+                    meta = dp
+                toks = out.get("token_ids") or []
+                if toks:
+                    first_token = toks[0]
+        except BaseException:
+            # The stream died with a pull in flight (prefill aborted
+            # mid-chunk): don't leak the executor-backed task.
+            if pull_task is not None:
+                pull_task.cancel()
+            raise
         if first_token is None or (meta is None and ticket is None):
+            if pull_task is not None:
+                pull_task.cancel()
             raise RuntimeError("incomplete disaggregated prefill response")
         if ticket is not None:
             if plane_client is None:
                 raise RuntimeError(
                     "prefill worker sent a KV-plane ticket but this worker "
                     "has no plane client")
-            kv = await plane_client.pull(ticket)
+            kv = await pull_task
             sp.set(path="plane", nbytes=int(kv.nbytes))
         else:
             kv = kv_from_chunks(meta, chunks)

@@ -38,6 +38,12 @@ class MockerConfig:
     prefill_tokens_per_s: float = 100_000.0
     decode_step_s: float = 0.005
     speedup_ratio: float = 1.0  # reference mocker/protocols.rs:79
+    # Simulated host (G2) tier: evicted blocks land here instead of
+    # vanishing, stay out of the radix index (their removed events
+    # fire) but in the inventory digest — the substrate KV federation
+    # routing/peer-pull tests need, with zero TPUs (docs/OBSERVABILITY
+    # "KV federation"). 0 disables (pre-federation behavior).
+    host_blocks: int = 0
 
     def prefill_time(self, tokens: int) -> float:
         return tokens / self.prefill_tokens_per_s / self.speedup_ratio
@@ -51,20 +57,32 @@ class KvCacheSim:
     (reference mocker/kv_manager.rs). Emits stored/removed hashes via the
     events lists drained by the engine loop."""
 
-    def __init__(self, capacity: int):
+    def __init__(self, capacity: int, host_capacity: int = 0):
         self.capacity = capacity
         # block_hash -> refcount; insertion order refreshed on use = LRU.
         self._blocks: OrderedDict[int, int] = OrderedDict()
+        # Simulated G2 host tier: eviction victims demote here (LRU,
+        # bounded); an admit that misses G1 but hits here ONBOARDS the
+        # block back (promote-on-hit) instead of "recomputing".
+        self.host_capacity = host_capacity
+        self.host: OrderedDict[int, bool] = OrderedDict()
+        self.host_onboards = 0
+        self.host_spills = 0
+        self.peer_onboards = 0
         self.stored_events: list[int] = []
         self.removed_events: list[int] = []
 
     def lookup_prefix(self, hashes: list[int]) -> int:
-        """Longest cached prefix (cache hit blocks) for a new sequence.
-        Refreshes recency of the hits."""
+        """Longest cached prefix (cache hit blocks) for a new sequence,
+        across G1 and the host-tier sim (a host block onboards during
+        allocate() instead of 'recomputing' — it counts as a hit).
+        Refreshes recency of the G1 hits."""
         n = 0
         for h in hashes:
             if h in self._blocks:
                 self._blocks.move_to_end(h)
+                n += 1
+            elif h in self.host:
                 n += 1
             else:
                 break
@@ -83,6 +101,9 @@ class KvCacheSim:
                 self._blocks[h] += 1
                 self._blocks.move_to_end(h)
             else:
+                if self.host.pop(h, None) is not None:
+                    # Promote-on-hit from the simulated host tier.
+                    self.host_onboards += 1
                 self._blocks[h] = 1
                 self.stored_events.append(h)
         return True
@@ -98,7 +119,22 @@ class KvCacheSim:
         for h in victims[:count]:
             del self._blocks[h]
             self.removed_events.append(h)
+            if self.host_capacity > 0:
+                # Demote to the host-tier sim instead of dropping.
+                self.host[h] = True
+                self.host.move_to_end(h)
+                self.host_spills += 1
+                while len(self.host) > self.host_capacity:
+                    self.host.popitem(last=False)
         return True
+
+    def inject(self, h: int) -> None:
+        """A peer-pulled block lands as a reusable (unpinned) local
+        block — allocate() then counts it as a hit instead of a miss."""
+        if h not in self._blocks:
+            self._blocks[h] = 0
+            self.stored_events.append(h)
+            self.peer_onboards += 1
 
     def append_block(self, h: int) -> bool:
         """Allocate one new pinned block for a decoding sequence."""
@@ -147,10 +183,16 @@ class MockerEngine(AsyncEngine):
                  kv_publisher=None, metrics_publisher=None,
                  inventory_publisher=None):
         self.config = config or MockerConfig()
-        self.kv = KvCacheSim(self.config.num_kv_blocks)
+        self.kv = KvCacheSim(self.config.num_kv_blocks,
+                             self.config.host_blocks)
         self.kv_publisher = kv_publisher
         self.metrics_publisher = metrics_publisher
         self.inventory_publisher = inventory_publisher
+        # G4 peer tier (kv_plane.RemoteBlockSource), set by the worker
+        # main when a KV plane runs: blocks the fleet holds but this
+        # worker lacks are "pulled" (real plane round trip; the sim
+        # discards the bytes and counts the block as onboarded).
+        self.remote_source = None
         self.waiting: list[_Seq] = []
         self.prefilling: list[_Seq] = []
         self.decoding: list[_Seq] = []
@@ -204,6 +246,8 @@ class MockerEngine(AsyncEngine):
                 # dtpu: ignore[unbounded-wait] -- see above
                 await self._wake.wait()
             now = time.monotonic()
+            if self.remote_source is not None and self.waiting:
+                await self._peer_consult()
             self._admit(now)
             # Complete prefills whose simulated time has elapsed.
             for seq in list(self.prefilling):
@@ -237,6 +281,37 @@ class MockerEngine(AsyncEngine):
             except Exception as exc:  # noqa: BLE001 — publishing must not
                 # kill the simulation loop (requests would hang forever).
                 log.warning("mocker publish failed: %s", exc)
+
+    async def _peer_consult(self) -> None:
+        """G4 consult for the queue head: the run of blocks past the
+        local prefix (G1 + host sim) is fetched from peers over the
+        REAL KV plane (executor — the blocking socket round trip must
+        not sit on the event loop); fetched blocks inject as reusable
+        local blocks so _admit counts them as hits. One consult per
+        sequence (the flag), recompute is the silent fallback."""
+        seq = self.waiting[0]
+        if getattr(seq, "peer_consulted", False):
+            return
+        seq.peer_consulted = True
+        hashes = seq.blocks.block_hashes
+        local = 0
+        for h in hashes:
+            if h in self.kv._blocks or h in self.kv.host:
+                local += 1
+            else:
+                break
+        want = hashes[local:]
+        if not want:
+            return
+        loop = asyncio.get_running_loop()
+        try:
+            fetched = await loop.run_in_executor(
+                None, self.remote_source.fetch, want, len(want))
+        except Exception:  # noqa: BLE001 — peers are best-effort
+            log.warning("mocker peer consult failed", exc_info=True)
+            return
+        for h, _ in fetched:
+            self.kv.inject(h)
 
     def _admit(self, now: float) -> None:
         cfg = self.config
@@ -337,20 +412,41 @@ class MockerEngine(AsyncEngine):
                 gpu_prefix_cache_hit_rate=hit_rate)), force=force)
 
     # -- KV observability (docs/OBSERVABILITY.md "KV & capacity") -------------
+    def host_block_provider(self, block_hash: int):
+        """KvPlaneServer ``blocks`` provider: serve any block this
+        worker holds (G1 or the host sim) to peer pulls, as a tiny
+        placeholder parcel (the sim's content is its hash). Runs on a
+        plane connection thread — dict lookups racing the loop degrade
+        to a miss, never an error."""
+        import numpy as np
+        try:
+            held = (block_hash in self.kv._blocks
+                    or block_hash in self.kv.host)
+        except RuntimeError:  # mutated mid-lookup: treat as miss
+            held = False
+        return np.full((2, 1, 1, 8), block_hash & 0xFFFF,
+                       np.float32) if held else None
+
     def inventory_digest(self):
         """Same digest shape the TPU engine publishes, from the
-        simulated block pool (fleet-pane tests without hardware)."""
+        simulated block pool (fleet-pane tests without hardware). The
+        sketch covers the host-tier sim too — the federated router's
+        view of blocks that left the radix index on eviction."""
         from dynamo_tpu.llm.kv_router.protocols import (KvInventoryDigest,
                                                         kmin_sketch)
         cfg = self.config
         hashes = list(self.kv._blocks.keys())
+        tier_blocks = {"g1": len(hashes)}
+        host_hashes = list(self.kv.host.keys())
+        if self.kv.host_capacity > 0:
+            tier_blocks["g2"] = len(host_hashes)
         return KvInventoryDigest(
             blocks=len(hashes),
-            tier_blocks={"g1": len(hashes)},
+            tier_blocks=tier_blocks,
             pages_total=cfg.num_kv_blocks,
             pages_free=cfg.num_kv_blocks - self.kv.active_blocks,
             pages_active=self.kv.active_blocks,
-            sketch=kmin_sketch(hashes))
+            sketch=kmin_sketch(hashes + host_hashes))
 
     async def _publish_inventory(self) -> None:
         if self.inventory_publisher is None:
@@ -375,11 +471,17 @@ class MockerEngine(AsyncEngine):
                 "reuse_hit_blocks": self.prefix_hits,
                 "reuse_lookup_blocks": self.prefix_lookups,
             },
-            "tiers": {},
+            "tiers": ({"g2_blocks": len(self.kv.host),
+                       "g2_capacity": self.kv.host_capacity,
+                       "g2_spills_in": self.kv.host_spills,
+                       "g2_onboards": self.kv.host_onboards}
+                      if self.kv.host_capacity > 0 else {}),
             "reuse": {"prefix_hit_blocks": self.prefix_hits,
-                      "prefix_lookup_blocks": self.prefix_lookups},
+                      "prefix_lookup_blocks": self.prefix_lookups,
+                      "onboard_blocks_peer": self.kv.peer_onboards},
             "plane": None,
-            "remote": None,
+            "remote": (self.remote_source.stats()
+                       if self.remote_source is not None else None),
             "digest": self.inventory_digest().to_wire(),
         }
 

@@ -73,6 +73,12 @@ class EventKind:
     PLANNER_DECISION = "planner_decision"
     CANARY_OK = "canary_ok"
     CANARY_FAIL = "canary_fail"
+    # KV federation (engine/kvbm.py + llm/kv_plane.py): tier placement
+    # decisions — watermark demotions down the ladder, promote-on-hit
+    # back up it, and cross-worker block pulls over the KV plane.
+    KV_DEMOTE = "kv_demote"
+    KV_PROMOTE = "kv_promote"
+    KV_PEER_PULL = "kv_peer_pull"
     # Synthesized by the timeline merge, never by emit sites: a worker's
     # delta stream skipped seqs (publisher overflow, dropped frames) or
     # restarted (new boot id).

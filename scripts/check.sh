@@ -73,6 +73,17 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
     -k 'parity or agrees or capacity or teacher' \
     -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
 
+echo "== federation smoke (KVBM tiers + inventory routing + peer pulls) =="
+# 2-mocker fleet: a prefix cached only in worker B's host tier routes
+# to B under federated scoring (cache_aware_rate rises vs the same
+# workload radix-only), and a peer pull moves blocks over the real KV
+# plane with a kv_peer_pull journal event. Plus the KVBM watermark/pin
+# policy units (docs/OBSERVABILITY.md "KV federation").
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_kv_federation.py -q -m 'not slow' \
+    -k 'smoke or watermark or pinned or breaker' \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+
 echo "== chunked-prefill smoke (stall-free scheduling) =="
 # Tiny CPU model: one long prompt prefilling in chunks with concurrent
 # short decoders — asserts completion, decode windows interleaved between
