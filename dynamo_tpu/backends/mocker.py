@@ -26,6 +26,7 @@ from dynamo_tpu.llm.mocker import MockerConfig, MockerEngine
 from dynamo_tpu.llm.model_card import (ModelRuntimeConfig, deregister_llm,
                                        register_llm)
 from dynamo_tpu.llm.reconfig import ROLES, RoleManager, ServingProfile
+from dynamo_tpu.llm.standby import ScaleAgent
 from dynamo_tpu.llm.tokenizer import Tokenizer, make_test_tokenizer
 from dynamo_tpu.runtime import journal
 from dynamo_tpu.runtime.config import RuntimeConfig
@@ -57,6 +58,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument("--mode", default="agg", choices=list(ROLES),
                         help="launch role; runtime-reconfigurable via "
                              "SetRole (llm/reconfig.py)")
+    parser.add_argument("--standby", action="store_true",
+                        help="park as a pre-warmed standby: simulator "
+                             "ready but DEREGISTERED, announced on a "
+                             "standby/ lease key, joining the serving "
+                             "fleet only on a planner promote "
+                             "directive (llm/standby.py)")
     parser.add_argument("--prefill-component", default="prefill",
                         help="component the prefill role registers under")
     return parser.parse_args(argv)
@@ -150,7 +157,11 @@ async def run(args: argparse.Namespace) -> None:
                             event["value"].get("model") == args.model_name:
                         peers[event["key"]] = event["value"]["addr"]
                     elif event["event"] == "delete":
-                        peers.pop(event["key"], None)
+                        gone = peers.pop(event["key"], None)
+                        if gone is not None:
+                            # worker_leave/scale-in: drop the peer AND
+                            # its breaker state now, not at TTL.
+                            engine.remote_source.drop_peer(gone)
                     engine.remote_source.peers = [
                         a for a in peers.values() if a != plane.address]
 
@@ -169,7 +180,15 @@ async def run(args: argparse.Namespace) -> None:
                             role=args.mode,
                             status_extra={"backend": "mocker",
                                           "model": args.model_name})
-        await roles.start()
+        # Autoscaling: every worker answers scale directives (retire);
+        # --standby parks warm and deregistered until a promote.
+        scale_agent = ScaleAgent(
+            runtime, roles, standby=args.standby,
+            status_extra={"backend": "mocker", "model": args.model_name},
+            metrics=runtime.metrics)
+        if not args.standby:
+            await roles.start()
+        await scale_agent.start()
         engine.start()
         status_server = None
         if cfg.system_enabled:
@@ -179,14 +198,17 @@ async def run(args: argparse.Namespace) -> None:
                                                port=cfg.system_port,
                                                role_manager=roles,
                                                kv_provider=engine.kv_status,
-                                               perf_provider=engine.perf_status)
+                                               perf_provider=engine.perf_status,
+                                               scale_agent=scale_agent)
             await status_server.start()
             await register_status_server(
                 runtime, status_server.port,
                 extra={"backend": "mocker", "component": args.component,
                        "model": args.model_name})
-        port = roles.profile.servers[0].port if roles.profile.servers else 0
-        print(f"MOCKER_READY mode={args.mode} port={port} "
+        port = (roles.profile.servers[0].port
+                if roles.profile and roles.profile.servers else 0)
+        mode = "standby" if args.standby else args.mode
+        print(f"MOCKER_READY mode={mode} port={port} "
               f"worker={runtime.instance_id:x}", flush=True)
         import signal
         loop = asyncio.get_running_loop()
@@ -205,6 +227,7 @@ async def run(args: argparse.Namespace) -> None:
         await engine.stop()
         if status_server is not None:
             await status_server.stop()
+        await scale_agent.stop()
         await roles.stop()
     finally:
         await runtime.close()

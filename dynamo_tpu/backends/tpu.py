@@ -157,6 +157,14 @@ def parse_args(argv=None) -> argparse.Namespace:
                              "worker (serves KV parcels); decode = decode "
                              "worker forwarding long prompts to prefill "
                              "workers")
+    parser.add_argument("--standby", action="store_true",
+                        help="park as a pre-warmed standby: weights "
+                             "loaded and warmup run, but DEREGISTERED "
+                             "— announced on a standby/ lease key and "
+                             "joining the serving fleet in seconds on "
+                             "a planner promote directive "
+                             "(llm/standby.py; docs/RESILIENCE.md "
+                             "\"Autoscaling\")")
     parser.add_argument("--max-local-prefill-length", type=int, default=512,
                         help="decode mode: prompts longer than this prefill "
                              "remotely (conditional disaggregation; dynamic "
@@ -533,7 +541,12 @@ async def run(args: argparse.Namespace) -> None:
                                     event["value"].get("model") == model_name:
                                 peers[event["key"]] = event["value"]["addr"]
                             elif event["event"] == "delete":
-                                peers.pop(event["key"], None)
+                                gone = peers.pop(event["key"], None)
+                                if gone is not None:
+                                    # worker_leave/scale-in: drop the
+                                    # peer AND its breaker state now,
+                                    # not at staleness TTL.
+                                    engine.remote_source.drop_peer(gone)
                             engine.remote_source.peers = [
                                 a for a in peers.values()
                                 if a != plane.address]
@@ -563,6 +576,7 @@ async def run(args: argparse.Namespace) -> None:
                 "(queue replies carry plane tickets); drop "
                 "--no-kv-plane or use --prefill-dispatch direct")
         from dynamo_tpu.llm.reconfig import RoleManager
+        from dynamo_tpu.llm.standby import ScaleAgent
         roles = RoleManager(
             runtime,
             make_profile_builder(runtime, args, engine, engine_cfg,
@@ -570,7 +584,17 @@ async def run(args: argparse.Namespace) -> None:
                                  prefill_component),
             role=args.mode,
             status_extra={"backend": "tpu", "model": model_name})
-        await roles.start()
+        # Autoscaling (llm/standby.py): every worker answers scale
+        # directives (retire drains it out); --standby parks it warm
+        # and deregistered until the planner promotes it. The engine is
+        # already built — weights loaded, warmup done — so the promote
+        # pays only registration, not cold start.
+        scale_agent = ScaleAgent(
+            runtime, roles, standby=args.standby,
+            status_extra={"backend": "tpu", "model": model_name},
+            metrics=runtime.metrics)
+        if not args.standby:
+            await roles.start()
         # Fleet inventory digests (KV & capacity plane): published from
         # the engine loop alongside KV events + ForwardPassMetrics, with
         # a periodic republish so an idle worker still shows up.
@@ -599,6 +623,9 @@ async def run(args: argparse.Namespace) -> None:
             runtime.require_coordinator(), cfg.namespace,
             f"{runtime.instance_id:x}")
         journal_pub.start_periodic()
+        # After journal.configure: the standby_ready event must carry
+        # this worker's id, not the "proc" placeholder.
+        await scale_agent.start()
         status_server = None
         if cfg.system_enabled:
             from dynamo_tpu.llm.fleet import register_status_server
@@ -607,7 +634,8 @@ async def run(args: argparse.Namespace) -> None:
                                                port=cfg.system_port,
                                                role_manager=roles,
                                                kv_provider=engine.kv_status,
-                                               perf_provider=engine.perf_status)
+                                               perf_provider=engine.perf_status,
+                                               scale_agent=scale_agent)
             await status_server.start()
             # Advertise for the frontend's /debug/fleet fan-out
             # (lease-bound: the entry dies with this worker).
@@ -615,8 +643,10 @@ async def run(args: argparse.Namespace) -> None:
                 runtime, status_server.port,
                 extra={"backend": "tpu", "component": args.component,
                        "model": model_name})
-        port = roles.profile.servers[0].port if roles.profile.servers else 0
-        print(f"TPU_WORKER_READY mode={args.mode} port={port} "
+        port = (roles.profile.servers[0].port
+                if roles.profile and roles.profile.servers else 0)
+        mode = "standby" if args.standby else args.mode
+        print(f"TPU_WORKER_READY mode={mode} port={port} "
               f"worker={runtime.instance_id:x} pages={engine.runner.num_pages}",
               flush=True)
         import signal
@@ -649,6 +679,7 @@ async def run(args: argparse.Namespace) -> None:
         # The role manager owns the serving profile: endpoint servers and
         # role-specific machinery (queue workers, disagg clients/watches)
         # all tear down through it, whatever role we ended up in.
+        await scale_agent.stop()
         await roles.stop()
         if status_server is not None:
             await status_server.stop()

@@ -172,6 +172,13 @@ async def check_coordinator(rep: Report, url: str) -> None:
             rep.add(OK, "disagg config",
                     "; ".join(f"{d['k']}={d['v']}" for d in disagg))
         check_roles(rep, await client.kv_get_prefix("rolestatus/"))
+        check_autoscale(
+            rep,
+            [it["v"] for it in await client.kv_get_prefix("standby/")
+             if isinstance(it.get("v"), dict)],
+            [{"key": it["k"], **it["v"]}
+             for it in await client.kv_get_prefix("scale/")
+             if isinstance(it.get("v"), dict)])
         system = await client.kv_get_prefix("system/")
         if system:
             rep.add(OK, "status servers",
@@ -618,6 +625,96 @@ def check_decision_plane(rep: Report, timeline: dict) -> None:
         rep.add(OK, "canary", "probing active, no live failure streaks")
 
 
+#: A standby not parked "ready" (warming/promoting) for longer than
+#: this is stuck — warmup and joins are seconds, not minutes.
+STANDBY_STUCK_S = 120.0
+#: A pending scale directive older than this never applied.
+SCALE_STUCK_S = 120.0
+#: Scale direction changes in the timeline window that count as thrash.
+SCALE_THRASH_N = 3
+#: Canary failures after a worker_join that count as a rejected join.
+CANARY_REJECT_N = 2
+
+
+def check_autoscale(rep: Report, standbys: list[dict],
+                    directives: list[dict],
+                    events: list[dict] | None = None) -> None:
+    """Autoscaling health (docs/RESILIENCE.md "Autoscaling"): standby
+    pool state, stuck scale directives, and — given timeline events —
+    scale thrash and canary-rejected joins. Pure function over the
+    coordinator listings / timeline payload so it unit-tests without
+    HTTP."""
+    now = time.time()
+    if not standbys and not directives and not events:
+        return  # no autoscaling deployed: nothing to report
+    ready = stuck = 0
+    for s in standbys:
+        state = s.get("state", "?")
+        age = now - float(s.get("ts") or now)
+        if state == "ready":
+            ready += 1
+        elif age > STANDBY_STUCK_S:
+            stuck += 1
+            rep.add(WARN, f"standby {s.get('worker', '?')}",
+                    f"state={state} for {age:.0f}s — warmup or join is "
+                    "wedged (a join should take seconds)")
+    if standbys and not stuck:
+        rep.add(OK, "standby pool",
+                f"{len(standbys)} parked ({ready} ready to promote)")
+    elif not standbys and directives:
+        # Scale directives in flight but nothing warm to promote: the
+        # next scale-out pays cold-start (minutes), not seconds.
+        rep.add(WARN, "standby pool",
+                "empty while scaling is active — launch workers with "
+                "--standby so scale-outs promote instead of cold-start")
+    for d in directives:
+        age = now - float(d.get("ts") or now)
+        if age > SCALE_STUCK_S:
+            rep.add(WARN, f"scale directive {d.get('key', '?')}",
+                    f"{d.get('action', '?')} pending {age:.0f}s without "
+                    "applying — target dead or fenced out; the scaler "
+                    "should have reaped it (planner down?)")
+    if events:
+        # Thrash: scale_out/scale_in direction flips in the window.
+        actions = [e["attrs"].get("action") for e in events
+                   if e.get("kind") == "planner_decision"
+                   and (e.get("attrs") or {}).get("action")
+                   in ("scale_out", "scale_out_cold", "scale_in")]
+        flips = sum(1 for a, b in zip(actions, actions[1:])
+                    if (a == "scale_in") != (b == "scale_in"))
+        if flips >= SCALE_THRASH_N:
+            rep.add(WARN, "autoscale thrash",
+                    f"{flips} scale direction changes in the timeline "
+                    "window — widen hysteresis/cooldown "
+                    "(DTPU_PLANNER_CAPACITY_*)")
+        elif actions:
+            rep.add(OK, "autoscale",
+                    f"{len(actions)} scale action(s), no thrash")
+        # Canary-rejected joins: fails attributed to a recently-joined
+        # worker with no admitting canary_ok after them.
+        joined: set[str] = set()
+        fails_after_join: dict[str, int] = {}
+        for e in events:
+            attrs = e.get("attrs") or {}
+            kind = e.get("kind")
+            if kind == "worker_join":
+                joined.add(str(attrs.get("instance") or "?"))
+            elif kind == "canary_fail":
+                w = str(attrs.get("worker_id") or "?")
+                if w in joined:
+                    fails_after_join[w] = fails_after_join.get(w, 0) + 1
+            elif kind == "canary_ok":
+                fails_after_join.pop(str(attrs.get("worker_id") or "?"),
+                                     None)
+        for w, n in sorted(fails_after_join.items()):
+            if n >= CANARY_REJECT_N:
+                rep.add(WARN, f"canary-rejected join {w}",
+                        f"worker joined but failed {n} canary probes and "
+                        "was never admitted — it is held on probation; "
+                        "if it was a standby promote, the scaler should "
+                        "promote a replacement")
+
+
 async def check_timeline(rep: Report, url: str) -> None:
     """Probe GET /debug/timeline and judge the decision plane."""
     import aiohttp
@@ -634,6 +731,8 @@ async def check_timeline(rep: Report, url: str) -> None:
         rep.add(FAIL, "/debug/timeline", f"{url}: {exc}")
         return
     check_decision_plane(rep, timeline)
+    # Timeline-window autoscale judgments (thrash, rejected joins).
+    check_autoscale(rep, [], [], events=timeline.get("events") or [])
 
 
 async def run(args) -> int:

@@ -1532,7 +1532,22 @@ class TPUEngine(AsyncEngine):
         if mm:
             r.no_cache = True
             return self._plan_prefill_multimodal(r, mm)
-        cached_pages = self.allocator.acquire_cached(hashes)
+        # Exact-reproduction contract for seeded sampling (temperature
+        # > 0, tests/test_seeded_sampling.py): prefix reuse changes
+        # WHICH program computes the non-reused tail (with-history
+        # buckets vs the whole/chunked-prompt path), and the low-bit
+        # logit differences flip near-ties under temperature sampling —
+        # the same (prompt, seed) would emit different tokens depending
+        # on what happens to be cached. First admission therefore
+        # always takes the canonical no-reuse path; preemption
+        # recompute (r.generated > 0) keeps reuse, because the pages it
+        # finds are the original run's own bit-identical history.
+        s = r.req.sampling_options
+        canonical = (getattr(s, "seed", None) is not None
+                     and (s.temperature or 0.0) > 0.0
+                     and r.generated == 0)
+        cached_pages = ([] if canonical
+                        else self.allocator.acquire_cached(hashes))
         reuse_tokens = len(cached_pages) * page
         if reuse_tokens >= len(prompt):
             # Always recompute at least the last token so we have logits.
@@ -1544,8 +1559,9 @@ class TPUEngine(AsyncEngine):
         self.prefix_hit_blocks += len(cached_pages)
         hbm_tokens = reuse_tokens
         # Extend the prefix from the host tiers (G2/G3) before recomputing.
-        extra_pages, extra_tokens, peer_tokens = self._try_onboard(
-            r, hashes, cached_pages)
+        extra_pages, extra_tokens, peer_tokens = (
+            ([], 0, 0) if canonical
+            else self._try_onboard(r, hashes, cached_pages))
         cached_pages = cached_pages + extra_pages
         reuse_tokens += extra_tokens
         r.reuse_tokens = reuse_tokens

@@ -106,6 +106,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument("--canary-ttft-bound-ms", type=float, default=None,
                         help="a canary first token slower than this "
                              "fails the probe")
+    parser.add_argument("--canary-gate-joins", action="store_true",
+                        help="canary-gated admission: a joining worker "
+                             "(standby promote, fresh pod) is held on "
+                             "breaker probation — zero user traffic — "
+                             "until a canary probe chain passes "
+                             "(docs/RESILIENCE.md \"Autoscaling\")")
     parser.add_argument("--coordinator-url", default=None)
     parser.add_argument("--grpc-port", type=int, default=None,
                         help="also serve the KServe v2 gRPC inference "
@@ -180,8 +186,17 @@ async def run(args: argparse.Namespace) -> None:
         canary_cfg.interval_s = args.canary_interval_s
     if args.canary_ttft_bound_ms is not None:
         canary_cfg.ttft_bound_ms = args.canary_ttft_bound_ms
+    if args.canary_gate_joins:
+        canary_cfg.enabled = True
+        canary_cfg.gate_joins = True
     canary = (CanaryProber(manager, canary_cfg, metrics=runtime.metrics)
               if canary_cfg.enabled else None)
+    if canary is not None:
+        # Fleet-membership hooks: joins go on probation until a probe
+        # chain passes (gate_joins), leaves clear probe state so a
+        # rejoining worker starts clean.
+        watcher.on_join = canary.note_join
+        watcher.on_leave = canary.note_leave
     service = HttpService(runtime, manager, args.http_host, args.http_port,
                           tls_cert_path=args.tls_cert_path,
                           tls_key_path=args.tls_key_path,

@@ -66,6 +66,14 @@ class CanaryConfig:
     # wedged ("timeout") — this is what catches the hung-but-leased
     # worker user traffic would otherwise discover.
     timeout_s: float = 10.0
+    # Canary-gated join (autoscaling, docs/RESILIENCE.md
+    # "Autoscaling"): a worker that joins holds its breaker on
+    # PROBATION — no user traffic at all — until a probe chain passes;
+    # the releasing canary_ok is caused by the worker_join event so
+    # the admission is one walkable chain on /debug/timeline.
+    gate_joins: bool = False
+    # How many consecutive ok probes release a probation hold.
+    gate_probes: int = 1
 
 
 def apply_canary_env(cfg: CanaryConfig) -> CanaryConfig:
@@ -90,6 +98,10 @@ class CanaryProber:
         self._fails: dict[int, int] = {}
         self._fail_refs: dict[int, str] = {}
         self._stats: dict[int, dict] = {}
+        # Canary-gated joins: worker id -> {"join_ref", "ok_streak"}.
+        # Membership means the worker's breaker is held on probation.
+        self._probation: dict[int, dict] = {}
+        self._gate_tasks: set[asyncio.Task] = set()
         self.sweeps = 0
         self._m_probes = self._m_ttft = None
         if metrics is not None:
@@ -124,6 +136,40 @@ class CanaryProber:
                 raise
             except Exception:  # noqa: BLE001 — probing must never die
                 log.exception("canary sweep failed")
+
+    # -- join gating (discovery hooks) ----------------------------------------
+    def note_join(self, served, iid: int) -> None:
+        """Discovery worker_join hook: with ``gate_joins`` on, hold the
+        worker's breaker (probation — routers exclude it, half-open
+        probes included) and probe it IMMEDIATELY instead of waiting
+        out the sweep interval. The probe that passes releases the
+        hold; until then no user request can reach the worker."""
+        if not self.cfg.gate_joins or iid in self._probation:
+            return
+        join_ref = journal.recent_ref(EventKind.WORKER_JOIN)
+        self._probation[iid] = {"join_ref": join_ref, "ok_streak": 0}
+        served.client.breakers.hold(iid, cause=join_ref)
+        log.info("canary: worker %x joined on probation; probing now", iid)
+        task = asyncio.get_running_loop().create_task(
+            self._gate_probe(served, iid))
+        self._gate_tasks.add(task)
+        task.add_done_callback(self._gate_tasks.discard)
+
+    def note_leave(self, served, iid: int) -> None:
+        """Discovery worker_leave hook: forget the worker's probe state
+        (a rejoining worker starts a fresh probation, not an inherited
+        failure streak)."""
+        self._probation.pop(iid, None)
+        self._fails.pop(iid, None)
+        self._fail_refs.pop(iid, None)
+
+    async def _gate_probe(self, served, iid: int) -> None:
+        try:
+            await self.probe(served, iid)
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — the sweep loop retries
+            log.exception("canary join probe failed")
 
     async def sweep(self) -> int:
         """Probe every worker of every remotely-served model once.
@@ -224,17 +270,33 @@ class CanaryProber:
             streak, ref = self._fails.pop(iid, 0), self._fail_refs.pop(
                 iid, None)
             stat["consecutive_fails"] = 0
+            ok_ref = None
             if streak:
                 ok_ref = journal.emit(
                     EventKind.CANARY_OK, cause=ref, worker_id=worker,
                     model=model, recovered_after=streak)
                 log.info("canary: worker %s recovered after %d failures",
                          worker, streak)
-            else:
-                ok_ref = None
-            # Only a recovering breaker gets the success signal: steady
-            # canary TTFTs must not pollute the breaker's latency EWMA
-            # (a tiny probe is far faster than real traffic).
+            gate = self._probation.get(iid)
+            if gate is not None:
+                gate["ok_streak"] += 1
+                if gate["ok_streak"] < max(1, self.cfg.gate_probes):
+                    return  # probation holds until the chain completes
+                self._probation.pop(iid, None)
+                if ok_ref is None:
+                    # The admitting event: caused by the join that put
+                    # the worker on probation — the last link of the
+                    # scale-out chain on /debug/timeline.
+                    ok_ref = journal.emit(
+                        EventKind.CANARY_OK,
+                        cause=ref or gate["join_ref"], worker_id=worker,
+                        model=model, admitted=True,
+                        probes=gate["ok_streak"])
+                log.info("canary: worker %s passed join probation; "
+                         "admitting", worker)
+            # Only a recovering/held breaker gets the success signal:
+            # steady canary TTFTs must not pollute the breaker's latency
+            # EWMA (a tiny probe is far faster than real traffic).
             from dynamo_tpu.runtime.overload import CLOSED
             if board.state(iid) != CLOSED:
                 board.record_success(iid, ttft, cause=ok_ref)
@@ -257,6 +319,8 @@ class CanaryProber:
         return {
             "enabled": True,
             "interval_s": self.cfg.interval_s,
+            "gate_joins": self.cfg.gate_joins,
+            "probation": sorted(f"{iid:x}" for iid in self._probation),
             "sweeps": self.sweeps,
             "workers": {f"{iid:x}": dict(stat)
                         for iid, stat in sorted(self._stats.items())},

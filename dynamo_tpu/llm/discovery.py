@@ -84,6 +84,11 @@ class ModelWatcher:
         self.manager = manager
         self.router_mode = router_mode
         self._kv_router_factory = kv_router_factory
+        # Fleet-membership hooks, called as (served, instance_id) after
+        # the join/leave journal event: the canary prober gates joins
+        # through them (llm/canary.py note_join/note_leave).
+        self.on_join = None
+        self.on_leave = None
         # Storage-pluggable discovery plane (reference key_value_store.rs
         # trait): any runtime.storage.KeyValueStore carries the model-entry
         # watch and tokenizer artifacts; default is the coordinator.
@@ -130,9 +135,16 @@ class ModelWatcher:
                 served.instances.add(iid)
                 # Decision plane: fleet membership changes are the raw
                 # material of most incident chains ("the flip happened
-                # because the fleet lost a worker").
+                # because the fleet lost a worker"). A join caused by a
+                # standby promotion names it when the promote event
+                # already reached this process's timeline collector.
                 journal.emit(EventKind.WORKER_JOIN, model=entry.model_name,
                              instance=instance_hex)
+                if self.on_join is not None:
+                    try:
+                        self.on_join(served, iid)
+                    except Exception:  # noqa: BLE001 — a hook, not a gate
+                        log.exception("on_join hook failed")
 
     async def _on_delete(self, key: str) -> None:
         parts = key[len(MODEL_ROOT):].split("/")
@@ -157,6 +169,19 @@ class ModelWatcher:
                         cause=(journal.recent_ref(EventKind.CHAOS_INJECT)
                                if chaos.ACTIVE else None),
                         model=name, instance=instance_hex)
+                    # Fleet-membership pruning beats staleness TTLs: the
+                    # KV router drops the worker's inventory/index and
+                    # breaker state NOW (scale-in must not leave ghosts
+                    # that attract routing for 30 more seconds).
+                    note_leave = getattr(served.router,
+                                         "note_worker_leave", None)
+                    if note_leave is not None:
+                        note_leave(iid)
+                    if self.on_leave is not None:
+                        try:
+                            self.on_leave(served, iid)
+                        except Exception:  # noqa: BLE001
+                            log.exception("on_leave hook failed")
                 if not served.instances:
                     log.info("model %s: last instance gone; removing", name)
                     await self._close_served(served)

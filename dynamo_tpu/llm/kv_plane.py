@@ -786,6 +786,17 @@ class RemoteBlockSource:
         self._cooldown.pop(addr, None)
         self._fail_streak.pop(addr, None)
 
+    def drop_peer(self, addr: str) -> None:
+        """Fleet-membership hook (worker_leave / scale-in): forget the
+        peer NOW — its address leaves the consult list and its breaker
+        state dies with it, instead of waiting out staleness TTLs. A
+        worker that later rejoins on the same address starts with a
+        clean breaker rather than inheriting the dead incarnation's
+        open curve."""
+        self.peers = [a for a in self.peers if a != addr]
+        self._cooldown.pop(addr, None)
+        self._fail_streak.pop(addr, None)
+
     def fetch(self, hashes: list[int], max_blocks: int,
               trace_id: str | None = None) -> list[tuple[int, np.ndarray]]:
         """SYNC (engine thread, between windows): returns the longest

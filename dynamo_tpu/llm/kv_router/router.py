@@ -217,6 +217,26 @@ class KvPushRouter(AsyncEngine):
                     self._g_digest_age.set(now - entry[0],
                                            worker=f"{worker:x}")
 
+    def note_worker_leave(self, worker_id: int) -> None:
+        """Discovery worker_leave hook (scale-in, crash): drop the
+        worker's routing state IMMEDIATELY instead of waiting out the
+        prune loop's 3 absent ticks + digest staleness TTL — a retired
+        worker's inventory must not keep attracting federated routing,
+        and its breaker must not survive into a reincarnation."""
+        self.indexer.tree.remove_worker(worker_id)
+        self.scheduler.remove_worker(worker_id)
+        self.fleet.remove_worker(worker_id)
+        breakers = getattr(self.client, "breakers", None)
+        if breakers is not None:
+            breakers.remove(worker_id)
+        hexid = f"{worker_id:x}"
+        for gauge in (self._g_usage, self._g_active_blocks,
+                      self._g_total_blocks, self._g_hit_rate,
+                      self._g_inventory):
+            gauge.set(0, worker=hexid)
+        log.info("worker %x left; routing state dropped immediately",
+                 worker_id)
+
     def kv_status(self) -> dict:
         """This router's /debug/kv block: index size, fleet inventory
         view, and decision telemetry (runtime/health.py _debug_kv)."""

@@ -67,6 +67,11 @@ ROLES = ("prefill", "decode", "agg")
 #: Why a stream died during a drain — the typed migration reason.
 DRAIN_REASON = "role_flip"
 
+#: The scale-in flavor: a retire drain kills leftovers with
+#: ``incomplete:scale_in`` frames so the ledger can attribute the
+#: migration cost to autoscaling, not to role flips.
+SCALE_IN_REASON = "scale_in"
+
 ROLE_ROOT = "role/"
 ROLE_STATUS_ROOT = "rolestatus/"
 
@@ -87,6 +92,11 @@ class RoleState:
     SERVING = "serving"
     DRAINING = "draining"
     FLIPPING = "flipping"
+    # Terminal: a scale-in retire drained this worker out of the fleet
+    # (llm/standby.py scale directives). The status key vanishes with
+    # the worker's lease moments later; "retired" is the short-lived
+    # honest answer in between.
+    RETIRED = "retired"
 
 
 #: role_flips_total outcome vocabulary. ``ok``/``failed`` terminate a
@@ -187,6 +197,10 @@ class RoleManager:
         self.drain_s = (drain_s if drain_s is not None
                         else runtime.config.retire_drain_s)
         self._extra = dict(status_extra or {})
+        # Scale-in hook: called once after a retire() drain completes
+        # (worker mains wire runtime.shutdown so the process exits and
+        # its lease — and status key — die with it).
+        self._on_retired: Callable | None = None
         self._lock = asyncio.Lock()
         self._watch = None
         self._watch_task: asyncio.Task | None = None
@@ -250,6 +264,10 @@ class RoleManager:
         if role not in ROLES:
             raise RoleTransitionError(
                 f"unknown role {role!r} (want one of {ROLES})")
+        if self.state == RoleState.RETIRED:
+            raise RoleTransitionError(
+                "worker is retired (scale-in drained it); no further "
+                "role transitions apply")
         epoch = int(epoch)
         if self._lock.locked():
             # Fast-path fencing against the in-flight flip WITHOUT
@@ -266,6 +284,14 @@ class RoleManager:
                 f"{self._inflight_epoch}) in flight; retry after it "
                 "converges")
         async with self._lock:
+            if self.state == RoleState.RETIRED:
+                # A retire won the race for the lock: this worker is out
+                # of the fleet, the flip must target someone else.
+                self._note_fence(self.role, role, epoch, "rejected_stale",
+                                 cause=cause)
+                raise RoleTransitionError(
+                    "worker retired while the flip waited; no further "
+                    "role transitions apply")
             if epoch <= self.applied_epoch:
                 if role == self.role and epoch == self.applied_epoch:
                     # Exact duplicate of the applied directive: idempotent.
@@ -359,6 +385,99 @@ class RoleManager:
         log.info("role flip %s -> %s (epoch %d): %s", old, role, epoch,
                  outcome)
         return self.last_outcome
+
+    # -- the Retire verb (scale-in; planner/capacity.py) ----------------------
+    async def retire(self, epoch: int, issued_by: str = "planner",
+                     drain_s: float | None = None,
+                     cause: str | None = None) -> dict:
+        """Drain this worker OUT of the fleet (scale-in). Shares the
+        SetRole lock and epoch fence, so a retire racing a role flip
+        resolves to exactly one winner — the loser rejects typed
+        (RoleTransitionError), never both. The drain reuses the flip
+        machinery with reason ``scale_in``: deregister-first, in-flight
+        streams finish within the budget or are killed with typed
+        ``incomplete:scale_in`` frames that migrate. On completion the
+        ``on_retired`` callback (worker main: runtime.shutdown) fires.
+        """
+        epoch = int(epoch)
+        if self.state == RoleState.RETIRED:
+            if epoch == self.applied_epoch:
+                return {"action": "retire", "epoch": epoch,
+                        "outcome": "duplicate", "state": self.state}
+            raise RoleTransitionError("worker is already retired")
+        if self._lock.locked():
+            if self.target_role is None and self._inflight_epoch == epoch:
+                # Duplicate of the running retire: acknowledged.
+                return {"action": "retire", "epoch": epoch,
+                        "outcome": "duplicate", "state": self.state}
+            self._note_retire_fence(epoch, "rejected_busy", cause=cause)
+            raise RoleTransitionError(
+                f"transition (epoch {self._inflight_epoch}) in flight; "
+                "retire rejected")
+        async with self._lock:
+            if epoch <= self.applied_epoch:
+                self._note_retire_fence(epoch, "rejected_stale", cause=cause)
+                raise RoleTransitionError(
+                    f"stale retire epoch {epoch} (applied epoch "
+                    f"{self.applied_epoch})")
+            self._inflight_epoch = epoch
+            budget = self.drain_s if drain_s is None else drain_s
+            log.info("scale-in retire (epoch %d, by %s): draining up to "
+                     "%.1fs", epoch, issued_by, budget)
+            requested_ref = journal.emit(
+                EventKind.SCALE_RETIRE, cause=cause, phase="draining",
+                epoch=epoch, issued_by=issued_by,
+                inflight=self.profile.inflight if self.profile else 0,
+                drain_s=budget)
+            outcome, error = "ok", None
+            with span("role.retire", epoch=epoch, issued_by=issued_by) as sp:
+                try:
+                    self.state = RoleState.DRAINING
+                    await self._write_status()
+                    if self.profile is not None:
+                        with span("role.drain",
+                                  inflight=self.profile.inflight):
+                            await self.profile.drain(
+                                budget, reason=SCALE_IN_REASON)
+                        await self.profile.close()
+                        self.profile = None
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:  # noqa: BLE001 — typed outcome
+                    outcome = "failed"
+                    error = f"{type(exc).__name__}: {exc}"
+                    log.exception("scale-in drain failed; retiring anyway")
+                finally:
+                    self.applied_epoch = epoch
+                    self.state = RoleState.RETIRED
+                    self._inflight_epoch = None
+                    self.last_outcome = {
+                        "action": "retire", "epoch": epoch,
+                        "outcome": outcome, "ts": time.time(),
+                        **({"error": error} if error else {})}
+                    journal.emit(EventKind.SCALE_RETIRE, cause=requested_ref,
+                                 phase="done", epoch=epoch, outcome=outcome)
+                    sp.set(outcome=outcome)
+                    await self._write_status()
+            log.info("scale-in retire (epoch %d): %s", epoch, outcome)
+        if self._on_retired is not None:
+            try:
+                res = self._on_retired()
+                if asyncio.iscoroutine(res):
+                    await res
+            except Exception:  # noqa: BLE001 — shutdown hook best-effort
+                log.exception("on_retired hook failed")
+        return self.last_outcome
+
+    def _note_retire_fence(self, epoch: int, outcome: str,
+                           cause: str | None = None) -> None:
+        self.last_outcome = {"action": "retire", "epoch": epoch,
+                             "outcome": outcome, "ts": time.time()}
+        journal.emit(EventKind.SCALE_RETIRE, cause=cause, phase="rejected",
+                     epoch=epoch, outcome=outcome)
+        if self._m_flips is not None:
+            self._m_flips.inc(**{"from": self.role, "to": "retired",
+                                 "outcome": outcome})
 
     async def _build_with_retry(self, role: str) -> ServingProfile:
         """Build a profile, riding out coordinator outages: registration

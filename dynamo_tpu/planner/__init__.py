@@ -7,6 +7,12 @@ asks a connector to scale prefill/decode replica counts, informed by a
 profiler-built capacity table (benchmarks/profiler/profile_sla.py:52).
 """
 
+from dynamo_tpu.planner.capacity import (
+    CapacityConfig,
+    CapacityModel,
+    FleetScaler,
+    apply_capacity_env,
+)
 from dynamo_tpu.planner.connector import Connector, FakeConnector
 from dynamo_tpu.planner.core import Planner, PlannerConfig, PoolState
 from dynamo_tpu.planner.predictors import (
@@ -30,4 +36,5 @@ __all__ = [
     "ConstantPredictor", "LinearTrendPredictor", "MovingAveragePredictor",
     "make_predictor", "choose_capacity", "profile_sweep",
     "ReconfigConfig", "RoleReconfigurator", "apply_reconfig_env",
+    "CapacityConfig", "CapacityModel", "FleetScaler", "apply_capacity_env",
 ]

@@ -16,6 +16,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 
+from dynamo_tpu.planner.capacity import CapacityConfig, apply_capacity_env
 from dynamo_tpu.planner.connector import FakeConnector
 from dynamo_tpu.planner.core import Planner, PlannerConfig
 from dynamo_tpu.planner.reconfig import ReconfigConfig, apply_reconfig_env
@@ -55,6 +56,16 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="drive live prefill/decode role flips from SLO "
                         "pressure + prefill-queue depth (knobs via "
                         "DTPU_PLANNER_RECONFIG_*; llm/reconfig.py)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="drive worker COUNT from the live capacity "
+                        "model: promote pre-warmed standbys on "
+                        "sustained SLO burn, retire the least-loaded "
+                        "worker on sustained headroom (knobs via "
+                        "DTPU_PLANNER_CAPACITY_*; planner/capacity.py)")
+    p.add_argument("--autoscale-role", default="decode",
+                   help="the role promoted standbys serve")
+    p.add_argument("--autoscale-min", type=int, default=1)
+    p.add_argument("--autoscale-max", type=int, default=8)
     return p.parse_args(argv)
 
 
@@ -90,6 +101,11 @@ async def run(args: argparse.Namespace) -> None:
             model_name=args.model_name,
             reconfig=apply_reconfig_env(
                 ReconfigConfig(enabled=args.reconfig)),
+            capacity=apply_capacity_env(CapacityConfig(
+                enabled=args.autoscale, role=args.autoscale_role,
+                component=args.decode_component,
+                min_workers=args.autoscale_min,
+                max_workers=args.autoscale_max)),
         ), connector, runtime=runtime)
         await planner.start()
         print(f"PLANNER_READY connector={args.connector} "

@@ -29,6 +29,7 @@ import time
 
 from dynamo_tpu.llm.kv_router.protocols import (ForwardPassMetrics,
                                                 load_metrics_subject)
+from dynamo_tpu.planner.capacity import CapacityConfig, FleetScaler
 from dynamo_tpu.planner.connector import Connector
 from dynamo_tpu.planner.predictors import make_predictor
 from dynamo_tpu.planner.reconfig import ReconfigConfig, RoleReconfigurator
@@ -62,6 +63,12 @@ class PlannerConfig:
     # via DTPU_PLANNER_RECONFIG_<FIELD>.
     reconfig: ReconfigConfig = dataclasses.field(
         default_factory=ReconfigConfig)
+    # SLA-driven autoscaling (planner/capacity.py); knobs overridable
+    # via DTPU_PLANNER_CAPACITY_<FIELD>. When enabled it OWNS worker
+    # count for its role — the legacy per-pool replica deciders stand
+    # down so two loops never fight over the same StatefulSet.
+    capacity: CapacityConfig = dataclasses.field(
+        default_factory=CapacityConfig)
 
 
 class PoolState:
@@ -103,6 +110,8 @@ class Planner:
         # Role-flip loop: constructed in start() (needs the coordinator),
         # or injected directly by tests / embedded deployments.
         self.reconfigurator: RoleReconfigurator | None = None
+        # Autoscaler (planner/capacity.py): same injection contract.
+        self.scaler: FleetScaler | None = None
 
     # -- metrics intake -------------------------------------------------------
     async def start(self) -> None:
@@ -124,6 +133,15 @@ class Planner:
                 pressure_fn=self._slo_pressure,
                 queue_depth_fn=(self._queue_depth
                                 if cfg.model_name else None))
+        if cfg.capacity.enabled and self.scaler is None:
+            self.scaler = FleetScaler(
+                client, cfg.namespace, cfg.capacity,
+                connector=self.connector,
+                pressure_fn=self._slo_pressure,
+                queue_depth_fn=(self._queue_depth
+                                if cfg.model_name else None),
+                demand_fn=self._demand,
+                metrics=getattr(self._runtime, "metrics", None))
         # Decision plane: the planner's reconfig decisions (and their
         # input signals) ride the journal subject into the frontend's
         # merged /debug/timeline, same as worker journals.
@@ -146,6 +164,12 @@ class Planner:
         from dynamo_tpu.llm.prefill_queue import queue_name
         client = self._runtime.require_coordinator()
         return await client.queue_len(queue_name(self.config.model_name))
+
+    def _demand(self) -> tuple[int, int]:
+        """Capacity-model demand source: (active, waiting) slots across
+        the decode pool's live metrics stream."""
+        snap = self.decode.snapshot()
+        return snap["active"], snap["waiting"]
 
     async def stop(self) -> None:
         pub = getattr(self, "_journal_pub", None)
@@ -213,20 +237,35 @@ class Planner:
         """One adjustment: observe, predict, decide, scale per pool.
         Returns the decision records (also appended to self.decisions)."""
         cfg = self.config
-        snap = self.decode.snapshot()
-        record = await self._decide(
-            "decode", cfg.decode_component, snap,
-            snap["active"] + snap["waiting"], self.decode.load_pred,
-            cfg.max_num_seqs_per_worker * cfg.target_utilization)
+        capacity_record = None
+        if self.scaler is not None and cfg.capacity.enabled:
+            try:
+                capacity_record = await self.scaler.step()
+                self.decisions.append(capacity_record)
+            except (ConnectionError, OSError, RuntimeError):
+                # The rest of the step must survive a flaky control
+                # plane; the next interval retries.
+                log.warning("capacity scaler step failed", exc_info=True)
         reconfig_record = None
         if self.reconfigurator is not None and self.config.reconfig.enabled:
             try:
                 reconfig_record = await self.reconfigurator.step()
                 self.decisions.append(reconfig_record)
             except (ConnectionError, OSError, RuntimeError):
-                # The scaling half of the step must survive a flaky
-                # control plane; the next interval retries.
                 log.warning("role reconfig step failed", exc_info=True)
+        if self.scaler is not None and cfg.capacity.enabled:
+            # The autoscaler owns worker count: the legacy per-pool
+            # replica deciders stand down (two loops patching the same
+            # StatefulSet would fight).
+            out = {"capacity": capacity_record}
+            if reconfig_record is not None:
+                out["reconfig"] = reconfig_record
+            return out
+        snap = self.decode.snapshot()
+        record = await self._decide(
+            "decode", cfg.decode_component, snap,
+            snap["active"] + snap["waiting"], self.decode.load_pred,
+            cfg.max_num_seqs_per_worker * cfg.target_utilization)
         if self.prefill is None:
             out = {"decode": record}
             if reconfig_record is not None:

@@ -182,19 +182,63 @@ class KubernetesConnector(Connector):
     """Scales the StatefulSets deploy_graph.py renders: component ``c`` of
     graph ``g`` lives in StatefulSet ``g-c`` (deploy_graph._component_name).
     Reference: kubernetes_connector.py (set_component_replicas /
-    add_component)."""
+    add_component).
+
+    Error discipline: an unreachable/flaky API server retries under the
+    unified ``policies.KUBE_SCALE`` curve (runtime/retry.py, bounded);
+    exhausting it journals a typed ``planner_decision`` failure and
+    returns instead of raising into the planner's ``step()`` — the next
+    adjustment interval re-decides from fresh signals, which is the
+    correct retry for a scaling loop. Kubernetes API *rejections*
+    (KubeAPIError: RBAC, bad namespace) are real configuration bugs
+    and still propagate."""
 
     def __init__(self, graph_name: str, api: KubernetesAPI | None = None):
         self.graph_name = graph_name
         self.api = api or KubernetesAPI()
+        self.scale_failures = 0
 
     def _sts(self, component: str) -> str:
         return f"{self.graph_name}-{component}"
 
     async def scale(self, component: str, replicas: int) -> None:
+        from dynamo_tpu.runtime import journal
+        from dynamo_tpu.runtime.journal import EventKind
+        from dynamo_tpu.runtime.retry import Backoff, policies
         name = self._sts(component)
-        await self.api.set_replicas(name, replicas)
-        log.info("scaled %s -> %d replicas", name, replicas)
+        backoff = Backoff(policies.KUBE_SCALE)
+        while True:
+            try:
+                await self.api.set_replicas(name, replicas)
+                log.info("scaled %s -> %d replicas", name, replicas)
+                return
+            except KubeAPIError:
+                raise  # API rejection: a config bug, not a transient
+            except (ConnectionError, OSError, asyncio.TimeoutError) as exc:
+                if await backoff.sleep():
+                    continue
+                self.scale_failures += 1
+                journal.emit(
+                    EventKind.PLANNER_DECISION, action="scale_failed",
+                    component=component, target=replicas,
+                    error=f"{type(exc).__name__}: {exc}",
+                    attempts=backoff.attempt)
+                log.warning("scale %s -> %d failed after %d attempts: %s "
+                            "(next interval retries)", name, replicas,
+                            backoff.attempt, exc)
+                return
 
     async def current(self, component: str) -> int | None:
-        return await self.api.get_replicas(self._sts(component))
+        from dynamo_tpu.runtime.retry import Backoff, policies
+        backoff = Backoff(policies.KUBE_SCALE)
+        while True:
+            try:
+                return await self.api.get_replicas(self._sts(component))
+            except KubeAPIError:
+                raise
+            except (ConnectionError, OSError, asyncio.TimeoutError) as exc:
+                if await backoff.sleep():
+                    continue
+                log.warning("get_replicas %s failed: %s (treating as "
+                            "unknown)", component, exc)
+                return None

@@ -184,13 +184,16 @@ class RoleReconfigurator:
         input signals — 'why did/didn't the planner act' is answerable
         from the timeline. The flip decision's ref rides the directive
         so the worker's role_flip_requested chains back to it."""
+        # NB ``worker=`` is emit()'s origin override — the flip TARGET
+        # rides as a plain attr so the decision stays attributed to the
+        # planner and its ref can't collide with the worker's own seqs.
         self._last_decision_ref = journal.emit(
             EventKind.PLANNER_DECISION,
             action=record.get("action"), signal=record.get("signal"),
             pressure=record.get("pressure"),
             queue_depth=record.get("queue_depth"),
             roles=record.get("roles"),
-            worker=record.get("worker"),
+            target_worker=record.get("worker"),
             target_role=record.get("target_role"))
         return record
 

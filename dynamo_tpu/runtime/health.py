@@ -216,7 +216,8 @@ async def _debug_profile(request: web.Request) -> web.Response:
 
 class SystemStatusServer:
     def __init__(self, runtime, host: str = "0.0.0.0", port: int = 0,
-                 role_manager=None, kv_provider=None, perf_provider=None):
+                 role_manager=None, kv_provider=None, perf_provider=None,
+                 scale_agent=None):
         self._runtime = runtime
         self.host, self.port = host, port
         self._endpoint_health: dict[str, bool] = {}
@@ -224,6 +225,10 @@ class SystemStatusServer:
         # llm/reconfig.RoleManager: enables the SetRole control verb on
         # this worker's status path (GET/POST /control/role).
         self.role_manager = role_manager
+        # llm/standby.ScaleAgent: enables the scale control verb
+        # (GET/POST /control/scale — standby state, operator
+        # promote/retire without going through the planner).
+        self.scale_agent = scale_agent
         # /debug/kv provider for THIS worker (engine.kv_status).
         self.kv_provider = kv_provider
         # /debug/perf provider (engine.perf_status).
@@ -239,6 +244,8 @@ class SystemStatusServer:
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/control/role", self._role_status)
         app.router.add_post("/control/role", self._role_set)
+        app.router.add_get("/control/scale", self._scale_status)
+        app.router.add_post("/control/scale", self._scale_apply)
         add_debug_routes(app, kv_provider=self.kv_provider,
                          perf_provider=self.perf_provider)
         self._runner = web.AppRunner(app, access_log=None)
@@ -266,6 +273,46 @@ class SystemStatusServer:
     async def _metrics(self, _request: web.Request) -> web.Response:
         return web.Response(body=self._runtime.metrics.expose(),
                             content_type="text/plain")
+
+    # -- Scale control verb (llm/standby.py; docs/RESILIENCE.md) --------------
+    async def _scale_status(self, _request: web.Request) -> web.Response:
+        if self.scale_agent is None:
+            return web.json_response(
+                {"error": "no scale agent on this worker"}, status=404)
+        return web.json_response(self.scale_agent.standby_status())
+
+    async def _scale_apply(self, request: web.Request) -> web.Response:
+        """POST /control/scale {"action": "promote"|"retire", "epoch": N,
+        "role"?} — the operator-facing scale verb (same shape as the
+        coordinator directive, fenced identically; a replayed curl
+        cannot re-apply). Fencing rejections answer 409 typed."""
+        from dynamo_tpu.runtime.errors import RoleTransitionError
+        if self.scale_agent is None:
+            return web.json_response(
+                {"error": "no scale agent on this worker"}, status=404)
+        try:
+            body = await request.json()
+        except (json.JSONDecodeError, ValueError):
+            return web.json_response({"error": "invalid JSON body"},
+                                     status=400)
+        action = body.get("action")
+        epoch = body.get("epoch")
+        if action not in ("promote", "retire") or not isinstance(epoch, int):
+            return web.json_response(
+                {"error": "body must carry action:promote|retire and "
+                 "epoch:int (above the applied epoch in "
+                 "GET /control/scale)"}, status=400)
+        directive = {**body, "issued_by": str(body.get("issued_by",
+                                                       "http"))}
+        try:
+            if action == "promote":
+                await self.scale_agent._promote(directive)
+            else:
+                await self.scale_agent._retire(directive)
+        except RoleTransitionError as exc:
+            return web.json_response(
+                {"error": str(exc), "type": "role_transition"}, status=409)
+        return web.json_response(self.scale_agent.standby_status())
 
     # -- SetRole control verb (llm/reconfig.py; docs/RESILIENCE.md) -----------
     async def _role_status(self, _request: web.Request) -> web.Response:

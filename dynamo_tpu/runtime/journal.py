@@ -73,6 +73,13 @@ class EventKind:
     PLANNER_DECISION = "planner_decision"
     CANARY_OK = "canary_ok"
     CANARY_FAIL = "canary_fail"
+    # Autoscaling (planner/capacity.py + llm/standby.py): a pre-warmed
+    # standby finished its warmup and parked (ready), a scale-out
+    # directive promoted it into the serving fleet, and the scale-in
+    # retire verb drained a serving worker out of it.
+    STANDBY_READY = "standby_ready"
+    STANDBY_PROMOTE = "standby_promote"
+    SCALE_RETIRE = "scale_retire"
     # KV federation (engine/kvbm.py + llm/kv_plane.py): tier placement
     # decisions — watermark demotions down the ladder, promote-on-hit
     # back up it, and cross-worker block pulls over the KV plane.

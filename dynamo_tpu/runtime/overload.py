@@ -447,7 +447,8 @@ class CircuitBreaker:
     it for another cooldown."""
 
     __slots__ = ("cfg", "_clock", "state", "streak", "opened_t",
-                 "ewma_latency_s", "samples", "probe_inflight", "opens")
+                 "ewma_latency_s", "samples", "probe_inflight", "opens",
+                 "probation")
 
     def __init__(self, cfg: OverloadConfig,
                  clock: Callable[[], float] = time.monotonic):
@@ -460,8 +461,14 @@ class CircuitBreaker:
         self.samples = 0
         self.probe_inflight = False
         self.opens = 0           # total open transitions (observability)
+        # Canary-gated join (llm/canary.py): a held breaker admits NO
+        # traffic — not even the post-cooldown half-open probe — until
+        # a success (the canary's, via direct routing) releases it.
+        self.probation = False
 
     def allows(self) -> bool:
+        if self.probation:
+            return False
         if not self.cfg.breaker_enabled or self.state == CLOSED:
             return True
         if self.state == OPEN:
@@ -476,6 +483,7 @@ class CircuitBreaker:
             self.probe_inflight = True
 
     def record_success(self, latency_s: float | None = None) -> None:
+        self.probation = False
         if self.state in (HALF_OPEN, OPEN):
             # Probe (or a straggler from before the open) succeeded:
             # close and forget the episode.
@@ -595,6 +603,24 @@ class BreakerBoard:
             if self._m_opens is not None:
                 self._m_opens.inc(worker=f"{worker_id:x}")
             self._publish(worker_id)
+
+    def hold(self, worker_id: int, cause: str | None = None) -> None:
+        """Canary-gated join: hold this worker's breaker — NO user
+        traffic, not even half-open probes — until something records a
+        success (the canary's direct-routed probe, which bypasses
+        breaker filtering). ``cause`` is the journal ref that put it on
+        probation (the worker_join event)."""
+        b = self.breaker(worker_id)
+        if b.probation:
+            return
+        before = b.state
+        b.probation = True
+        b.state = OPEN
+        b.opened_t = self._clock()
+        journal.emit(EventKind.BREAKER_TRANSITION, cause=cause,
+                     worker_id=f"{worker_id:x}", reason="probation",
+                     **{"from": before, "to": OPEN})
+        self._publish(worker_id)
 
     def remove(self, worker_id: int) -> None:
         self._breakers.pop(worker_id, None)

@@ -84,6 +84,20 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
     -k 'smoke or watermark or pinned or breaker' \
     -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
 
+echo "== autoscale smoke (burn -> scale-out -> canary-gated join -> scale-in) =="
+# Mocker fleet + scripted SLO burn: the capacity scaler promotes a
+# pre-warmed standby, the canary gate holds it on probation until a
+# probe chain passes, sustained headroom scales it back in with a
+# zero-drop drain, and the whole causal chain (slo_alert_fire ->
+# planner_decision -> standby_promote -> worker_join -> canary_ok) is
+# walked via explicit cause refs. The chaos matrix (standby crash
+# mid-join, fencing races, coordinator restart) is tier-1; the
+# 5x-overload convergence run is -m slow.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_autoscale.py -q -m 'not slow' \
+    -k 'smoke or scaler or model or gate or parks or doctor' \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+
 echo "== chunked-prefill smoke (stall-free scheduling) =="
 # Tiny CPU model: one long prompt prefilling in chunks with concurrent
 # short decoders — asserts completion, decode windows interleaved between

@@ -216,6 +216,92 @@ def test_planner_cli_flags():
     assert args.connector == "kube" and args.graph_name == "g"
     assert args.prefill_component == "prefill"
     assert parse_args([]).connector == "log"
+    auto = parse_args(["--autoscale", "--autoscale-max", "5"])
+    assert auto.autoscale and auto.autoscale_max == 5
+
+
+# -- error paths: unreachable API server (satellite, runtime/retry.py) --------
+
+def _fast_policy(monkeypatch):
+    from dynamo_tpu.runtime.retry import RetryPolicy, policies
+    monkeypatch.setattr(
+        policies, "KUBE_SCALE",
+        RetryPolicy(initial_delay_s=0.001, max_delay_s=0.002,
+                    multiplier=1.0, jitter=0.0, max_attempts=2))
+
+
+def _fresh_journal():
+    from dynamo_tpu.runtime import journal
+    from dynamo_tpu.runtime.journal import Journal
+    journal._JOURNAL = Journal(capacity=256, worker="planner")
+    return journal._JOURNAL
+
+
+@async_test
+async def test_scale_unreachable_api_retries_then_journals(monkeypatch):
+    """scale() against an unreachable API server walks the unified
+    KUBE_SCALE retry policy, then journals a typed planner_decision
+    failure instead of raising into the planner's step()."""
+    _fast_policy(monkeypatch)
+    j = _fresh_journal()
+    conn = KubernetesConnector(
+        "graph", api=KubernetesAPI(base_url="http://127.0.0.1:9",
+                                   token="t", namespace=NS))
+    await conn.scale("decode", 4)  # must NOT raise
+    assert conn.scale_failures == 1
+    events = [e for e in j.events() if e["kind"] == "planner_decision"]
+    assert len(events) == 1
+    attrs = events[0]["attrs"]
+    assert attrs["action"] == "scale_failed"
+    assert (attrs["component"], attrs["target"]) == ("decode", 4)
+    assert attrs["attempts"] == 2 and "error" in attrs
+
+
+@async_test
+async def test_current_unreachable_api_returns_unknown(monkeypatch):
+    """current() degrades to None (unknown) so the planner's decide
+    step falls back to the observed fleet size."""
+    _fast_policy(monkeypatch)
+    conn = KubernetesConnector(
+        "graph", api=KubernetesAPI(base_url="http://127.0.0.1:9",
+                                   token="t", namespace=NS))
+    assert await conn.current("decode") is None
+
+
+@async_test
+async def test_planner_step_survives_unreachable_api(monkeypatch, kube):
+    """End to end through step(): the API server dies between decisions;
+    the step completes (decision recorded, nothing raised) and the next
+    interval's decision against a recovered server lands."""
+    _fast_policy(monkeypatch)
+    _fresh_journal()
+    kube.statefulsets["graph-decode"] = 1
+    api = _api(kube)
+    conn = KubernetesConnector("graph", api=api)
+    planner = Planner(
+        PlannerConfig(decode_component="decode",
+                      max_num_seqs_per_worker=4, target_utilization=1.0,
+                      predictor="constant", min_replicas=1,
+                      max_replicas=8),
+        conn)
+    # 12 wanted slots on 2 live workers -> want 3 (above the observed
+    # fleet, so the step must actually call scale()).
+    for w in range(2):
+        planner.decode.observe(w, ForwardPassMetrics(
+            worker_id=w,
+            worker_stats=WorkerStats(request_active_slots=6,
+                                     request_total_slots=4)))
+    # Kill the API server: the step must still complete.
+    good_url = api.base_url
+    api.base_url = "http://127.0.0.1:9"
+    out = await planner.step()
+    assert out["decode"]["target"] == 3  # decided, not applied
+    assert kube.statefulsets["graph-decode"] == 1
+    assert conn.scale_failures == 1
+    # Server recovers: the next interval applies the decision.
+    api.base_url = good_url
+    await planner.step()
+    assert kube.statefulsets["graph-decode"] == 3
 
 
 def test_deploy_graph_wires_planner_to_kube():

@@ -666,6 +666,22 @@ def test_docs_drift_canary_series_are_documented():
     assert not missing, f"undocumented canary series: {sorted(missing)}"
 
 
+def test_docs_drift_autoscale_series_are_documented():
+    """Autoscaling acceptance: the planner-side autoscale_ family and
+    the worker-side standby_ family are whole-family documented in
+    docs/OBSERVABILITY.md "Autoscaling"."""
+    doc = (REPO / "docs" / "OBSERVABILITY.md").read_text()
+    documented = set(_DOC_NAME_RE.findall(doc))
+    for family, minimum in (("autoscale_", 5), ("standby_", 3)):
+        registered = {n for n in _registered_metric_names()
+                      if n.startswith(family)}
+        assert len(registered) >= minimum, \
+            f"expected the {family} family, scan found {sorted(registered)}"
+        missing = registered - documented
+        assert not missing, \
+            f"undocumented {family} series: {sorted(missing)}"
+
+
 def test_docs_drift_kv_series_are_documented():
     """PR 8 acceptance: every dynamo_tpu_kv_* series registered in the
     source is documented in docs/OBSERVABILITY.md "KV & capacity" — the
