@@ -66,6 +66,14 @@ def parse_args(argv=None) -> argparse.Namespace:
                              "directive (llm/standby.py)")
     parser.add_argument("--prefill-component", default="prefill",
                         help="component the prefill role registers under")
+    parser.add_argument("--lora", action="append", default=[],
+                        metavar="NAME",
+                        help="register NAME as a served LoRA adapter "
+                             "name riding this mocker's base model "
+                             "(repeatable; the simulator ignores the "
+                             "adapter — this exercises the frontend "
+                             "resolution / routing / accounting path "
+                             "without TPUs)")
     return parser.parse_args(argv)
 
 
@@ -98,6 +106,14 @@ def make_profile_builder(runtime, engine, args, tokenizer):
                 max_num_seqs=args.max_num_seqs))
         prof.add_closer(
             "model-card", lambda: deregister_llm(runtime, args.model_name))
+        from dynamo_tpu.llm.model_card import register_adapter
+        for lname in getattr(args, "lora", None) or []:
+            await register_adapter(
+                runtime, endpoint, lname, args.model_name, tokenizer,
+                kv_cache_block_size=args.block_size,
+                migration_limit=args.migration_limit)
+            prof.add_closer(f"adapter-card-{lname}",
+                            lambda n=lname: deregister_llm(runtime, n))
         return prof
 
     return build

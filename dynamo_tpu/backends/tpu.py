@@ -127,6 +127,24 @@ def parse_args(argv=None) -> argparse.Namespace:
                              "until high (hysteresis); needs "
                              "--host-cache-pages (DTPU_KV_WATERMARKS "
                              "overrides)")
+    parser.add_argument("--lora", action="append", default=[],
+                        metavar="NAME=PATH",
+                        help="serve a LoRA adapter: NAME becomes a "
+                             "registered model name riding this "
+                             "worker's base model; PATH is a HF PEFT "
+                             "checkpoint dir (adapter_config.json + "
+                             "adapter_model.safetensors). Repeatable — "
+                             "heterogeneous adapters batch into one "
+                             "decode window (engine/lora.py)")
+    parser.add_argument("--max-adapters", type=int, default=None,
+                        help="resident device adapter slots (default: "
+                             "max(4, number of --lora flags)); registered "
+                             "adapters beyond this hot-load on demand "
+                             "with LRU eviction")
+    parser.add_argument("--max-lora-rank", type=int, default=8,
+                        help="adapter ranks pad to this fixed max so "
+                             "stacks keep static shapes (checkpoints "
+                             "with a larger rank are rejected)")
     parser.add_argument("--spec-decode", default=None, choices=["ngram"],
                         help="speculative decoding: 'ngram' = prompt-"
                              "lookup self-drafting verified in-window "
@@ -240,12 +258,33 @@ def build_engine_config(args) -> EngineConfig:
             getattr(args, "kv_watermarks", None))[0],
         kv_demote_high_watermark=_watermark_arg(
             getattr(args, "kv_watermarks", None))[1],
+        max_adapters=_max_adapters_arg(args),
+        lora_max_rank=getattr(args, "max_lora_rank", 8),
         spec_decode=getattr(args, "spec_decode", None),
         spec_k=getattr(args, "spec_k", 3),
         ttft_budget_ms=getattr(args, "ttft_budget_ms", None),
         admission_reject_factor=(
             getattr(args, "admission_reject_factor", 0.0)
             if getattr(args, "ttft_budget_ms", None) else 0.0))
+
+
+def _lora_args(args) -> list[tuple[str, str]]:
+    """Parse repeated --lora NAME=PATH flags."""
+    out = []
+    for item in getattr(args, "lora", None) or []:
+        name, sep, path = str(item).partition("=")
+        if not sep or not name or not path:
+            raise SystemExit(f"--lora expects NAME=PATH, got {item!r}")
+        out.append((name, path))
+    return out
+
+
+def _max_adapters_arg(args) -> int:
+    explicit = getattr(args, "max_adapters", None)
+    if explicit is not None:
+        return explicit
+    loras = _lora_args(args)
+    return max(4, len(loras)) if loras else 0
 
 
 def _watermark_arg(value) -> tuple[float, float]:
@@ -294,8 +333,9 @@ def make_profile_builder(runtime, args, engine, engine_cfg, tokenizer,
     from dynamo_tpu.llm.disagg import (
         PREFILL_ENDPOINT, DisaggDecodeHandler, DisaggRouterConfig,
         make_prefill_handler)
-    from dynamo_tpu.llm.model_card import deregister_llm
+    from dynamo_tpu.llm.model_card import deregister_llm, register_adapter
     from dynamo_tpu.llm.reconfig import ServingProfile
+    lora_names = [name for name, _ in _lora_args(args)]
 
     async def build(role: str) -> ServingProfile:
         prof = ServingProfile(role)
@@ -382,6 +422,25 @@ def make_profile_builder(runtime, args, engine, engine_cfg, tokenizer,
                            engine_cfg.expected_roofline_frac}))
         prof.add_closer("model-card",
                         lambda: deregister_llm(runtime, model_name))
+        # LoRA adapters register as served names riding THIS endpoint
+        # (adapter-aware model cards: the frontend resolves the OpenAI
+        # model field to (base, adapter) from the card's extras). They
+        # deregister with the base card on drains/role flips — a
+        # prefill-only worker must not advertise adapter names either.
+        for lname in lora_names:
+            await register_adapter(
+                runtime, endpoint, lname, model_name, tokenizer,
+                context_length=engine_cfg.max_model_len,
+                kv_cache_block_size=engine_cfg.page_size,
+                migration_limit=args.migration_limit,
+                tool_call_parser=args.tool_call_parser,
+                reasoning_parser=args.reasoning_parser,
+                runtime_config=ModelRuntimeConfig(
+                    total_kv_blocks=engine.runner.num_pages,
+                    max_num_seqs=engine_cfg.max_num_seqs,
+                    extra={"hidden_size": engine_cfg.model.hidden_size}))
+            prof.add_closer(f"adapter-card-{lname}",
+                            lambda n=lname: deregister_llm(runtime, n))
         return prof
 
     return build
@@ -473,11 +532,24 @@ async def run(args: argparse.Namespace) -> None:
                     raise SystemExit(
                         f"node {args.node_rank} config {shape} does not "
                         f"match leader {leader}")
+        loras = _lora_args(args)
+        if multihost_engine and loras:
+            raise SystemExit(
+                "--lora is not supported with a multi-host single engine "
+                "yet: adapter hot-loads are not in the follower replay "
+                "stream (engine/multihost.py)")
         # Engine construction blocks for seconds (weight load + sharded
         # device_put + first compiles); run it off the event loop so the
         # coordinator lease keepalives keep flowing.
         engine = await asyncio.get_running_loop().run_in_executor(
             None, build_engine)
+        if loras:
+            # Host-side parse/pad/stack only (device uploads happen
+            # lazily on the engine thread at first use): off the loop so
+            # large checkpoints don't stall lease keepalives.
+            await asyncio.get_running_loop().run_in_executor(
+                None, lambda: [engine.register_adapter(n, path=p)
+                               for n, p in loras])
         if multihost_engine:
             # Leader: publish every device call to the follower replay
             # stream, and hold serving until every follower is listening.

@@ -150,6 +150,7 @@ async def check_coordinator(rep: Report, url: str) -> None:
         names = sorted({m["v"].get("model_name", "?") for m in models})
         rep.add(OK if models else WARN, "registered models",
                 ", ".join(names) if names else "none registered")
+        check_adapter_cards(rep, [m["v"] for m in models])
 
         instances = await client.kv_get_prefix("instances/")
         rep.add(OK if instances else WARN, "live instances",
@@ -396,6 +397,90 @@ async def check_fleet_kv(rep: Report, url: str) -> None:
                     rep.add(FAIL, "/debug/kv", f"HTTP {r.status}")
     except (aiohttp.ClientError, OSError, asyncio.TimeoutError) as exc:
         rep.add(FAIL, "fleet kv pane", f"{url}: {exc}")
+
+
+#: Adapter-miss storm threshold (check_adapters): WARN when more than
+#: this fraction of adapter requests forced a hot-load — the resident
+#: slot count is too small for the working set (raise --max-adapters or
+#: pin the hot tenants).
+ADAPTER_MISS_WARN_RATE = 0.3
+ADAPTER_MISS_MIN_REQUESTS = 20
+
+
+def check_adapter_cards(rep: Report, entries: list[dict]) -> None:
+    """Model-card sanity for LoRA adapters: every adapter card's
+    ``lora_base`` must name a model some worker still serves — a
+    dangling binding means requests for the adapter name will route to
+    a worker that 404s them (the base worker role-flipped or retired
+    without its adapter cards)."""
+    names = {e.get("model_name") for e in entries}
+    adapters = []
+    for e in entries:
+        extra = (((e.get("card") or {}).get("runtime_config") or {})
+                 .get("extra") or {})
+        base = extra.get("lora_base")
+        if not base:
+            continue
+        adapters.append((e.get("model_name"), base))
+        if base not in names:
+            rep.add(WARN, f"adapter card {e.get('model_name')}",
+                    f"points at base model {base!r} which no registered "
+                    f"worker serves (stale card after a role flip / "
+                    f"scale-in?)")
+    if adapters:
+        bases = sorted({b for _, b in adapters})
+        rep.add(OK, "adapter cards",
+                f"{len(adapters)} adapter name(s) over base "
+                f"{', '.join(bases)}")
+
+
+def check_adapter_workers(rep: Report, workers: dict) -> None:
+    """Per-worker AdapterStore health from the /debug/fleet pane:
+    resident/registered counts, eviction totals, and the adapter-miss
+    storm WARN (hot-load rate above threshold — every miss pays a
+    device upload before the request can prefill)."""
+    seen = False
+    for worker, res in sorted(workers.items()):
+        ad = (res.get("kv") or {}).get("adapters") if res.get("ok") else None
+        if not ad:
+            continue
+        seen = True
+        requests = sum((ad.get("requests_total") or {}).values())
+        miss = ad.get("miss_total", 0)
+        detail = (f"{len(ad.get('resident') or {})}/"
+                  f"{ad.get('max_adapters')} resident, "
+                  f"{len(ad.get('registered') or [])} registered, "
+                  f"loads {ad.get('loads_total', 0)}, evictions "
+                  f"{ad.get('evictions_total', 0)}, misses {miss}/"
+                  f"{requests} req")
+        if (requests >= ADAPTER_MISS_MIN_REQUESTS
+                and miss > ADAPTER_MISS_WARN_RATE * requests):
+            rep.add(WARN, f"adapters {worker}",
+                    detail + " — adapter-miss storm: the resident slot "
+                    "count is below the working set (raise "
+                    "--max-adapters or pin hot tenants)")
+        else:
+            rep.add(OK, f"adapters {worker}", detail)
+    if not seen:
+        rep.add(SKIP, "adapters", "no worker reports an adapter store")
+
+
+async def check_adapters(rep: Report, url: str) -> None:
+    """LoRA adapter serving (docs/OBSERVABILITY.md "Adapters"): reads
+    the frontend's /debug/fleet pane for per-worker adapter stores."""
+    import aiohttp
+    url = url.rstrip("/")
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"{url}/debug/fleet",
+                                   timeout=aiohttp.ClientTimeout(15)) as r:
+                if r.status != 200:
+                    rep.add(SKIP, "adapters", f"/debug/fleet HTTP {r.status}")
+                    return
+                fleet = await r.json()
+        check_adapter_workers(rep, fleet.get("workers") or {})
+    except (aiohttp.ClientError, OSError, asyncio.TimeoutError) as exc:
+        rep.add(SKIP, "adapters", f"{url}: {exc}")
 
 
 async def check_kv_federation(rep: Report, url: str) -> None:
@@ -750,6 +835,7 @@ async def run(args) -> int:
         await check_observability(rep, args.frontend_url)
         await check_fleet_kv(rep, args.frontend_url)
         await check_kv_federation(rep, args.frontend_url)
+        await check_adapters(rep, args.frontend_url)
         await check_perf(rep, args.frontend_url)
         await check_timeline(rep, args.frontend_url)
     n_fail = sum(1 for s, _, _ in rep.rows if s == FAIL)

@@ -281,6 +281,21 @@ class EngineConfig:
     # behind. 0 disables the hook. Needs ttft_budget_ms to have a
     # pressure signal at all.
     brownout_spec_disable_level: int = 2
+    # Multi-tenant batched LoRA (engine/lora.py, ROADMAP item 4): > 0
+    # enables the adapter subsystem with this many RESIDENT device
+    # adapter slots (slot 0 is always the base model — no delta). All
+    # serving programs then add the gathered low-rank correction
+    # x @ A[ids] @ B[ids] at every target projection, so HETEROGENEOUS
+    # adapters batch into one decode window (the S-LoRA / Punica
+    # technique, static-shaped so the jit program count stays fixed).
+    # Registered adapters beyond the resident count hot-load on demand
+    # with LRU eviction (host copies are always kept). 0 = disabled:
+    # programs are byte-identical to the pre-LoRA engine.
+    max_adapters: int = 0
+    # Per-adapter rank is padded to this fixed max so A/B stacks keep
+    # static shapes across heterogeneous adapters (checkpoints with a
+    # larger rank are rejected at load).
+    lora_max_rank: int = 8
     # Perf plane (engine/perf.py): the roofline fraction this deployment
     # is EXPECTED to achieve in steady-state decode — recorded into the
     # model card's runtime_config.extra and served on /debug/perf, so
@@ -323,6 +338,27 @@ class EngineConfig:
         else:
             per_head = 2 * m.head_dim
         return 2 * m.num_layers * m.num_kv_heads * per_head
+
+    def lora_target_shapes(self) -> dict[str, tuple[int, int]]:
+        """(d_in, d_out) per LoRA target projection for this model —
+        the attention projections always, the dense MLP projections when
+        the model is dense (MoE expert weights are not adapter targets:
+        PEFT Mixtral checkpoints conventionally target attention only).
+        The single source for stack shapes in the runner, the loader's
+        padding, and the store's host-side validation."""
+        m = self.model
+        d = m.head_dim
+        shapes = {
+            "wq": (m.hidden_size, m.num_heads * d),
+            "wk": (m.hidden_size, m.num_kv_heads * d),
+            "wv": (m.hidden_size, m.num_kv_heads * d),
+            "wo": (m.num_heads * d, m.hidden_size),
+        }
+        if not m.num_experts:
+            shapes["w_gate"] = (m.hidden_size, m.intermediate_size)
+            shapes["w_up"] = (m.hidden_size, m.intermediate_size)
+            shapes["w_down"] = (m.intermediate_size, m.hidden_size)
+        return shapes
 
     def bucket_for(self, length: int) -> int:
         for b in self.prefill_buckets:

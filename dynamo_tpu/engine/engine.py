@@ -35,7 +35,7 @@ from dynamo_tpu.engine.kv_cache import PageAllocator
 from dynamo_tpu.engine.runner import (
     ModelRunner, PrefillSeq, PK_OVERRIDE, PK_TOKEN, PK_POS, PK_SEQLEN,
     PK_TOPK, PK_TEMP, PK_TOPP, PK_CAP, PK_LOGPROB, PK_FREQPEN, PK_PRESPEN,
-    PK_SEED, PK_SEEDED, PK_PREFIX, TOP_LOGPROBS)
+    PK_SEED, PK_SEEDED, PK_ADAPTER, PK_PREFIX, TOP_LOGPROBS)
 from dynamo_tpu.engine.sampler import MAX_TOPK
 from dynamo_tpu.llm.kv_router.protocols import (ForwardPassMetrics, KvStats,
                                                 SpecDecodeStats, WorkerStats)
@@ -98,6 +98,12 @@ class _Request:
     prefilling: bool = False
     prefill_pos: int = 0
     prefill_t0: float = 0.0
+    # Batched LoRA (engine/lora.py): the resident device slot this
+    # request's adapter occupies (0 = base model) and the store
+    # reference held while the request is live (released at slot
+    # finish; a requeued request re-acquires at re-admission).
+    adapter_slot: int = 0
+    adapter_ref: str | None = None
 
     def push(self, item) -> None:
         self.loop.call_soon_threadsafe(self.out_q.put_nowait, item)
@@ -174,9 +180,26 @@ class TPUEngine(AsyncEngine):
         if metrics_registry is not None:
             from dynamo_tpu.engine.kv_metrics import KvMetricsUpdater
             self.kv_metrics = KvMetricsUpdater(metrics_registry)
+        # Multi-tenant batched LoRA (engine/lora.py; config.max_adapters
+        # > 0): the store owns adapter registration, device-slot LRU
+        # placement and hot-loads — the engine resolves a request's
+        # adapter name at admission (engine thread: the upload is device
+        # work) and threads the slot id through every dispatch.
+        self.adapters = None
+        if config.max_adapters > 0:
+            from dynamo_tpu.engine.lora import AdapterStore
+            self.adapters = AdapterStore(self.runner, config.max_adapters,
+                                         config.lora_max_rank)
+        self.adapter_metrics = None
+        if metrics_registry is not None and self.adapters is not None:
+            from dynamo_tpu.engine.kv_metrics import AdapterMetricsUpdater
+            self.adapter_metrics = AdapterMetricsUpdater(metrics_registry)
         b = config.max_num_seqs
         # Slot state (host view; tokens chain on-device between windows).
         self.slot_req: list[_Request | None] = [None] * b
+        # Per-slot resident adapter ids for the decode-window control
+        # array (0 = base model).
+        self.adapter_ids = np.zeros(b, np.int32)
         self.disp_positions = np.zeros(b, np.int64)
         self.disp_seq_lens = np.zeros(b, np.int64)
         self.temperature = np.zeros(b, np.float32)
@@ -343,6 +366,19 @@ class TPUEngine(AsyncEngine):
             raise ValueError(
                 f"prompt length {len(req.token_ids)} exceeds max model len "
                 f"{self.config.max_model_len}")
+        adapter = getattr(req, "adapter", None)
+        if adapter:
+            from dynamo_tpu.runtime.errors import AdapterNotFoundError
+            if self.adapters is None:
+                raise AdapterNotFoundError(
+                    f"adapter {adapter!r} requested but this engine "
+                    f"serves no adapters (--max-adapters 0)")
+            if not self.adapters.registered(adapter):
+                # Fail fast at generate() — the authoritative (slot)
+                # resolution happens at admission on the engine thread.
+                raise AdapterNotFoundError(
+                    f"adapter {adapter!r} is not registered on this "
+                    f"worker (serving: {self.adapters.names() or 'none'})")
         s = req.sampling_options
         if s.logprobs is not None and s.logprobs > TOP_LOGPROBS:
             log.warning("top_logprobs=%d exceeds cap %d; clamping",
@@ -569,6 +605,7 @@ class TPUEngine(AsyncEngine):
         (their D2H copies all start now; the plane then streams group i
         while group i+1's copy completes) and returns a list of
         handles."""
+        self._reject_adapter_extract(req)
         self._validate(req)
         r = _Request(req=req, ctx=Context(), out_q=None, loop=None,  # type: ignore[arg-type]
                      tokens_all=list(req.token_ids))
@@ -676,6 +713,19 @@ class TPUEngine(AsyncEngine):
             on_ticket(ticket)
         return first_token, ticket, prompt_len
 
+    @staticmethod
+    def _reject_adapter_extract(req: PreprocessedRequest) -> None:
+        """Disaggregated prefill serves the BASE model only: the decode
+        side keeps adapter requests local (llm/disagg.py gate), so an
+        adapter reaching a prefill worker is a routing bug — fail typed
+        rather than compute base KV under an adapter-salted hash chain."""
+        if getattr(req, "adapter", None):
+            from dynamo_tpu.runtime.errors import InvalidRequestError
+            raise InvalidRequestError(
+                f"disaggregated prefill does not serve LoRA adapter "
+                f"requests (adapter={req.adapter!r}); the decode worker "
+                f"prefills these locally")
+
     # Backstop for streamed-extract group resolvers: the plane thread
     # waits on the chunk's extract event at most this long before
     # failing the pull (an aborted prefill sets the events, so only a
@@ -698,6 +748,7 @@ class TPUEngine(AsyncEngine):
         Failure mid-loop marks every pending group failed (resolvers
         raise, the sink's pull errors, the decode worker falls back to
         local prefill) and re-raises to the handler."""
+        self._reject_adapter_extract(req)
         self._validate(req)
         r = _Request(req=req, ctx=Context(), out_q=None, loop=None,  # type: ignore[arg-type]
                      tokens_all=list(req.token_ids))
@@ -794,6 +845,52 @@ class TPUEngine(AsyncEngine):
             self.allocator.release(r.pages)
             r.pages = []
 
+    def register_adapter(self, name: str, path: str | None = None,
+                         weights: dict | None = None,
+                         pin: bool = False) -> None:
+        """Register a LoRA adapter (host-side: parse/pad/stack only —
+        the device upload happens lazily at first use on the engine
+        thread, which IS the hot-load path). Safe from any thread."""
+        if self.adapters is None:
+            raise RuntimeError(
+                "engine built without adapters (config.max_adapters=0)")
+        self.adapters.register(name, path=path, weights=weights)
+        if pin:
+            self.adapters.pin(name)
+
+    # -- engine-thread adapter resolution -------------------------------------
+    def _acquire_adapter(self, r: _Request) -> bool:
+        """Resolve the request's adapter name to a resident device slot
+        (hot-loading on miss — ENGINE THREAD). Returns False after
+        pushing the typed error when resolution fails (unknown name ->
+        404 at the frontend; all slots busy -> 503, router retries)."""
+        name = getattr(r.req, "adapter", None)
+        if not name:
+            return True
+        if r.adapter_ref is not None:
+            return True  # already held (shouldn't happen, but idempotent)
+        try:
+            if self.adapters is None:
+                from dynamo_tpu.runtime.errors import AdapterNotFoundError
+                raise AdapterNotFoundError(
+                    f"adapter {name!r} requested but this engine serves "
+                    f"no adapters")
+            r.adapter_slot = self.adapters.acquire(name)
+        except Exception as exc:  # noqa: BLE001 — typed errors reach the stream
+            r.push(exc)
+            return False
+        r.adapter_ref = name
+        # Accounting attribution: scripts/slo_report.py --by adapter.
+        r.ctx.values["adapter"] = name
+        return True
+
+    def _release_adapter(self, r: _Request | None) -> None:
+        if r is not None and r.adapter_ref is not None \
+                and self.adapters is not None:
+            self.adapters.release(r.adapter_ref)
+            r.adapter_ref = None
+            r.adapter_slot = 0
+
     async def embed(self, token_lists: list[list[int]],
                     pooling: str = "last") -> list[list[float]]:
         """Batch embeddings, computed on the engine thread between windows
@@ -860,6 +957,8 @@ class TPUEngine(AsyncEngine):
             "remote": (self.remote_source.stats()
                        if self.remote_source is not None else None),
             "kvbm": self.kvbm.status(),
+            "adapters": (self.adapters.status()
+                         if self.adapters is not None else None),
             "digest": self.inventory_digest().to_wire(),
         }
         return status
@@ -1376,6 +1475,11 @@ class TPUEngine(AsyncEngine):
                 r.push(LLMEngineOutput(
                     token_ids=[], finish_reason=FinishReason.CANCELLED).to_wire())
                 continue
+            # Adapter resolution first (engine thread: the hot-load is
+            # device work): a missing adapter 404s here, a slot-starved
+            # store 503s — either way before any pages are touched.
+            if not self._acquire_adapter(r):
+                continue
             if r.injected is not None:
                 self._note_queue_wait(r)
                 slot = free_slots.pop(0)
@@ -1386,6 +1490,7 @@ class TPUEngine(AsyncEngine):
                     log.exception("KV injection failed")
                     r.push(RuntimeError(f"kv injection failed: {exc}"))
                     free_slots.insert(0, slot)
+                    self._release_adapter(r)
                     continue
                 # No pages for the transferred KV: fall back to a normal
                 # local prefill of the full prompt (correctness preserved).
@@ -1415,9 +1520,12 @@ class TPUEngine(AsyncEngine):
             except Exception as exc:  # noqa: BLE001
                 log.exception("prefill planning failed")
                 r.push(RuntimeError(f"prefill failed: {exc}"))
+                self._release_adapter(r)
                 continue
             if plan is None:
-                # No KV room: put back and stop admitting.
+                # No KV room: put back and stop admitting (drop the
+                # adapter ref while queued so it can't pin the slot).
+                self._release_adapter(r)
                 self._queue_put(r)
                 break
             slot = free_slots.pop(0)
@@ -1467,6 +1575,7 @@ class TPUEngine(AsyncEngine):
                         r.cold_tokens = 0
                         self.allocator.release(r.pages)
                         r.pages = []
+                        self._release_adapter(r)
                         r.push(RuntimeError(f"prefill failed: {exc}"))
                     continue
                 rows = []
@@ -1497,7 +1606,9 @@ class TPUEngine(AsyncEngine):
         page = self.config.page_size
         first_token, kv = r.injected
         prompt = r.tokens_all
-        r.blocks = TokenBlockSequence(page, prompt)
+        from dynamo_tpu.llm.tokens import chain_salt
+        r.blocks = TokenBlockSequence(
+            page, prompt, salt=chain_salt(getattr(r.req, "adapter", None)))
         total_pages = -(-len(prompt) // page)
         if kv.shape[3] != total_pages:
             raise ValueError(
@@ -1526,7 +1637,14 @@ class TPUEngine(AsyncEngine):
         cfg = self.config
         page = cfg.page_size
         prompt = r.tokens_all
-        r.blocks = TokenBlockSequence(page, prompt)
+        # Adapter-conditioned KV must never alias base (or other-adapter)
+        # KV: the same tokens forwarded through adapter A produce
+        # different K/V, so the hash chain roots at the adapter's salt —
+        # prefix reuse, onboarding tiers and KV events all stay correct
+        # per adapter with zero extra bookkeeping (llm/tokens.py).
+        from dynamo_tpu.llm.tokens import chain_salt
+        salt = chain_salt(getattr(r.req, "adapter", None))
+        r.blocks = TokenBlockSequence(page, prompt, salt=salt)
         hashes = r.blocks.block_hashes
         mm = getattr(r.req, "mm_embeds", None)
         if mm:
@@ -1599,7 +1717,8 @@ class TPUEngine(AsyncEngine):
             start_pos=reuse_tokens, chunk_pages=chunk_pages,
             hist_pages=hist, sampling=self._sampling_of(r),
             logprobs=r.req.sampling_options.logprobs is not None,
-            penalties=self._penalties_of(r), seed=self._seed_of(r))
+            penalties=self._penalties_of(r), seed=self._seed_of(r),
+            adapter_id=r.adapter_slot)
 
     def _plan_prefill_multimodal(self, r: _Request, mm: list[dict]):
         """Plan a prompt with encoder-embedding spans (reference
@@ -1646,7 +1765,7 @@ class TPUEngine(AsyncEngine):
             sampling=self._sampling_of(r),
             logprobs=r.req.sampling_options.logprobs is not None,
             penalties=self._penalties_of(r), seed=self._seed_of(r),
-            embeds=emb, embeds_mask=mask)
+            embeds=emb, embeds_mask=mask, adapter_id=r.adapter_slot)
 
     # -- stall-free chunked prefill -------------------------------------------
     def _chunk_seq(self, r: _Request, start: int, n: int,
@@ -1671,14 +1790,15 @@ class TPUEngine(AsyncEngine):
             return PrefillSeq(
                 tokens=tokens, start_pos=start, chunk_pages=chunk_pages,
                 hist_pages=hist if len(hist) else None,
-                sampling=(0.0, 0, 1.0), embeds=emb, embeds_mask=emb_mask)
+                sampling=(0.0, 0, 1.0), embeds=emb, embeds_mask=emb_mask,
+                adapter_id=r.adapter_slot)
         return PrefillSeq(
             tokens=tokens, start_pos=start, chunk_pages=chunk_pages,
             hist_pages=hist if len(hist) else None,
             sampling=self._sampling_of(r),
             logprobs=r.req.sampling_options.logprobs is not None,
             penalties=self._penalties_of(r), seed=self._seed_of(r),
-            embeds=emb, embeds_mask=emb_mask)
+            embeds=emb, embeds_mask=emb_mask, adapter_id=r.adapter_slot)
 
     def _dispatch_prefill_chunks(self) -> bool:
         """One scheduling pass over the prefilling requests: dispatch at
@@ -1898,6 +2018,7 @@ class TPUEngine(AsyncEngine):
         self.top_k[slot] = tk
         self.top_p[slot] = tp
         self.freq_pen[slot], self.pres_pen[slot] = self._penalties_of(r)
+        self.adapter_ids[slot] = r.adapter_slot
         self._set_seed_slot(r, slot)
         self.overrides.pop(slot, None)
 
@@ -1916,6 +2037,7 @@ class TPUEngine(AsyncEngine):
         if finish is not None:
             self._pending_release.append((self._dispatch_serial, r.pages))
             r.pages = []
+            self._release_adapter(r)
             return
         r.slot = slot
         r.epoch += 1
@@ -1930,6 +2052,7 @@ class TPUEngine(AsyncEngine):
         self.top_p[slot] = tp
         fp, pp = self._penalties_of(r)
         self.freq_pen[slot], self.pres_pen[slot] = fp, pp
+        self.adapter_ids[slot] = r.adapter_slot
         self._set_seed_slot(r, slot)
         if fp or pp:
             # tokens_all already includes first_token (appended above).
@@ -2082,6 +2205,7 @@ class TPUEngine(AsyncEngine):
             packed[i, PK_PRESPEN] = self.pres_pen[i:i + 1].view(np.int32)[0]
             packed[i, PK_SEED] = self.seeds[i]
             packed[i, PK_SEEDED] = int(self.seeded[i])
+            packed[i, PK_ADAPTER] = self.adapter_ids[i]
             packed[i, PK_PREFIX:PK_PREFIX + len(r.pages)] = r.pages
             slots[i] = (r, r.epoch, start, cap)
             adv = min(M, max(0, cap - start))
@@ -2336,9 +2460,12 @@ class TPUEngine(AsyncEngine):
         self.slot_req[slot] = None
         self.disp_positions[slot] = 0
         self.disp_seq_lens[slot] = 0
+        if 0 <= slot < len(self.adapter_ids):
+            self.adapter_ids[slot] = 0
         self.overrides.pop(slot, None)
         if r is None:
             return
+        self._release_adapter(r)
         r.slot = -1
         r.epoch += 1
         if not register:
@@ -2424,6 +2551,8 @@ class TPUEngine(AsyncEngine):
             self.kv_metrics.update(self)
         if self.perf_metrics is not None:
             self.perf_metrics.update(self)
+        if self.adapter_metrics is not None:
+            self.adapter_metrics.update(self.adapters)
         loop = self._publish_loop
         if loop is None or loop.is_closed():
             self.allocator.drain_events()

@@ -186,3 +186,53 @@ class KvMetricsUpdater:
                         plane.bytes_out, direction="out")
             self._delta(self.c_plane_blocks_served, ("served",),
                         plane.blocks_served)
+
+
+class AdapterMetricsUpdater:
+    """dynamo_tpu_adapter_* exporter (engine/lora.py AdapterStore ->
+    Prometheus, same discipline as KvMetricsUpdater: the store keeps
+    plain ints, gauges set directly, monotonic ints become counter
+    deltas on a throttle). Documented in docs/OBSERVABILITY.md
+    "Adapters" (whole-family docs-drift guard, tests/test_slo.py)."""
+
+    def __init__(self, registry, min_interval_s: float = 0.5):
+        self.min_interval_s = min_interval_s
+        self._next = 0.0
+        self._last: dict[tuple, float] = {}
+        self.g_resident = registry.gauge(
+            "adapter_resident", "LoRA adapters currently resident in "
+            "device slots (hot; excludes host-registered-only adapters)")
+        self.c_loads = registry.counter(
+            "adapter_loads_total", "Adapter device uploads (cold first "
+            "loads + hot-reloads after eviction)")
+        self.c_evictions = registry.counter(
+            "adapter_evictions_total", "Adapter slot evictions (LRU "
+            "pressure + explicit admin evicts)")
+        self.c_miss = registry.counter(
+            "adapter_miss_total", "Requests that arrived while their "
+            "adapter was NOT resident (each forces a hot-load — a high "
+            "rate is an adapter-miss storm: raise --max-adapters or pin)")
+        self.c_requests = registry.counter(
+            "adapter_requests_total", "Requests resolved per adapter "
+            "name", ["adapter"])
+        for bound in (self.g_resident, self.c_loads, self.c_evictions,
+                      self.c_miss):
+            bound.ensure()
+
+    def _delta(self, bound, key: tuple, current: float, **labels) -> None:
+        prev = self._last.get(key, 0.0)
+        if current > prev:
+            bound.inc(current - prev, **labels)
+        self._last[key] = current
+
+    def update(self, store, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now < self._next:
+            return
+        self._next = now + self.min_interval_s
+        self.g_resident.set(store.resident)
+        self._delta(self.c_loads, ("loads",), store.loads_total)
+        self._delta(self.c_evictions, ("evictions",), store.evictions_total)
+        self._delta(self.c_miss, ("miss",), store.miss_total)
+        for name, n in store.requests_total.items():
+            self._delta(self.c_requests, ("req", name), n, adapter=name)

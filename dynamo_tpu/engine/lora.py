@@ -1,0 +1,240 @@
+"""Multi-tenant LoRA adapter store: batched heterogeneous adapters on
+one base model (ROADMAP item 4; the S-LoRA / Punica technique done
+TPU-idiomatically).
+
+The runner holds ONE pair of stacked device pytrees per target
+projection — ``A [L, S, d_in, r]`` / ``B [L, S, r, d_out]`` with
+``S = max_adapters + 1`` slots (slot 0 is the base model: all-zero, no
+delta) and every adapter's rank padded to a fixed ``lora_max_rank`` —
+so the serving programs add the gathered low-rank correction
+``x @ A[ids] @ B[ids]`` with STATIC shapes: heterogeneous adapters batch
+into one decode window and the jit program count stays fixed (adapter
+ids are data, not shape — zero recompiles per tenant mix).
+
+This module owns the placement policy over those slots, KVBM-style:
+host copies of every registered adapter are always kept (they are tiny —
+a rank-8 adapter for an 8B model is ~10 MB), the device slots are the
+constrained resource, and ``acquire`` hot-loads on miss with LRU
+eviction over slots no live request references. ``pin`` exempts an
+adapter from eviction entirely (latency-critical tenants). All device
+work happens on the engine thread (``acquire``/``release`` are called
+from admission/finish); ``register`` is pure host work and safe from
+any thread.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+import numpy as np
+
+from dynamo_tpu.runtime.errors import AdapterNotFoundError, OverloadedError
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("lora")
+
+
+class AdapterStore:
+    def __init__(self, runner, max_adapters: int, max_rank: int):
+        if max_adapters < 1:
+            raise ValueError(f"max_adapters must be >= 1, got {max_adapters}")
+        self.runner = runner
+        self.max_adapters = max_adapters
+        self.max_rank = max_rank
+        # Canonical (d_in, d_out) per target projection — registration
+        # validates host weights against these; the runner replicates
+        # wk/wv columns itself when tp > num_kv_heads.
+        self.target_shapes = runner.config.lora_target_shapes()
+        self.num_layers = runner.canonical_spec.num_layers
+        self._lock = threading.Lock()
+        #: name -> {"weights": {key: (A, B)}, "rank": int, "path": str|None}
+        self._registry: dict[str, dict] = {}
+        #: device slot s (1-based) serves self._slots[s - 1].
+        self._slots: list[str | None] = [None] * max_adapters
+        self._slot_of: dict[str, int] = {}
+        self._refs: dict[str, int] = collections.defaultdict(int)
+        self._pinned: set[str] = set()
+        self._lru_clock = 0
+        self._last_used: dict[str, int] = {}
+        # Plain-int telemetry (engine-thread friendly; the
+        # AdapterMetricsUpdater turns these into dynamo_tpu_adapter_*
+        # deltas on a throttle, docs/OBSERVABILITY.md "Adapters").
+        self.loads_total = 0
+        self.evictions_total = 0
+        self.miss_total = 0
+        self.requests_total: collections.Counter = collections.Counter()
+
+    # -- host-side registry ---------------------------------------------------
+    def register(self, name: str, path: str | None = None,
+                 weights: dict | None = None) -> None:
+        """Register an adapter by HF PEFT checkpoint dir or pre-loaded
+        ``{key: (A [L, d_in, r], B [L, r, d_out])}`` host pytree. Host
+        work only — the device upload happens lazily at first acquire
+        (the hot-load path), so registration is cheap at any time."""
+        if not name:
+            raise ValueError("adapter name must be non-empty")
+        if weights is None:
+            if path is None:
+                raise ValueError("register needs a path or weights")
+            from dynamo_tpu.engine.weights import load_lora_weights
+            weights = load_lora_weights(self.runner.canonical_spec, path,
+                                        self.max_rank)
+        rank = 0
+        for key, (a, b) in weights.items():
+            shape = self.target_shapes.get(key)
+            if shape is None:
+                raise ValueError(
+                    f"adapter {name!r}: {key} is not a LoRA target for "
+                    f"this model (targets: {sorted(self.target_shapes)})")
+            d_in, d_out = shape
+            want_a = (self.num_layers, d_in, self.max_rank)
+            want_b = (self.num_layers, self.max_rank, d_out)
+            if tuple(a.shape) != want_a or tuple(b.shape) != want_b:
+                raise ValueError(
+                    f"adapter {name!r}: {key} shapes {a.shape}/{b.shape} "
+                    f"!= expected {want_a}/{want_b}")
+            # Effective rank: trailing all-zero columns are padding.
+            nz = np.flatnonzero(
+                np.abs(np.asarray(a, np.float32)).sum(axis=(0, 1)))
+            rank = max(rank, int(nz[-1]) + 1 if len(nz) else 0)
+        with self._lock:
+            replacing = name in self._registry
+            self._registry[name] = {"weights": weights, "rank": rank,
+                                    "path": path}
+            if replacing and name in self._slot_of:
+                # Live-reload: the resident copy is stale — re-upload in
+                # place so in-flight acquires keep a consistent slot id.
+                self._upload_locked(name, self._slot_of[name])
+        log.info("adapter %r registered (rank %d%s)%s", name, rank,
+                 f", {path}" if path else "",
+                 " [live-reloaded]" if replacing else "")
+
+    def registered(self, name: str) -> bool:
+        with self._lock:
+            return name in self._registry
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._registry)
+
+    # -- device-slot placement (ENGINE THREAD) --------------------------------
+    def _full_weights(self, name: str) -> dict:
+        """The complete per-target host set for an upload: projections
+        the checkpoint does not cover get zeros — a slot overwrite must
+        never leave a previous tenant's deltas behind."""
+        import ml_dtypes
+        entry = self._registry[name]
+        out = {}
+        for key, (d_in, d_out) in self.target_shapes.items():
+            pair = entry["weights"].get(key)
+            if pair is None:
+                pair = (np.zeros((self.num_layers, d_in, self.max_rank),
+                                 ml_dtypes.bfloat16),
+                        np.zeros((self.num_layers, self.max_rank, d_out),
+                                 ml_dtypes.bfloat16))
+            out[key] = pair
+        return out
+
+    def _upload_locked(self, name: str, slot: int) -> None:
+        self.runner.set_adapter_slot(slot, self._full_weights(name))
+        self.loads_total += 1
+
+    def acquire(self, name: str) -> int:
+        """Resolve an adapter name to its device slot id, hot-loading on
+        miss (LRU eviction over unpinned slots no live request holds).
+        Raises AdapterNotFoundError (unknown name — the frontend's 404)
+        or OverloadedError (every slot busy — the router retries
+        elsewhere / later). Pairs with ``release``."""
+        with self._lock:
+            if name not in self._registry:
+                raise AdapterNotFoundError(
+                    f"adapter {name!r} is not registered on this worker "
+                    f"(serving: {sorted(self._registry) or 'none'})")
+            self.requests_total[name] += 1
+            self._lru_clock += 1
+            self._last_used[name] = self._lru_clock
+            slot = self._slot_of.get(name)
+            if slot is None:
+                slot = self._place_locked(name)
+            self._refs[name] += 1
+            return slot
+
+    def _place_locked(self, name: str) -> int:
+        self.miss_total += 1
+        free = next((i for i, n in enumerate(self._slots) if n is None),
+                    None)
+        if free is None:
+            victims = [n for n in self._slots
+                       if n is not None and not self._refs[n]
+                       and n not in self._pinned]
+            if not victims:
+                raise OverloadedError(
+                    f"all {self.max_adapters} adapter slots are held by "
+                    f"live or pinned adapters; cannot hot-load "
+                    f"{name!r}", retry_after_s=1.0)
+            victim = min(victims, key=lambda n: self._last_used.get(n, 0))
+            free = self._slot_of.pop(victim) - 1
+            self._slots[free] = None
+            self.evictions_total += 1
+            log.info("adapter %r evicted from slot %d (LRU) for %r",
+                     victim, free + 1, name)
+        slot = free + 1
+        self._upload_locked(name, slot)
+        self._slots[free] = name
+        self._slot_of[name] = slot
+        log.info("adapter %r hot-loaded into slot %d", name, slot)
+        return slot
+
+    def release(self, name: str) -> None:
+        """Drop one live-request reference (engine thread, at slot
+        finish). The adapter stays resident until LRU pressure."""
+        with self._lock:
+            if self._refs.get(name, 0) > 0:
+                self._refs[name] -= 1
+
+    def pin(self, name: str) -> None:
+        """Exempt from LRU eviction (the KVBM pin discipline). Unknown
+        names raise — a pin typo must not silently protect nothing."""
+        with self._lock:
+            if name not in self._registry:
+                raise AdapterNotFoundError(f"cannot pin unknown adapter "
+                                           f"{name!r}")
+            self._pinned.add(name)
+
+    def unpin(self, name: str) -> None:
+        with self._lock:
+            self._pinned.discard(name)
+
+    def evict(self, name: str) -> bool:
+        """Explicitly free an adapter's slot (admin). Refuses while live
+        requests reference it; returns whether a slot was freed."""
+        with self._lock:
+            slot = self._slot_of.get(name)
+            if slot is None or self._refs.get(name, 0):
+                return False
+            self._slot_of.pop(name)
+            self._slots[slot - 1] = None
+            self.evictions_total += 1
+            return True
+
+    @property
+    def resident(self) -> int:
+        return len(self._slot_of)
+
+    def status(self) -> dict:
+        """The /debug/kv "adapters" block (doctor check_adapters reads
+        this through /debug/fleet)."""
+        with self._lock:
+            return {
+                "max_adapters": self.max_adapters,
+                "max_rank": self.max_rank,
+                "registered": sorted(self._registry),
+                "resident": {n: s for n, s in self._slot_of.items()},
+                "pinned": sorted(self._pinned),
+                "active_refs": {n: r for n, r in self._refs.items() if r},
+                "loads_total": self.loads_total,
+                "evictions_total": self.evictions_total,
+                "miss_total": self.miss_total,
+                "requests_total": dict(self.requests_total),
+            }

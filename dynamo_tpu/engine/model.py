@@ -42,6 +42,39 @@ def mm(x: jax.Array, w, pattern: str) -> jax.Array:
     return jnp.einsum(pattern, x, w, preferred_element_type=jnp.bfloat16)
 
 
+def lora_delta(x: jax.Array, ll: dict, ids: jax.Array) -> jax.Array:
+    """Gathered batched low-rank correction ``x @ A[ids] @ B[ids]`` —
+    the S-LoRA / Punica batched-heterogeneous-adapter step, as two
+    gathered einsums so it lives INSIDE the same jit programs as the
+    base projections (static shapes: adapter ids are data, not shape).
+
+    x [B, H] or [B, T, H]; ll = one layer's stacks {"a": [S, H, r],
+    "b": [S, r, D]}; ids [B] resident slot ids (0 = base model, whose
+    stacks are all-zero — the correction is exact zeros and the output
+    is bit-identical to the LoRA-free projection). The rank contraction
+    accumulates in f32, matching mm()'s numerics discipline."""
+    a = jnp.take(ll["a"], ids, axis=0)             # [B, H, r]
+    b = jnp.take(ll["b"], ids, axis=0)             # [B, r, D]
+    if x.ndim == 2:
+        u = jnp.einsum("bh,bhr->br", x, a,
+                       preferred_element_type=jnp.float32)
+        return jnp.einsum("br,brd->bd", u.astype(jnp.bfloat16), b,
+                          preferred_element_type=jnp.bfloat16)
+    u = jnp.einsum("bth,bhr->btr", x, a,
+                   preferred_element_type=jnp.float32)
+    return jnp.einsum("btr,brd->btd", u.astype(jnp.bfloat16), b,
+                      preferred_element_type=jnp.bfloat16)
+
+
+def qkv_lora(q, k, v, h, ll, ids):
+    """Apply the wq/wk/wv corrections to freshly-projected q/k/v (h is
+    the rms-normed layer input the projections read)."""
+    q = q + lora_delta(h, ll["wq"], ids)
+    k = k + lora_delta(h, ll["wk"], ids)
+    v = v + lora_delta(h, ll["wv"], ids)
+    return q, k, v
+
+
 def embed_lookup(embed, tokens: jax.Array) -> jax.Array:
     """Token-embedding gather; int8 tables gather q rows and scale by the
     per-hidden-channel scale."""
@@ -167,7 +200,8 @@ def param_specs(spec: ModelSpec) -> dict:
     return specs
 
 
-def ffn_block(h2: jax.Array, lp: dict, spec: ModelSpec) -> jax.Array:
+def ffn_block(h2: jax.Array, lp: dict, spec: ModelSpec, ll: dict | None = None,
+              ids: jax.Array | None = None) -> jax.Array:
     """Feed-forward over normalized hidden states [..., H]: dense SwiGLU,
     or Mixtral-style top-k MoE when spec.num_experts > 0.
 
@@ -178,10 +212,20 @@ def ffn_block(h2: jax.Array, lp: dict, spec: ModelSpec) -> jax.Array:
     parallelism without a dynamic all-to-all (serving batches are small;
     capacity-based dispatch kernels are a future optimization)."""
     if not spec.num_experts:
+        # Dense-MLP LoRA targets (gathered per-row deltas; MoE expert
+        # weights are not adapter targets — attention-only there, so the
+        # stacks simply lack the MLP keys).
+        mlp_lora = ll is not None and "w_gate" in ll
         gate = mm(h2, lp["w_gate"], "...h,hi->...i")
         up = mm(h2, lp["w_up"], "...h,hi->...i")
+        if mlp_lora:
+            gate = gate + lora_delta(h2, ll["w_gate"], ids)
+            up = up + lora_delta(h2, ll["w_up"], ids)
         ff = jax.nn.silu(gate.astype(jnp.float32)).astype(jnp.bfloat16) * up
-        return mm(ff, lp["w_down"], "...i,ih->...h")
+        down = mm(ff, lp["w_down"], "...i,ih->...h")
+        if mlp_lora:
+            down = down + lora_delta(ff, ll["w_down"], ids)
+        return down
     orig = h2.shape
     x = h2.reshape(-1, orig[-1])                       # [T, H]
     router = jnp.einsum("th,he->te", x, lp["moe_gate"],
@@ -458,6 +502,8 @@ def prefill_forward(params: Params, spec: ModelSpec,
                     sp_shard: bool = False, ring_mesh=None,
                     x_embeds: jax.Array | None = None,
                     embeds_mask: jax.Array | None = None,
+                    lora: dict | None = None,
+                    adapter_ids: jax.Array | None = None,
                     ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Process prompt chunks and write K/V into pages.
 
@@ -485,11 +531,14 @@ def prefill_forward(params: Params, spec: ModelSpec,
     cos, sin = rope_tables(positions, d, spec.rope_theta)
     valid = jnp.arange(s)[None, :] < seq_lens[:, None]
 
-    def layer_fn(x, lp):
+    def layer_fn(x, scan_in):
+        lp, ll = scan_in if lora is not None else (scan_in, None)
         h = rms_norm(x, lp["input_norm"], spec.rms_norm_eps)
         q = mm(h, lp["wq"], "bsh,hd->bsd")
         k = mm(h, lp["wk"], "bsh,hd->bsd")
         v = mm(h, lp["wv"], "bsh,hd->bsd")
+        if ll is not None:
+            q, k, v = qkv_lora(q, k, v, h, ll, adapter_ids)
         if spec.qkv_bias:
             q = q + lp["bq"]
             k = k + lp["bk"]
@@ -506,14 +555,18 @@ def prefill_forward(params: Params, spec: ModelSpec,
             attn = dense_causal_attention(q, k, v, positions, valid,
                                           spec.q_per_kv)
         attn = attn.reshape(b, s, -1)
-        x = x + mm(attn, lp["wo"], "bsd,dh->bsh")
+        proj = mm(attn, lp["wo"], "bsd,dh->bsh")
+        if ll is not None:
+            proj = proj + lora_delta(attn, ll["wo"], adapter_ids)
+        x = x + proj
         h2 = rms_norm(x, lp["post_attn_norm"], spec.rms_norm_eps)
-        x = x + ffn_block(h2, lp, spec)
+        x = x + ffn_block(h2, lp, spec, ll, adapter_ids)
         return x, (k, v)
 
     # Cache writes are deferred out of the scan (ys are fresh allocations —
     # carrying the caches through would rewrite the whole pool per call).
-    x, (k_new, v_new) = jax.lax.scan(layer_fn, x, params["layers"])
+    xs = (params["layers"], lora) if lora is not None else params["layers"]
+    x, (k_new, v_new) = jax.lax.scan(layer_fn, x, xs)
     # k_new [L,B,S,Nkv,D] -> page blocks [L,Nkv,B*S/page,page,D]; one
     # in-place scatter per cache covers every layer.
     L = spec.num_layers
@@ -693,6 +746,8 @@ def decode_forward(params: Params, spec: ModelSpec,
                    tokens: jax.Array, positions: jax.Array,
                    page_table: jax.Array, seq_lens: jax.Array,
                    attention_impl=None, write_mask: jax.Array | None = None,
+                   lora: dict | None = None,
+                   adapter_ids: jax.Array | None = None,
                    ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One decode step for the whole slot batch.
 
@@ -726,11 +781,16 @@ def decode_forward(params: Params, spec: ModelSpec,
     L = spec.num_layers
 
     def layer_fn(x, scan_in):
-        lp, layer = scan_in
+        if lora is not None:
+            lp, layer, ll = scan_in
+        else:
+            (lp, layer), ll = scan_in, None
         h = rms_norm(x, lp["input_norm"], spec.rms_norm_eps)
         q = mm(h, lp["wq"], "bh,hd->bd")
         k = mm(h, lp["wk"], "bh,hd->bd")
         v = mm(h, lp["wv"], "bh,hd->bd")
+        if ll is not None:
+            q, k, v = qkv_lora(q, k, v, h, ll, adapter_ids)
         if spec.qkv_bias:
             q = q + lp["bq"]
             k = k + lp["bk"]
@@ -743,13 +803,17 @@ def decode_forward(params: Params, spec: ModelSpec,
         attn = attn_fn(q, k_cache, v_cache, layer, page_table, hist_lens,
                        k, v, spec.q_per_kv)  # [B,Nh,D]
         attn = attn.reshape(b, -1)
-        x = x + mm(attn, lp["wo"], "bd,dh->bh")
+        proj = mm(attn, lp["wo"], "bd,dh->bh")
+        if ll is not None:
+            proj = proj + lora_delta(attn, ll["wo"], adapter_ids)
+        x = x + proj
         h2 = rms_norm(x, lp["post_attn_norm"], spec.rms_norm_eps)
-        x = x + ffn_block(h2, lp, spec)
+        x = x + ffn_block(h2, lp, spec, ll, adapter_ids)
         return x, (k, v)
 
-    x, (k_new, v_new) = jax.lax.scan(
-        layer_fn, x, (params["layers"], jnp.arange(L)))
+    xs = ((params["layers"], jnp.arange(L), lora) if lora is not None
+          else (params["layers"], jnp.arange(L)))
+    x, (k_new, v_new) = jax.lax.scan(layer_fn, x, xs)
     # One in-place scatter: [L,Nkv,B,D] at (dest_page[b], page_off[b]).
     k_cache = scatter_tokens(k_cache, k_new.transpose(0, 2, 1, 3),
                              dest_page, page_off)
@@ -765,7 +829,9 @@ def decode_window_multi_step(params: Params, spec: ModelSpec,
                              k_buf: jax.Array, v_buf: jax.Array,
                              wlen: jax.Array, tokens: jax.Array,
                              positions: jax.Array, page_table: jax.Array,
-                             hist_lens: jax.Array
+                             hist_lens: jax.Array,
+                             lora: dict | None = None,
+                             adapter_ids: jax.Array | None = None
                              ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Speculative-verification step INSIDE a window: S tokens per slot
     (the chained token + S-1 n-gram drafts) forwarded TOGETHER — one
@@ -790,11 +856,16 @@ def decode_window_multi_step(params: Params, spec: ModelSpec,
     L = spec.num_layers
 
     def layer_fn(x, scan_in):
-        lp, layer, kb_l, vb_l = scan_in                # kb_l [Nkv,B,W,D]
+        if lora is not None:
+            lp, layer, kb_l, vb_l, ll = scan_in        # kb_l [Nkv,B,W,D]
+        else:
+            (lp, layer, kb_l, vb_l), ll = scan_in, None
         h = rms_norm(x, lp["input_norm"], spec.rms_norm_eps)
         q = mm(h, lp["wq"], "bsh,hd->bsd")
         k = mm(h, lp["wk"], "bsh,hd->bsd")
         v = mm(h, lp["wv"], "bsh,hd->bsd")
+        if ll is not None:
+            q, k, v = qkv_lora(q, k, v, h, ll, adapter_ids)
         if spec.qkv_bias:
             q = q + lp["bq"]
             k = k + lp["bk"]
@@ -838,13 +909,18 @@ def decode_window_multi_step(params: Params, spec: ModelSpec,
                + jnp.einsum("bnsgj,nbjd->bsngd", p_win, vb_l)
                + jnp.einsum("bnsgt,btnd->bsngd", p_blk, v))
         attn = out.reshape(b, s, -1)
-        x = x + mm(attn, lp["wo"], "bsd,dh->bsh")
+        proj = mm(attn, lp["wo"], "bsd,dh->bsh")
+        if ll is not None:
+            proj = proj + lora_delta(attn, ll["wo"], adapter_ids)
+        x = x + proj
         h2 = rms_norm(x, lp["post_attn_norm"], spec.rms_norm_eps)
-        x = x + ffn_block(h2, lp, spec)
+        x = x + ffn_block(h2, lp, spec, ll, adapter_ids)
         return x, (k, v)
 
-    x, (k_new, v_new) = jax.lax.scan(
-        layer_fn, x, (params["layers"], jnp.arange(L), k_buf, v_buf))
+    xs = ((params["layers"], jnp.arange(L), k_buf, v_buf, lora)
+          if lora is not None
+          else (params["layers"], jnp.arange(L), k_buf, v_buf))
+    x, (k_new, v_new) = jax.lax.scan(layer_fn, x, xs)
     x = rms_norm(x, params["final_norm"], spec.rms_norm_eps)
     logits = lm_logits(x.reshape(b * s, -1), params, spec)
     return logits.reshape(b, s, -1), k_new, v_new
@@ -905,7 +981,8 @@ def decode_window_step(params: Params, spec: ModelSpec,
                        k_buf: jax.Array, v_buf: jax.Array, m: jax.Array,
                        tokens: jax.Array, positions: jax.Array,
                        page_table: jax.Array, hist_lens: jax.Array,
-                       attention_impl=None
+                       attention_impl=None, lora: dict | None = None,
+                       adapter_ids: jax.Array | None = None
                        ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One decode step INSIDE an M-step window: the caches are read-only
     (gathered), this window's earlier tokens come from k_buf/v_buf
@@ -923,11 +1000,16 @@ def decode_window_step(params: Params, spec: ModelSpec,
     L = spec.num_layers
 
     def layer_fn(x, scan_in):
-        lp, layer, kb_l, vb_l = scan_in
+        if lora is not None:
+            lp, layer, kb_l, vb_l, ll = scan_in
+        else:
+            (lp, layer, kb_l, vb_l), ll = scan_in, None
         h = rms_norm(x, lp["input_norm"], spec.rms_norm_eps)
         q = mm(h, lp["wq"], "bh,hd->bd")
         k = mm(h, lp["wk"], "bh,hd->bd")
         v = mm(h, lp["wv"], "bh,hd->bd")
+        if ll is not None:
+            q, k, v = qkv_lora(q, k, v, h, ll, adapter_ids)
         if spec.qkv_bias:
             q = q + lp["bq"]
             k = k + lp["bk"]
@@ -940,13 +1022,18 @@ def decode_window_step(params: Params, spec: ModelSpec,
         attn = attn_fn(q, k_cache, v_cache, layer, page_table, hist_lens,
                        kb_l, vb_l, m, k, v, spec.q_per_kv)
         attn = attn.reshape(b, -1)
-        x = x + mm(attn, lp["wo"], "bd,dh->bh")
+        proj = mm(attn, lp["wo"], "bd,dh->bh")
+        if ll is not None:
+            proj = proj + lora_delta(attn, ll["wo"], adapter_ids)
+        x = x + proj
         h2 = rms_norm(x, lp["post_attn_norm"], spec.rms_norm_eps)
-        x = x + ffn_block(h2, lp, spec)
+        x = x + ffn_block(h2, lp, spec, ll, adapter_ids)
         return x, (k, v)
 
-    x, (k_new, v_new) = jax.lax.scan(
-        layer_fn, x, (params["layers"], jnp.arange(L), k_buf, v_buf))
+    xs = ((params["layers"], jnp.arange(L), k_buf, v_buf, lora)
+          if lora is not None
+          else (params["layers"], jnp.arange(L), k_buf, v_buf))
+    x, (k_new, v_new) = jax.lax.scan(layer_fn, x, xs)
     x = rms_norm(x, params["final_norm"], spec.rms_norm_eps)
     logits = lm_logits(x, params, spec)
     return logits, k_new, v_new

@@ -70,7 +70,10 @@ PK_FREQPEN = 9    # float32 bits: OpenAI frequency_penalty (0 = off)
 PK_PRESPEN = 10   # float32 bits: OpenAI presence_penalty (0 = off)
 PK_SEED = 11      # int32 sampling seed (meaningful when PK_SEEDED)
 PK_SEEDED = 12    # 1 -> slot uses a per-request seeded rng stream
-PK_PREFIX = 13    # page table starts here
+PK_ADAPTER = 13   # resident LoRA adapter slot id (0 = base model; the
+                  # gathered A/B correction reads this row's stacks —
+                  # engine/lora.py)
+PK_PREFIX = 14    # page table starts here
 
 TOP_LOGPROBS = 8  # alternatives returned when logprobs are requested
 
@@ -85,7 +88,7 @@ def mask_seed(seed: int) -> int:
 
 _PF_HDR = 12      # prefill packed-array header columns (7 freq-penalty
                   # bits, 8 pres-penalty bits, 9 seed, 10 seeded flag,
-                  # 11 spare)
+                  # 11 adapter slot id)
 
 
 def _logprobs_of(logits: jax.Array, sampled: jax.Array):
@@ -113,6 +116,8 @@ class PrefillSeq:
     # token table's row (the token id there is a placeholder).
     embeds: np.ndarray | None = None
     embeds_mask: np.ndarray | None = None
+    # Resident LoRA adapter slot (0 = base model; engine/lora.py).
+    adapter_id: int = 0
 
 
 def _mh_put(value, sharding):
@@ -323,6 +328,33 @@ class ModelRunner:
         self.counts_dev = _mh_zeros(
             (config.max_num_seqs, spec.vocab_size), jnp.uint8,
             NamedSharding(self.mesh, P()))
+        # Batched LoRA stacks (engine/lora.py): one pair of stacked
+        # pytrees per target projection — A [L, S, d_in, r] /
+        # B [L, S, r, d_out], S = max_adapters + 1 slots with slot 0 the
+        # base model (all-zero, exact no-op). Layer-major so the layer
+        # scan consumes them as xs alongside params["layers"]; the layer
+        # axis shards over "pp" (stacks live with their stage), the rest
+        # replicates — a rank-8 stack is megabytes, not gigabytes. The
+        # named-parameter-overlay shape: adapter weights ride the mesh
+        # beside base params and hot-swap per slot without touching them.
+        self.lora = None
+        if config.max_adapters > 0:
+            S = config.max_adapters + 1
+            r = config.lora_max_rank
+            lspec = NamedSharding(self.mesh, P("pp", None, None, None))
+            shapes = config.lora_target_shapes()
+            if self.kv_rep > 1:
+                # KV-head replication rewrote wk/wv: the B stacks' output
+                # axis follows the EFFECTIVE head count (uploads
+                # replicate columns in set_adapter_slot).
+                dkv = spec.num_kv_heads * spec.head_dim
+                shapes["wk"] = (shapes["wk"][0], dkv)
+                shapes["wv"] = (shapes["wv"][0], dkv)
+            L = spec.num_layers
+            self.lora = {
+                key: {"a": _mh_zeros((L, S, d_in, r), jnp.bfloat16, lspec),
+                      "b": _mh_zeros((L, S, r, d_out), jnp.bfloat16, lspec)}
+                for key, (d_in, d_out) in shapes.items()}
         self._attention_impl, self._window_attention_impl = \
             self._pick_attention()
 
@@ -432,13 +464,14 @@ class ModelRunner:
         # (multimodal prompts) takes encoder embeddings + a mask that
         # override the token table under media spans.
         def step(params, k_cache, v_cache, packed, rng, counts=None,
-                 emb=None, emb_mask=None):
+                 emb=None, emb_mask=None, lora=None):
             start = packed[:, 0]
             n = packed[:, 1]
             hist_lens = packed[:, 2]
             temp = jax.lax.bitcast_convert_type(packed[:, 3], jnp.float32)
             top_k = packed[:, 4]
             top_p = jax.lax.bitcast_convert_type(packed[:, 5], jnp.float32)
+            adapter_ids = packed[:, 11]
             tokens = packed[:, _PF_HDR:_PF_HDR + bucket]
             page_table = packed[:, _PF_HDR + bucket:
                                 _PF_HDR + bucket + bucket_pages]
@@ -452,7 +485,7 @@ class ModelRunner:
             cfg_pp = self.config.pp
             pipelined = (not with_history and cfg_pp > 1
                          and self.config.pp_microbatch and not sp_shard
-                         and not with_embeds
+                         and not with_embeds and lora is None
                          and batch % cfg_pp == 0
                          and spec.num_layers % cfg_pp == 0)
             if with_history:
@@ -460,7 +493,8 @@ class ModelRunner:
                     params, spec, k_cache, v_cache, tokens, positions,
                     page_table, seq_lens, hist_table, hist_lens,
                     self._attention_impl, sp_shard=sp_shard,
-                    x_embeds=emb, embeds_mask=emb_mask)
+                    x_embeds=emb, embeds_mask=emb_mask,
+                    lora=lora, adapter_ids=adapter_ids)
             elif pipelined:
                 from dynamo_tpu.engine.model import (
                     prefill_forward_pipelined)
@@ -473,7 +507,8 @@ class ModelRunner:
                     page_table, seq_lens, sp_shard=sp_shard,
                     ring_mesh=(self.mesh if sp_shard
                                and self.config.ring_attention else None),
-                    x_embeds=emb, embeds_mask=emb_mask)
+                    x_embeds=emb, embeds_mask=emb_mask,
+                    lora=lora, adapter_ids=adapter_ids)
             if penalized:
                 freq = jax.lax.bitcast_convert_type(packed[:, 7],
                                                     jnp.float32)
@@ -546,7 +581,8 @@ class ModelRunner:
         page = self.config.page_size
 
         def run_window(params, k_cache, v_cache, tokens_dev, packed, rng,
-                       counts=None):
+                       counts=None, lora=None):
+            adapter_ids = packed[:, PK_ADAPTER]
             mask = packed[:, PK_OVERRIDE] > 0
             tokens0 = jnp.where(mask, packed[:, PK_TOKEN], tokens_dev)
             positions0 = packed[:, PK_POS]
@@ -589,7 +625,8 @@ class ModelRunner:
                 logits, k_new, v_new = decode_window_step(
                     params, spec, k_cache, v_cache, kbuf, vbuf, m, tokens,
                     positions, page_table, hist_lens,
-                    attention_impl=self._window_attention_impl)
+                    attention_impl=self._window_attention_impl,
+                    lora=lora, adapter_ids=adapter_ids)
                 # Append this step's K/V ([L,B,Nkv,D] -> window col m).
                 kbuf = jax.lax.dynamic_update_slice(
                     kbuf, k_new.transpose(0, 2, 1, 3)[:, :, :, None],
@@ -694,8 +731,9 @@ class ModelRunner:
         W = m_outer * S  # in-window KV columns (worst case: all accepted)
 
         def run_spec(params, k_cache, v_cache, tokens_dev, hist_dev,
-                     positions_dev, packed):
+                     positions_dev, packed, lora=None):
             from dynamo_tpu.engine.model import decode_window_multi_step
+            adapter_ids = packed[:, PK_ADAPTER]
             override = packed[:, PK_OVERRIDE] > 0
             tokens0 = jnp.where(override, packed[:, PK_TOKEN], tokens_dev)
             pos0 = jnp.where(override, packed[:, PK_POS], positions_dev)
@@ -744,7 +782,8 @@ class ModelRunner:
                 # positions hold garbage until the post-scan commit.
                 logits, k_new, v_new = decode_window_multi_step(
                     params, spec, k_cache, v_cache, kbuf, vbuf, wlen,
-                    tok_blk, pos_blk, page_table, hist_lens=pos0)
+                    tok_blk, pos_blk, page_table, hist_lens=pos0,
+                    lora=lora, adapter_ids=adapter_ids)
                 out = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B,S]
                 eq = (drafts == out[:, :k]) & dvalid
                 accflags = jnp.cumprod(
@@ -806,11 +845,13 @@ class ModelRunner:
         chain on-device (see _get_spec_window)."""
         bucket_pages = packed.shape[1] - PK_PREFIX
         fn = self._get_spec_window(m_outer, k, bucket_pages)
+        kw = {} if self.lora is None else {"lora": self.lora}
         with self.mesh:
             (outs, accs, ndrafts, self.tokens_dev, self.positions_dev,
              self.hist_dev, self.k_cache, self.v_cache) = fn(
                 self.params, self.k_cache, self.v_cache, self.tokens_dev,
-                self.hist_dev, self.positions_dev, jnp.asarray(packed))
+                self.hist_dev, self.positions_dev, jnp.asarray(packed),
+                **kw)
         return outs, accs, ndrafts
 
     def seed_history(self, entries: list[tuple]) -> None:
@@ -873,6 +914,45 @@ class ModelRunner:
                 self.hist_dev, self.positions_dev, self.tokens_dev,
                 jnp.asarray(toks), jnp.asarray(meta))
 
+    # -- batched LoRA (engine/lora.py) ----------------------------------------
+    def set_adapter_slot(self, slot: int, host: dict) -> None:
+        """Upload one adapter's host weights into device slot ``slot``
+        (ENGINE THREAD; the AdapterStore's hot-load path). ``host`` is
+        the COMPLETE target set {key: (A [L, d_in, r], B [L, r, d_out])}
+        at canonical shapes — untargeted projections are zeros, so a
+        slot overwrite can never leave a previous tenant's deltas
+        behind. One compiled scatter program for every slot (the slot
+        index is data), registered through perf.instrumented_jit."""
+        if self.lora is None:
+            raise RuntimeError("runner built without max_adapters")
+        if not 1 <= slot <= self.config.max_adapters:
+            raise ValueError(f"adapter slot {slot} outside "
+                             f"[1, {self.config.max_adapters}]")
+        key = ("lora_load",)
+        fn = self._window_cache.get(key)
+        if fn is None:
+            def scatter(lora, host, s):
+                return jax.tree.map(
+                    lambda dst, src: dst.at[:, s].set(src), lora, host)
+            fn = perf.instrumented_jit("lora_load", scatter, key=key,
+                                       donate_argnums=(0,))
+            self._window_cache[key] = fn
+        dev = {}
+        for k, (a, b) in host.items():
+            a = np.asarray(a)
+            b = np.asarray(b)
+            if self.kv_rep > 1 and k in ("wk", "wv"):
+                # Match the replicated wk/wv columns: canonical head g's
+                # B columns land at effective heads [g*rep, (g+1)*rep).
+                L, r, _ = b.shape
+                d = self.spec.head_dim
+                b = (b.reshape(L, r, self.canonical_nkv, d)
+                     .repeat(self.kv_rep, axis=2)
+                     .reshape(L, r, self.spec.num_kv_heads * d))
+            dev[k] = {"a": jnp.asarray(a), "b": jnp.asarray(b)}
+        with self.mesh:
+            self.lora = fn(self.lora, dev, jnp.asarray(slot, jnp.int32))
+
     # -- public API (blocking; called from the engine thread) -----------------
     def prefill_batch(self, seqs: list[PrefillSeq],
                       slots: list[int] | None = None,
@@ -921,6 +1001,7 @@ class ModelRunner:
             if s.seed is not None:
                 packed[i, 9] = mask_seed(s.seed)
                 packed[i, 10] = 1
+            packed[i, 11] = s.adapter_id
             packed[i, _PF_HDR:_PF_HDR + n] = s.tokens
             # Pad page-table rows stay 0 = the allocator's RESERVED scratch
             # page, so padded block scatters land there — padding with a
@@ -948,6 +1029,10 @@ class ModelRunner:
                 emb[i, :n_row] = s.embeds.astype(ml_dtypes.bfloat16)
                 emb_mask[i, :n_row] = s.embeds_mask
             kw = {"emb": jnp.asarray(emb), "emb_mask": jnp.asarray(emb_mask)}
+        if self.lora is not None:
+            # Adapter stacks ride every prefill when LoRA serving is on:
+            # row ids are data (col 11), so one program covers every mix.
+            kw["lora"] = self.lora
         fn = self._get_prefill(bucket, bp, with_history, penalized, seeded,
                                with_embeds)
         with self.mesh:
@@ -1068,18 +1153,19 @@ class ModelRunner:
                          or packed[:, PK_PRESPEN].any())
         seeded = bool(packed[:, PK_SEEDED].any())
         fn = self._get_window(window, bucket_pages, penalized, seeded)
+        kw = {} if self.lora is None else {"lora": self.lora}
         with self.mesh:
             if penalized:
                 (toks, lps, top_vs, top_is, self.tokens_dev, self.k_cache,
                  self.v_cache, self._rng, self.counts_dev) = fn(
                     self.params, self.k_cache, self.v_cache,
                     self.tokens_dev, jnp.asarray(packed), self._rng,
-                    self.counts_dev)
+                    self.counts_dev, **kw)
             else:
                 (toks, lps, top_vs, top_is, self.tokens_dev, self.k_cache,
                  self.v_cache, self._rng) = fn(
                     self.params, self.k_cache, self.v_cache,
-                    self.tokens_dev, jnp.asarray(packed), self._rng)
+                    self.tokens_dev, jnp.asarray(packed), self._rng, **kw)
         return toks, lps, top_vs, top_is
 
     def embed(self, token_lists: list[list[int]],
@@ -1385,7 +1471,8 @@ def _replicate_kv_heads(params, spec, rep: int):
 def _prefill_with_history(params, spec, k_cache, v_cache, tokens, positions,
                           page_table, seq_lens, hist_table, hist_lens,
                           attention_impl, sp_shard: bool = False,
-                          x_embeds=None, embeds_mask=None):
+                          x_embeds=None, embeds_mask=None,
+                          lora=None, adapter_ids=None):
     """Chunked prefill: like prefill_forward but queries also attend to the
     sequence's earlier pages (read via the paged path). x_embeds/embeds_mask
     override token embeddings under multimodal media spans (rows are
@@ -1412,11 +1499,17 @@ def _prefill_with_history(params, spec, k_cache, v_cache, tokens, positions,
     maxp = hist_table.shape[1]
 
     def layer_fn(x, scan_in):
-        lp, layer = scan_in
+        if lora is not None:
+            lp, layer, ll = scan_in
+        else:
+            (lp, layer), ll = scan_in, None
         h = rms_norm(x, lp["input_norm"], spec.rms_norm_eps)
         q = mm(h, lp["wq"], "bsh,hd->bsd")
         k = mm(h, lp["wk"], "bsh,hd->bsd")
         v = mm(h, lp["wv"], "bsh,hd->bsd")
+        if ll is not None:
+            from dynamo_tpu.engine.model import qkv_lora
+            q, k, v = qkv_lora(q, k, v, h, ll, adapter_ids)
         if spec.qkv_bias:
             q = q + lp["bq"]
             k = k + lp["bk"]
@@ -1455,13 +1548,18 @@ def _prefill_with_history(params, spec, k_cache, v_cache, tokens, positions,
         attn = (jnp.einsum("bngql,nbld->bqngd", p_hist, v_hist)
                 + jnp.einsum("bngqk,bknd->bqngd", p_chunk, v))
         attn = attn.reshape(b, s, -1)
-        x = x + mm(attn, lp["wo"], "bsd,dh->bsh")
+        proj = mm(attn, lp["wo"], "bsd,dh->bsh")
+        if ll is not None:
+            from dynamo_tpu.engine.model import lora_delta
+            proj = proj + lora_delta(attn, ll["wo"], adapter_ids)
+        x = x + proj
         h2 = rms_norm(x, lp["post_attn_norm"], spec.rms_norm_eps)
-        x = x + ffn_block(h2, lp, spec)
+        x = x + ffn_block(h2, lp, spec, ll, adapter_ids)
         return x, (k, v)
 
-    x, (k_new, v_new) = jax.lax.scan(
-        layer_fn, x, (params["layers"], jnp.arange(L)))
+    xs = ((params["layers"], jnp.arange(L), lora) if lora is not None
+          else (params["layers"], jnp.arange(L)))
+    x, (k_new, v_new) = jax.lax.scan(layer_fn, x, xs)
     k_blocks = (k_new.reshape(L, b * (s // page), page, nkv, d)
                 .transpose(0, 3, 1, 2, 4))
     v_blocks = (v_new.reshape(L, b * (s // page), page, nkv, d)

@@ -93,3 +93,98 @@ def load_hf_weights(spec: ModelSpec, model_dir: str):
         params["lm_head"] = get("lm_head.weight").T
     log.info("loaded %d tensors from %s", len(tensors), model_dir)
     return params
+
+
+def load_lora_weights(spec: ModelSpec, adapter_dir: str, max_rank: int):
+    """Load a HF PEFT LoRA checkpoint into stacked per-projection pairs.
+
+    Reads ``adapter_config.json`` (r, lora_alpha, target_modules) and
+    ``adapter_model.safetensors`` from ``adapter_dir`` and returns
+    ``{key: (A [L, d_in, max_rank], B [L, max_rank, d_out])}`` numpy
+    bf16 pytrees over the projections the checkpoint targets (subset of
+    wq/wk/wv/wo + dense MLP). PEFT stores ``lora_A.weight`` as [r, in]
+    and ``lora_B.weight`` as [out, r]; ours are the transposes, with the
+    ``lora_alpha / r`` scale folded into B so serving pays no extra
+    multiply. Ranks below ``max_rank`` zero-pad — padded columns
+    contribute exact zeros, so heterogeneous-rank adapters share one
+    static stack shape. Layers or projections the checkpoint does not
+    cover stay zero (no delta).
+    """
+    import ml_dtypes
+    from safetensors import safe_open
+
+    cfg_path = os.path.join(adapter_dir, "adapter_config.json")
+    # dtpu: ignore[blocking-call-in-async] -- adapter-load startup/hot-load I/O, engine-thread or CLI, never the serving loop
+    with open(cfg_path) as fh:
+        cfg = json.load(fh)
+    rank = int(cfg.get("r", 8))
+    alpha = float(cfg.get("lora_alpha", rank))
+    if rank > max_rank:
+        raise ValueError(
+            f"adapter rank {rank} exceeds lora_max_rank {max_rank} "
+            f"({adapter_dir}); raise --max-lora-rank or re-train smaller")
+    scale = alpha / max(1, rank)
+
+    files = sorted(glob.glob(os.path.join(adapter_dir, "*.safetensors")))
+    if not files:
+        raise FileNotFoundError(f"no safetensors under {adapter_dir}")
+    tensors: dict[str, np.ndarray] = {}
+    for path in files:
+        with safe_open(path, framework="numpy") as fh:
+            for name in fh.keys():
+                tensors[name] = fh.get_tensor(name)
+
+    # HF module suffix -> our stacked projection key.
+    proj_of = {"q_proj": "wq", "k_proj": "wk", "v_proj": "wv",
+               "o_proj": "wo", "gate_proj": "w_gate", "up_proj": "w_up",
+               "down_proj": "w_down"}
+    if spec.num_experts:
+        for k in ("gate_proj", "up_proj", "down_proj"):
+            proj_of.pop(k)
+    L = spec.num_layers
+    bf16 = ml_dtypes.bfloat16
+    found: dict[str, dict[int, tuple[np.ndarray, np.ndarray]]] = {}
+    for name, arr in tensors.items():
+        # base_model.model.model.layers.{i}.self_attn.q_proj.lora_A.weight
+        parts = name.split(".")
+        if "layers" not in parts or "weight" != parts[-1]:
+            continue
+        li = int(parts[parts.index("layers") + 1])
+        module = parts[-3]
+        kind = parts[-2]  # lora_A | lora_B
+        key = proj_of.get(module)
+        if key is None or kind not in ("lora_A", "lora_B") or li >= L:
+            continue
+        a, b = found.setdefault(key, {}).get(li, (None, None))
+        if kind == "lora_A":
+            a = arr
+        else:
+            b = arr
+        found[key][li] = (a, b)
+    if not found:
+        raise ValueError(
+            f"{adapter_dir}: no LoRA tensors matched the target "
+            f"projections {sorted(proj_of.values())}")
+
+    out: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+    for key, per_layer in found.items():
+        # d_in/d_out from the checkpoint itself (validated against the
+        # model by the AdapterStore at registration).
+        li0 = next(iter(per_layer))
+        a0, b0 = per_layer[li0]
+        d_in = a0.shape[1]
+        d_out = b0.shape[0]
+        A = np.zeros((L, d_in, max_rank), bf16)
+        B = np.zeros((L, max_rank, d_out), bf16)
+        for li, (a, b) in per_layer.items():
+            if a is None or b is None:
+                raise ValueError(
+                    f"{adapter_dir}: layer {li} {key} has only one of "
+                    f"lora_A/lora_B")
+            r = a.shape[0]
+            A[li, :, :r] = a.astype(np.float32).T.astype(bf16)
+            B[li, :r, :] = (b.astype(np.float32).T * scale).astype(bf16)
+        out[key] = (A, B)
+    log.info("loaded LoRA adapter from %s: rank %d (padded to %d), "
+             "targets %s", adapter_dir, rank, max_rank, sorted(out))
+    return out

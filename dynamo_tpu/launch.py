@@ -85,6 +85,13 @@ def parse_args(argv=None) -> argparse.Namespace:
                              "with --quant (DTPU_QUANT_KV overrides)")
     parser.add_argument("--host-cache-pages", type=int, default=0)
     parser.add_argument("--kv-disk-cache-dir", default=None)
+    parser.add_argument("--lora", action="append", default=[],
+                        metavar="NAME=PATH",
+                        help="out=tpu: serve a LoRA adapter as its own "
+                             "model name on the in-process engine "
+                             "(HF PEFT checkpoint dir; repeatable)")
+    parser.add_argument("--max-adapters", type=int, default=None)
+    parser.add_argument("--max-lora-rank", type=int, default=8)
     parser.add_argument("--coordinator-url", default=None,
                         help="out=dyn: control plane to discover workers on")
     parser.add_argument("--tool-call-parser", default=None)
@@ -149,7 +156,14 @@ def _build_engine(args, metrics_registry=None):
 
 def build_local_served(args, metrics_registry=None
                        ) -> tuple[ServedModel, object]:
-    """Static pipeline: Preprocessor -> Backend -> engine, no network."""
+    """Static pipeline: Preprocessor -> Backend -> engine, no network.
+    With ``--lora``, the adapters register on the engine and each
+    adapter name becomes its own ServedModel (attached as
+    ``served.adapter_served``) whose card carries the (base, adapter)
+    binding — the same resolution the distributed frontend does from
+    discovered cards."""
+    if getattr(args, "lora", None) and args.output != "tpu":
+        raise SystemExit("--lora needs the real engine (out=tpu)")
     engine, tokenizer = _build_engine(args, metrics_registry)
     name = args.model_name or os.path.basename(args.model.rstrip("/"))
     card = ModelDeploymentCard(
@@ -162,6 +176,26 @@ def build_local_served(args, metrics_registry=None
     backend = Backend(tokenizer, inner=engine)
     pre = OpenAIPreprocessor(card, tokenizer, inner=backend)
     served = ServedModel(entry, pre, client=None, router=None)
+    served.adapter_served = []
+    for item in getattr(args, "lora", None) or []:
+        lname, sep, path = str(item).partition("=")
+        if not sep or not lname or not path:
+            raise SystemExit(f"--lora expects NAME=PATH, got {item!r}")
+        engine.register_adapter(lname, path=path)
+        from dynamo_tpu.llm.model_card import ModelRuntimeConfig
+        acard = ModelDeploymentCard(
+            name=lname, chat_template=DEFAULT_CHAT_TEMPLATE,
+            context_length=args.context_length,
+            tool_call_parser=args.tool_call_parser,
+            reasoning_parser=args.reasoning_parser,
+            runtime_config=ModelRuntimeConfig(
+                extra={"lora_base": name, "adapter": lname}))
+        aentry = ModelEntry(model_name=lname, namespace="local",
+                            component="local", endpoint="generate",
+                            model_type="chat", card=acard)
+        apre = OpenAIPreprocessor(acard, tokenizer, inner=backend)
+        served.adapter_served.append(
+            ServedModel(aentry, apre, client=None, router=None))
     return served, engine
 
 
@@ -285,6 +319,8 @@ async def run(args) -> None:
         served, engine = build_local_served(
             args, runtime.metrics.namespace("local").component(args.output))
         manager.models[served.name] = served
+        for extra in getattr(served, "adapter_served", []):
+            manager.models[extra.name] = extra
         watcher = None
     # SLO plane + accounting ledger + flight-bundle context: the static
     # pipeline gets the same decision-grade observability the

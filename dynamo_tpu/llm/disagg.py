@@ -287,7 +287,11 @@ class DisaggDecodeHandler:
     async def generate(self, request, context: Context) -> AsyncIterator[dict]:
         req = (request if isinstance(request, PreprocessedRequest)
                else PreprocessedRequest.from_wire(request))
-        if self.config.prefill_remote(len(req.token_ids)):
+        # LoRA adapter requests always prefill locally: the prefill
+        # worker holds base weights only, and base-computed KV under an
+        # adapter-salted hash chain would be silently wrong KV.
+        if self.config.prefill_remote(len(req.token_ids)) \
+                and not getattr(req, "adapter", None):
             injected = await self._remote_prefill(req, context)
             if injected is not None:
                 self.remote_prefills += 1

@@ -96,6 +96,13 @@ class ModelWatcher:
         # local store swaps out the config/discovery plane, not the
         # request plane.
         self._store = store
+        # KV routers shared across served names that point at the SAME
+        # worker endpoint — LoRA adapter cards ride their base model's
+        # workers, and a per-name router would split the radix/fleet
+        # view (and the breaker state) that makes KV-aware routing work.
+        # Keyed by (namespace, component, endpoint); refcounted by the
+        # model names using it so the last leaver closes it.
+        self._router_share: dict[tuple, dict] = {}
         self._task: asyncio.Task | None = None
         self._watch = None
         self._lock = asyncio.Lock()
@@ -187,8 +194,14 @@ class ModelWatcher:
                     await self._close_served(served)
                     del self.manager.models[name]
 
-    @staticmethod
-    async def _close_served(served: ServedModel) -> None:
+    async def _close_served(self, served: ServedModel) -> None:
+        for key, share in list(self._router_share.items()):
+            if share["router"] is served.router:
+                share["users"].discard(served.name)
+                if share["users"]:
+                    return  # other served names (adapters/base) still use it
+                del self._router_share[key]
+                break
         router_close = getattr(served.router, "close", None)
         if router_close is not None:
             await router_close()  # also closes the underlying client
@@ -200,10 +213,19 @@ class ModelWatcher:
         tokenizer = await fetch_tokenizer(store, entry.card)
         endpoint = (self._runtime.namespace(entry.namespace)
                     .component(entry.component).endpoint(entry.endpoint))
-        client = await endpoint.client()
         if self.router_mode == "kv" and self._kv_router_factory is not None:
-            router = await self._kv_router_factory(self._runtime, entry, client)
+            share_key = (entry.namespace, entry.component, entry.endpoint)
+            share = self._router_share.get(share_key)
+            if share is None:
+                client = await endpoint.client()
+                router = await self._kv_router_factory(self._runtime, entry,
+                                                       client)
+                share = {"router": router, "client": client, "users": set()}
+                self._router_share[share_key] = share
+            client, router = share["client"], share["router"]
+            share["users"].add(entry.model_name)
         else:
+            client = await endpoint.client()
             router = RouterEngine(client, self.router_mode)
         chain = Migration(entry.card.migration_limit, inner=router,
                           metrics=self._runtime.metrics)

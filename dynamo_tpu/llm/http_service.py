@@ -29,7 +29,8 @@ from dynamo_tpu.llm.protocols import (
 from dynamo_tpu.llm.recorder import finish_account, make_account
 from dynamo_tpu.runtime import slo as slo_mod
 from dynamo_tpu.runtime.context import Context
-from dynamo_tpu.runtime.errors import (InvalidRequestError, NoInstancesError,
+from dynamo_tpu.runtime.errors import (AdapterNotFoundError,
+                                       InvalidRequestError, NoInstancesError,
                                        OverloadedError, RateLimitedError)
 from dynamo_tpu.runtime.logging import (current_trace, get_logger,
                                         parse_traceparent)
@@ -67,6 +68,13 @@ def _response_object(full: dict, model: str, text: str | None) -> dict:
             "total_tokens": usage.get("total_tokens", 0),
         },
     }
+
+
+def _adapter_of(served) -> str | None:
+    """The LoRA adapter name a served model resolves to (None = base):
+    register_adapter stamps the binding into the card's runtime extras."""
+    extra = (served.entry.card.runtime_config.extra or {})
+    return extra.get("adapter") if extra.get("lora_base") else None
 
 
 def _error_body(message: str, err_type: str = "invalid_request_error",
@@ -386,6 +394,7 @@ class HttpService:
                 return _error_body(f"model {chat_req.model!r} not found",
                                    "model_not_found", 404)
             acct = make_account(route, chat_req.model)
+            acct["adapter"] = _adapter_of(served)
             permit, meta_headers, shed = await self._admit(request, route,
                                                            acct)
             if shed is not None:
@@ -420,6 +429,14 @@ class HttpService:
                             http_status=503)
                 return _error_body(str(exc), "service_unavailable", 503,
                                    retry_after_s=self._retry_after(exc))
+            except AdapterNotFoundError as exc:
+                # The model name resolved to an adapter card whose base
+                # worker does not hold the adapter: a naming error — 404
+                # like an unknown model, typed so clients can tell which.
+                self._m_requests.inc(route=route, status="404")
+                acct.update(status="error", reason="adapter_not_found",
+                            http_status=404)
+                return _error_body(str(exc), "adapter_not_found", 404)
             except RateLimitedError as exc:
                 self._m_requests.inc(route=route, status="429")
                 acct.update(status="shed", http_status=429,
@@ -475,6 +492,7 @@ class HttpService:
                 return _error_body(f"model {comp_req.model!r} not found",
                                    "model_not_found", 404)
             acct = make_account(route, comp_req.model)
+            acct["adapter"] = _adapter_of(served)
             permit, meta_headers, shed = await self._admit(request, route,
                                                            acct)
             if shed is not None:
@@ -523,6 +541,11 @@ class HttpService:
                                      "logprobs": None}],
                         "usage": usage or usage_block(0, 0),
                     }, headers=meta_headers)
+            except AdapterNotFoundError as exc:
+                self._m_requests.inc(route=route, status="404")
+                acct.update(status="error", reason="adapter_not_found",
+                            http_status=404)
+                return _error_body(str(exc), "adapter_not_found", 404)
             except ValueError as exc:
                 self._m_requests.inc(route=route, status="400")
                 acct.update(status="error", reason="invalid_request",
@@ -834,6 +857,12 @@ class HttpService:
                             http_status=503)
             return _error_body(str(exc), "service_unavailable", 503,
                                retry_after_s=self._retry_after(exc))
+        except AdapterNotFoundError as exc:
+            self._m_requests.inc(route=route, status="404")
+            if acct is not None:
+                acct.update(status="error", reason="adapter_not_found",
+                            http_status=404)
+            return _error_body(str(exc), "adapter_not_found", 404)
         except Exception as exc:  # noqa: BLE001
             if acct is not None:
                 if isinstance(exc, ConnectionResetError):

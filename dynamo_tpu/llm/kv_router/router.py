@@ -266,8 +266,16 @@ class KvPushRouter(AsyncEngine):
         from dynamo_tpu.llm.tokens import compute_block_hashes
 
         with span("router.decide", mode="kv") as sp:
-            block_hashes = compute_block_hashes(req.token_ids,
-                                                self.config.block_size)
+            # Adapter requests hash under the adapter's chain salt, the
+            # SAME chain the worker registers its adapter-conditioned KV
+            # under — so per-adapter prefix affinity is exact, while the
+            # candidate set / load / KV events all stay keyed on the
+            # BASE model (adapters are cheap to replicate: any base
+            # worker serves the name, hot-loading on first arrival).
+            from dynamo_tpu.llm.tokens import chain_salt
+            block_hashes = compute_block_hashes(
+                req.token_ids, self.config.block_size,
+                salt=chain_salt(getattr(req, "adapter", None)))
             request_blocks = max(1, len(block_hashes))
             radix = self.indexer.tree.find_matches(block_hashes)
             workers = self.client.instance_ids()

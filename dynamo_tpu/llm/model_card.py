@@ -160,6 +160,31 @@ async def register_llm(
     return entry
 
 
+async def register_adapter(
+    runtime,
+    endpoint,
+    adapter_name: str,
+    base_name: str,
+    tokenizer: Tokenizer,
+    runtime_config: ModelRuntimeConfig | None = None,
+    **kwargs,
+) -> ModelEntry:
+    """Register a LoRA adapter as a SERVED MODEL NAME: a full model card
+    under ``models/{adapter-slug}`` pointing at the BASE model's worker
+    endpoint, with ``runtime_config.extra`` carrying the adapter/base
+    binding. The frontend resolves the OpenAI ``model`` field to this
+    card like any other model; its preprocessor then stamps the wire
+    request with ``adapter=<name>`` so the worker forwards it through
+    the right LoRA slot (engine/lora.py). Adapters are cheap to
+    replicate — every worker of the base model can serve the name, so
+    the entry is per-instance exactly like base registrations."""
+    rc = runtime_config or ModelRuntimeConfig()
+    rc.extra = dict(rc.extra or {})
+    rc.extra.update({"lora_base": base_name, "adapter": adapter_name})
+    return await register_llm(runtime, endpoint, adapter_name, tokenizer,
+                              runtime_config=rc, **kwargs)
+
+
 #: Model-card keys this process still serves; deregister_llm removes a
 #: key so lease-recreated replays stop re-putting it.
 _active_cards: set = set()

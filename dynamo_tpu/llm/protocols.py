@@ -68,6 +68,12 @@ class PreprocessedRequest(BaseModel):
     sampling_options: SamplingOptions = Field(default_factory=SamplingOptions)
     eos_token_ids: list[int] = Field(default_factory=list)
     annotations: dict[str, Any] = Field(default_factory=dict)
+    # Multi-tenant LoRA (engine/lora.py): the adapter name this request
+    # forwards through, resolved by the frontend from the served model
+    # card (the OpenAI ``model`` field names an adapter slug whose card
+    # points at the base worker). None = base model. The worker maps it
+    # to a resident device slot at admission (hot-loading on miss).
+    adapter: str | None = None
     # Disaggregation: router-to-worker hints (reference kv_transfer_params).
     disagg_params: dict[str, Any] | None = None
     # Router-estimated prefix-cache overlap, for engine scheduling.

@@ -283,6 +283,10 @@ def make_account(route: str, model: str, ctx=None) -> dict:
         "ts": time.time(),
         "route": route,
         "model": model,
+        # LoRA adapter the model name resolved to (None = base model):
+        # scripts/slo_report.py --by adapter rolls up per-tenant-model
+        # TTFT/ITL/token volumes from this field.
+        "adapter": None,
         "request_id": getattr(ctx, "id", None),
         "trace_id": getattr(ctx, "trace_id", None),
         "tenant": None,
@@ -329,7 +333,7 @@ def finish_account(acct: dict, status: str, reason: str | None = None,
         values = getattr(ctx, "values", {})
         for key in ("worker_id", "migrations", "migration_reason",
                     "reuse_tokens", "kv_hit_ratio", "kv_tiers",
-                    "queue_wait_s"):
+                    "queue_wait_s", "adapter"):
             if values.get(key) is not None:
                 acct[key] = values[key]
     (ledger or get_ledger()).record(acct)

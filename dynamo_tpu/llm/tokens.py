@@ -29,13 +29,26 @@ def hash_block(parent_hash: int | None, token_ids: Sequence[int]) -> int:
     return h.intdigest()
 
 
-def compute_block_hashes(token_ids: Sequence[int], block_size: int
-                         ) -> list[int]:
+def chain_salt(name: str | None) -> int | None:
+    """Root-of-chain salt for content that conditions KV beyond the token
+    ids themselves — a LoRA adapter name: the same tokens forwarded
+    through adapter A produce different K/V than the base model, so
+    their block hashes must never alias (engine prefix cache, router
+    radix, KV events all chain from this root). None -> unsalted base
+    chain, byte-identical to the pre-adapter hash math."""
+    if not name:
+        return None
+    return xxhash.xxh3_64(name.encode(), seed=HASH_SEED).intdigest()
+
+
+def compute_block_hashes(token_ids: Sequence[int], block_size: int,
+                         salt: int | None = None) -> list[int]:
     """Hashes for all COMPLETE blocks of the sequence (partial tail block is
     excluded — it can't be cache-shared; reference
-    compute_block_hash_for_seq, indexer.rs:123)."""
+    compute_block_hash_for_seq, indexer.rs:123). ``salt`` (chain_salt)
+    roots the chain so adapter-conditioned KV never aliases base KV."""
     hashes: list[int] = []
-    parent: int | None = None
+    parent: int | None = salt
     for start in range(0, len(token_ids) - block_size + 1, block_size):
         parent = hash_block(parent, token_ids[start:start + block_size])
         hashes.append(parent)
@@ -46,10 +59,15 @@ class TokenBlockSequence:
     """A token sequence maintained as hashed complete blocks + a partial tail
     (reference TokenBlockSequence/PartialTokenBlock, lib/tokens lib.rs)."""
 
-    def __init__(self, block_size: int, token_ids: Iterable[int] = ()):
+    def __init__(self, block_size: int, token_ids: Iterable[int] = (),
+                 salt: int | None = None):
         self.block_size = block_size
         self.tokens: list[int] = []
         self.block_hashes: list[int] = []
+        # Root-of-chain salt (chain_salt): adapter-conditioned sequences
+        # hash into a disjoint chain so their KV pages never alias the
+        # base model's (or another adapter's) cache entries.
+        self.salt = salt
         self.extend(token_ids)
 
     def extend(self, token_ids: Iterable[int]) -> list[int]:
@@ -59,7 +77,7 @@ class TokenBlockSequence:
         while len(self.tokens) // self.block_size > len(self.block_hashes):
             idx = len(self.block_hashes)
             block = self.tokens[idx * self.block_size:(idx + 1) * self.block_size]
-            parent = self.block_hashes[-1] if self.block_hashes else None
+            parent = self.block_hashes[-1] if self.block_hashes else self.salt
             h = hash_block(parent, block)
             self.block_hashes.append(h)
             new.append(h)

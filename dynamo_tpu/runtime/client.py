@@ -390,8 +390,8 @@ class EndpointClient:
                         breakers.record_failure(iid)
                         raise StreamIncompleteError(reason=why or None)
                     from dynamo_tpu.runtime.errors import (
-                        InvalidRequestError, RateLimitedError,
-                        RoleTransitionError)
+                        AdapterNotFoundError, InvalidRequestError,
+                        RateLimitedError, RoleTransitionError)
                     # Wire-typed errors: decode every class that carries
                     # a WIRE_PREFIX so HTTP status / retry semantics
                     # survive remote deployment. One explicit branch per
@@ -406,6 +406,14 @@ class EndpointClient:
                         if payload.startswith(RateLimitedError.WIRE_PREFIX):
                             raise RateLimitedError(
                                 payload[len(RateLimitedError.WIRE_PREFIX):])
+                        if payload.startswith(
+                                AdapterNotFoundError.WIRE_PREFIX):
+                            # A naming error (the adapter slug resolved
+                            # to a worker without the adapter), not a
+                            # worker-health signal.
+                            raise AdapterNotFoundError(
+                                payload[len(
+                                    AdapterNotFoundError.WIRE_PREFIX):])
                         if payload.startswith(RoleTransitionError.WIRE_PREFIX):
                             # Control-verb fencing rejection: the caller's
                             # fault (stale epoch), not worker health.

@@ -96,3 +96,16 @@ class InvalidRequestError(EngineError):
     prefix so the class survives the request plane."""
 
     WIRE_PREFIX = "invalid_request: "
+
+
+class AdapterNotFoundError(EngineError):
+    """The request named a LoRA adapter this worker does not serve
+    (engine/lora.py AdapterStore registry miss). Maps to HTTP 404 at the
+    frontend — the OpenAI ``model`` field resolved to an adapter slug
+    whose base worker no longer (or never) holds the adapter, which is
+    a naming error, not a capacity condition. NOT retryable as-is: the
+    same name keeps missing until an operator registers the adapter.
+    Wire-prefixed so the 404 semantics survive the request plane."""
+
+    WIRE_PREFIX = "adapter_not_found: "
+    retryable = False

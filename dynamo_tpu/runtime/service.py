@@ -18,7 +18,8 @@ from typing import Any, AsyncIterator, Callable
 
 from dynamo_tpu.runtime.component import Endpoint, Instance
 from dynamo_tpu.runtime.context import Context
-from dynamo_tpu.runtime.errors import (InvalidRequestError, OverloadedError,
+from dynamo_tpu.runtime.errors import (AdapterNotFoundError,
+                                       InvalidRequestError, OverloadedError,
                                        RateLimitedError, RoleTransitionError)
 from dynamo_tpu.runtime.frame import read_frame, write_frame
 from dynamo_tpu.runtime.logging import get_logger
@@ -210,6 +211,16 @@ class EndpointServer:
                 except (ConnectionError, OSError):
                     pass
             raise
+        except AdapterNotFoundError as exc:
+            # Unknown LoRA adapter name (engine/lora.py): typed so a
+            # remote frontend answers 404, not 500. Must precede the
+            # generic engine-validation branch — it is an EngineError too.
+            self._m_errors.inc()
+            try:
+                await send({"t": "err", "rid": rid,
+                            "e": f"{AdapterNotFoundError.WIRE_PREFIX}{exc}"})
+            except (ConnectionError, OSError):
+                pass
         except (ValueError, InvalidRequestError) as exc:
             # Engine request validation (raised as ValueError by the
             # engine, or already typed by llm-layer code): type it on the

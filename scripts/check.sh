@@ -98,6 +98,18 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
     -k 'smoke or scaler or model or gate or parks or doctor' \
     -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
 
+echo "== lora smoke (batched multi-tenant adapters) =="
+# Tiny CPU engine with 2 registered adapters: heterogeneous-window
+# token parity vs sequential single-adapter runs (greedy + seeded),
+# adapter_id=0 bit-identity with the LoRA-free engine, repeated
+# MIXED-adapter windows with ZERO unexpected recompiles via the perf
+# plane, and the http e2e resolving two adapter names on one
+# mocker-backed base (typed 404s, per-adapter ledger rollup).
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_lora.py -q -m 'not slow' \
+    -k 'smoke or parity or bit_identical or http' \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+
 echo "== chunked-prefill smoke (stall-free scheduling) =="
 # Tiny CPU model: one long prompt prefilling in chunks with concurrent
 # short decoders — asserts completion, decode windows interleaved between

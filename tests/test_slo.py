@@ -682,6 +682,20 @@ def test_docs_drift_autoscale_series_are_documented():
             f"undocumented {family} series: {sorted(missing)}"
 
 
+def test_docs_drift_adapter_series_are_documented():
+    """Batched-LoRA acceptance: the dynamo_tpu_adapter_* family
+    (engine/lora.py AdapterStore -> AdapterMetricsUpdater) is
+    whole-family documented in docs/OBSERVABILITY.md "Adapters"."""
+    doc = (REPO / "docs" / "OBSERVABILITY.md").read_text()
+    documented = set(_DOC_NAME_RE.findall(doc))
+    registered = {n for n in _registered_metric_names()
+                  if n.startswith("adapter_")}
+    assert len(registered) >= 5, \
+        f"expected the adapter_ family, scan found {sorted(registered)}"
+    missing = registered - documented
+    assert not missing, f"undocumented adapter series: {sorted(missing)}"
+
+
 def test_docs_drift_kv_series_are_documented():
     """PR 8 acceptance: every dynamo_tpu_kv_* series registered in the
     source is documented in docs/OBSERVABILITY.md "KV & capacity" — the
