@@ -1,13 +1,23 @@
 """dtpu-lint: repo-native static analysis for async/JAX/wire hazards.
 
-v2 is interprocedural: a project-wide symbol table and call graph
-(``callgraph.py``) feed transitive facts — async-context, blocking-ness,
-hot-path reachability — to the rules, and findings carry the
-propagation chain (``engine._dispatch_window → runner.decode_window →
-np.asarray``).
+v2 made the analyzer interprocedural: a project-wide symbol table and
+call graph (``callgraph.py``) feed transitive facts — async-context,
+blocking-ness, hot-path reachability — to the rules, and findings carry
+the propagation chain (``engine._dispatch_window → runner.decode_window
+→ np.asarray``).
 
-Usage (CLI): ``python -m dynamo_tpu.analysis [paths] [--format json]
-[--budget deploy/lint-budget.json] [--callgraph MODULE] [--stats]``
+v3 adds *dataflow* (``dataflow.py``): a flow-sensitive abstract
+interpretation over a small lattice (traced / per-request / py-scalar /
+shape / const) with function summaries, powering the
+compile/purity rules (recompile-on-value, weak-type-promotion,
+traced-bool-coercion) plus a lockset analysis (lock-order-inversion).
+Everything still runs in ONE pass: parse once, one call graph, one
+dataflow, all 18 rules share them — and a content-hash run cache
+(``cache.py``) makes the warm path sub-second.
+
+Usage (CLI): ``python -m dynamo_tpu.analysis [paths] [--format
+text|json|sarif] [--budget deploy/lint-budget.json] [--callgraph
+MODULE] [--stats] [--no-cache] [--sarif-out FILE]``
 Usage (API)::
 
     from dynamo_tpu.analysis import analyze_paths
@@ -19,21 +29,27 @@ Rule catalog and suppression syntax: docs/ANALYSIS.md.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Iterable
 
+from dynamo_tpu.analysis import cache as _cache
 from dynamo_tpu.analysis.callgraph import CallGraph, build_callgraph
 from dynamo_tpu.analysis.core import (
-    CallGraphRule, Finding, Module, ProjectRule, Rule, analyze,
+    CallGraphRule, Finding, Module, ProjectRule, Rule, _today, analyze,
     count_suppressions, load_paths)
+from dynamo_tpu.analysis.dataflow import ensure_dataflow
 from dynamo_tpu.analysis.rules_async import (
     BlockingCallInAsync, FireAndForgetTask, LockAcrossAwait,
     SwallowedCancellation, UnboundedQueue, UnboundedWait)
+from dynamo_tpu.analysis.rules_dataflow import (
+    RecompileOnValue, TracedBoolCoercion, WeakTypePromotion)
 from dynamo_tpu.analysis.rules_hotpath import HostSyncInHotPath
 from dynamo_tpu.analysis.rules_jax import JitRecompileHazard, UnregisteredJit
 from dynamo_tpu.analysis.rules_journal import UntypedJournalEvent
 from dynamo_tpu.analysis.rules_metrics import DirectPrometheusImport
 from dynamo_tpu.analysis.rules_purity import ImpureJitProgram
-from dynamo_tpu.analysis.rules_threads import EngineThreadSharedState
+from dynamo_tpu.analysis.rules_threads import (
+    EngineThreadSharedState, LockOrderInversion)
 from dynamo_tpu.analysis.rules_wire import WireErrorTaxonomy
 
 __all__ = [
@@ -58,7 +74,14 @@ DEFAULT_RULES: tuple[type[Rule], ...] = (
     DirectPrometheusImport,
     UntypedJournalEvent,
     WireErrorTaxonomy,
+    RecompileOnValue,
+    WeakTypePromotion,
+    TracedBoolCoercion,
+    LockOrderInversion,
 )
+
+# Rules that consume the dataflow substrate (built once, shared).
+_DATAFLOW_RULES = (RecompileOnValue, WeakTypePromotion, TracedBoolCoercion)
 
 
 def default_rules(select: Iterable[str] | None = None) -> list[Rule]:
@@ -75,34 +98,100 @@ def default_rules(select: Iterable[str] | None = None) -> list[Rule]:
 
 @dataclasses.dataclass
 class AnalysisRun:
-    """One full pass: modules parsed once, the call graph built once,
-    every rule run over the shared structures."""
+    """One full pass: modules parsed once, the call graph and dataflow
+    built once, every rule run over the shared structures.
+
+    ``timings`` isolates analysis cost from I/O (the deflake contract
+    for the <10s budget test); ``cached`` marks a run replayed from the
+    content-hash cache, in which case the stored suppression counts and
+    stats stand in for the unloaded modules/graph."""
 
     modules: list[Module]
     failed: list[str]
     rules: list[Rule]
     graph: CallGraph | None
     findings: list[Finding]
+    timings: dict = dataclasses.field(default_factory=dict)
+    cached: bool = False
+    cached_suppressions: dict | None = None
+    cached_stats: dict | None = None
 
     def suppression_counts(self) -> dict[str, int]:
+        if self.cached_suppressions is not None:
+            return dict(self.cached_suppressions)
         return count_suppressions(self.modules,
                                   [r.rule_id for r in default_rules()])
 
+    def graph_stats(self) -> dict:
+        if self.graph is not None:
+            return self.graph.stats()
+        return dict(self.cached_stats or {})
 
-def run_analysis(paths: Iterable[str],
-                 select: Iterable[str] | None = None) -> AnalysisRun:
-    """The single-pass engine behind both the CLI and ``analyze_paths``:
-    parse each module once, build the call graph at most once, and share
-    both across all selected rules."""
+
+def _run_fresh(paths: Iterable[str],
+               select: Iterable[str] | None) -> AnalysisRun:
+    timings: dict = {}
+    t0 = time.perf_counter()
+    c0 = time.thread_time()
     modules, failed = load_paths(paths)
+    timings["parse_s"] = time.perf_counter() - t0
     rules = default_rules(select)
+    t1 = time.perf_counter()
     graph = (build_callgraph(modules)
              if any(isinstance(r, CallGraphRule) for r in rules) else None)
+    timings["graph_s"] = time.perf_counter() - t1
+    t2 = time.perf_counter()
+    if graph is not None and any(isinstance(r, _DATAFLOW_RULES)
+                                 for r in rules):
+        ensure_dataflow(graph)
+    timings["dataflow_s"] = time.perf_counter() - t2
+    t3 = time.perf_counter()
     findings = analyze(modules, rules, graph=graph)
     findings.extend(
         Finding(path, 1, 0, "parse-error", "file could not be parsed")
         for path in failed)
-    return AnalysisRun(modules, failed, rules, graph, findings)
+    timings["rules_s"] = time.perf_counter() - t3
+    timings["analysis_s"] = time.perf_counter() - t0
+    # this thread's CPU seconds: immune to being scheduled out on a
+    # saturated box AND to other threads' work — the perf-budget test
+    # judges this, not wall time
+    timings["analysis_cpu_s"] = time.thread_time() - c0
+    return AnalysisRun(modules, failed, rules, graph, findings,
+                       timings=timings)
+
+
+def run_analysis(paths: Iterable[str],
+                 select: Iterable[str] | None = None, *,
+                 cache_dir: str | None = None) -> AnalysisRun:
+    """The single-pass engine behind both the CLI and ``analyze_paths``:
+    parse each module once, build the call graph and dataflow at most
+    once, and share them across all selected rules.
+
+    ``cache_dir`` enables the content-hash run cache (the CLI passes
+    ``.dtpu-lint-cache``; the API default stays cache-off so library
+    callers and tests never touch the working tree)."""
+    if cache_dir is None:
+        return _run_fresh(paths, select)
+    files = _cache.expand_files(paths)
+    key = _cache.run_key(files, select, _today())
+    doc = _cache.load_run(cache_dir, key)
+    if doc is not None:
+        findings = [Finding(chain=tuple(f.pop("chain", ())), **f)
+                    for f in doc["findings"]]
+        return AnalysisRun(
+            [], doc["failed"], default_rules(select), None, findings,
+            timings=dict(doc.get("timings", {})), cached=True,
+            cached_suppressions=doc["suppressions"],
+            cached_stats=doc["stats"])
+    run = _run_fresh(paths, select)
+    _cache.store_run(cache_dir, key, {
+        "findings": [f.to_json() for f in run.findings],
+        "failed": run.failed,
+        "suppressions": run.suppression_counts(),
+        "stats": run.graph_stats(),
+        "timings": run.timings,
+    })
+    return run
 
 
 def analyze_paths(paths: Iterable[str],

@@ -10,6 +10,14 @@ Options beyond path selection:
   sorted by (path, line, col, rule), suppression counts, graph stats) —
   stable across runs so lint gates can diff them. ``--json`` stays as
   the legacy bare-findings-array alias.
+- ``--format sarif``: SARIF 2.1.0 (byte-stable, sorted like json) for
+  CI/code-review inline annotation; ``--sarif-out FILE`` writes the
+  SARIF artifact alongside any primary format (check.sh uses it to get
+  the human gate output AND the artifact from one pass).
+- ``--no-cache``: bypass the content-hash run cache. The CLI caches
+  under ``.dtpu-lint-cache/`` by default (warm unchanged-repo runs are
+  sub-second); the cache key covers file contents, the analyzer's own
+  sources, the rule selection, and today's date (suppression expiry).
 - ``--budget FILE``: the suppression ratchet. FILE maps rule id ->
   maximum allowed suppression directives; any rule over its
   budget fails the run. Ratchet down by lowering the number in the
@@ -29,6 +37,8 @@ import sys
 from pathlib import Path
 
 from dynamo_tpu.analysis import default_rules, run_analysis
+from dynamo_tpu.analysis.cache import DEFAULT_CACHE_DIR
+from dynamo_tpu.analysis.sarif import render_sarif
 
 SCHEMA_VERSION = 1
 
@@ -90,10 +100,18 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("paths", nargs="*",
                         help="files or directories (default: the "
                              "dynamo_tpu package)")
-    parser.add_argument("--format", choices=("text", "json"),
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
                         default="text", dest="fmt",
                         help="output format (json is versioned and "
-                             "schema-pinned for gate diffing)")
+                             "schema-pinned for gate diffing; sarif is "
+                             "SARIF 2.1.0 for CI annotation — both "
+                             "byte-stable)")
+    parser.add_argument("--sarif-out", metavar="FILE",
+                        help="also write the SARIF 2.1.0 artifact to "
+                             "FILE (any --format)")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="bypass the .dtpu-lint-cache content-hash "
+                             "run cache")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="legacy alias: emit findings as a bare JSON "
                              "array")
@@ -121,8 +139,11 @@ def main(argv: list[str] | None = None) -> int:
     select = ([s.strip() for s in args.select.split(",") if s.strip()]
               if args.select else None)
     paths = args.paths or [str(Path(__file__).resolve().parent.parent)]
+    # --callgraph needs the live graph, which a cache hit skips building
+    cache_dir = None if (args.no_cache or args.callgraph) \
+        else DEFAULT_CACHE_DIR
     try:
-        run = run_analysis(paths, select)
+        run = run_analysis(paths, select, cache_dir=cache_dir)
     except ValueError as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2
@@ -131,18 +152,27 @@ def main(argv: list[str] | None = None) -> int:
         return _dump_callgraph(run, args.callgraph)
 
     budget_errors = _check_budget(run, args.budget) if args.budget else []
-    stats = run.graph.stats() if run.graph is not None else {}
+    stats = run.graph_stats()
     stats["rules"] = len(run.rules)
     stats["findings"] = len(run.findings)
 
     if args.stats:
-        print("dtpu-lint: " + " ".join(f"{k}={v}"
-                                       for k, v in sorted(stats.items())),
-              file=sys.stderr)
+        # `cached` rides the stderr line only: the json/sarif documents
+        # must stay byte-identical between cold and warm runs
+        extra = {"cached": 1} if run.cached else {}
+        print("dtpu-lint: " + " ".join(
+            f"{k}={v}" for k, v in sorted({**stats, **extra}.items())),
+            file=sys.stderr)
 
     findings = run.findings
+    if args.sarif_out:
+        Path(args.sarif_out).write_text(
+            render_sarif(findings, default_rules()) + "\n",
+            encoding="utf-8")
     if args.as_json:
         print(json.dumps([f.to_json() for f in findings], indent=2))
+    elif args.fmt == "sarif":
+        print(render_sarif(findings, default_rules()))
     elif args.fmt == "json":
         doc = {
             "version": SCHEMA_VERSION,

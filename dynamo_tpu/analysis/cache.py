@@ -1,0 +1,106 @@
+"""Content-hash run cache for ``run_analysis``.
+
+The full-repo pass (parse → call graph → dataflow → 18 rules) costs a
+few seconds; the warm ``scripts/check.sh`` lint stage should cost
+milliseconds when nothing changed. This cache stores the *result* of a
+run (findings, suppression counts, stats, timings) keyed by:
+
+- the **engine hash** — sha256 over every ``dynamo_tpu/analysis/*.py``
+  source file, so editing any rule, the dataflow engine, or this cache
+  invalidates everything;
+- the **per-file content hashes** of every analyzed source file;
+- the selected rule ids;
+- **today's date** — suppression expiry (``until=YYYY-MM-DD``) makes
+  results date-dependent, so a cached clean run can't mask a
+  suppression that expired overnight.
+
+Whole-run granularity is deliberate: dataflow summaries and call-graph
+facts are interprocedural, so reusing one file's facts while a
+dependency changed would be unsound. The per-file hashes in the key
+give exact invalidation; any change recomputes everything (still <10s).
+
+Entries live under ``.dtpu-lint-cache/`` (gitignored); the newest
+few are kept, the rest pruned. ``--no-cache`` bypasses entirely; the
+API default is cache-off so tests and library callers never touch the
+working tree.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from pathlib import Path
+from typing import Iterable
+
+__all__ = ["engine_hash", "run_key", "expand_files", "load_run",
+           "store_run", "DEFAULT_CACHE_DIR"]
+
+DEFAULT_CACHE_DIR = ".dtpu-lint-cache"
+_KEEP = 8
+_engine_hash: str | None = None
+
+
+def engine_hash() -> str:
+    """sha256 over the analyzer's own sources — the engine version."""
+    global _engine_hash
+    if _engine_hash is None:
+        h = hashlib.sha256()
+        pkg = Path(__file__).resolve().parent
+        for f in sorted(pkg.glob("*.py")):
+            h.update(f.name.encode())
+            h.update(f.read_bytes())
+        _engine_hash = h.hexdigest()
+    return _engine_hash
+
+
+def expand_files(paths: Iterable[str | Path]) -> list[Path]:
+    """The same file expansion load_paths performs, for hashing."""
+    files: list[Path] = []
+    for raw in paths:
+        p = Path(raw)
+        files.extend(sorted(p.rglob("*.py")) if p.is_dir() else [p])
+    return files
+
+
+def run_key(files: list[Path], select, today: str) -> str:
+    h = hashlib.sha256()
+    h.update(engine_hash().encode())
+    h.update(today.encode())
+    h.update(repr(sorted(select) if select else None).encode())
+    for f in files:
+        h.update(str(f).encode())
+        try:
+            h.update(hashlib.sha256(f.read_bytes()).digest())
+        except OSError:
+            h.update(b"<unreadable>")
+    return h.hexdigest()
+
+
+def load_run(cache_dir: str | Path, key: str) -> dict | None:
+    path = Path(cache_dir) / f"run-{key[:32]}.json"
+    try:
+        doc = json.loads(path.read_text(encoding="utf-8"))
+    except (OSError, ValueError):
+        return None
+    if doc.get("key") != key:
+        return None
+    return doc
+
+
+def store_run(cache_dir: str | Path, key: str, doc: dict) -> None:
+    root = Path(cache_dir)
+    try:
+        root.mkdir(parents=True, exist_ok=True)
+        out = dict(doc)
+        out["key"] = key
+        path = root / f"run-{key[:32]}.json"
+        tmp = path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(out, sort_keys=True), encoding="utf-8")
+        tmp.replace(path)
+        entries = sorted(root.glob("run-*.json"),
+                         key=lambda p: p.stat().st_mtime, reverse=True)
+        for stale in entries[_KEEP:]:
+            stale.unlink(missing_ok=True)
+    except OSError:
+        # cache failures must never fail the lint run
+        return

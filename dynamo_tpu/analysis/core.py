@@ -18,13 +18,19 @@ Suppressions: ``# dtpu: ignore[rule-id]`` (comma-separate several ids, or
 omit the bracket to silence every rule) on the flagged line or on a
 comment line directly above it. Suppression comments should carry a
 rationale after the directive — the analyzer doesn't parse it, reviewers
-read it.
+read it. A directive may carry an expiry: ``# dtpu: ignore[rule-id]
+until=2027-01-01 -- rationale``. Past the date the directive stops
+suppressing AND becomes an ``expired-suppression`` finding — stale
+waivers can't accumulate silently. ``DTPU_LINT_TODAY=YYYY-MM-DD``
+overrides "today" (tests pin it; CI uses the real clock).
 """
 
 from __future__ import annotations
 
 import ast
 import dataclasses
+import datetime
+import os
 import re
 from pathlib import Path
 from typing import Iterable, Iterator
@@ -35,7 +41,18 @@ __all__ = [
     "count_suppressions",
 ]
 
-_SUPPRESS_RE = re.compile(r"#\s*dtpu:\s*ignore(?:\[([A-Za-z0-9_,\- ]*)\])?")
+_SUPPRESS_RE = re.compile(
+    r"#\s*dtpu:\s*ignore(?:\[([A-Za-z0-9_,\- ]*)\])?"
+    r"(?:\s+until=(\d{4}-\d{2}-\d{2}))?")
+
+
+def _today() -> str:
+    """ISO date used for suppression expiry (env-overridable so tests
+    and reproducible runs can pin it)."""
+    env = os.environ.get("DTPU_LINT_TODAY", "")
+    if re.fullmatch(r"\d{4}-\d{2}-\d{2}", env):
+        return env
+    return datetime.date.today().isoformat()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -84,20 +101,34 @@ class Module:
         for node in ast.walk(tree):
             for child in ast.iter_child_nodes(node):
                 child._dtpu_parent = node  # type: ignore[attr-defined]
+        # line -> date for ACTIVE directives that carry until= (the
+        # ratchet's "expiring" count); (line, date, ids) for directives
+        # whose date has passed — they no longer suppress and analyze()
+        # turns each into an expired-suppression finding.
+        self.suppression_until: dict[int, str] = {}
+        self.expired: list[tuple[int, str, set[str] | None]] = []
         self.suppressions = self._parse_suppressions()
 
     def _parse_suppressions(self) -> dict[int, set[str] | None]:
-        """line -> suppressed rule ids (None = all rules)."""
+        """line -> suppressed rule ids (None = all rules). Expired
+        directives (``until=`` in the past) are excluded — they land in
+        ``self.expired`` instead."""
         out: dict[int, set[str] | None] = {}
+        today = _today()
         for i, line in enumerate(self.lines, 1):
             m = _SUPPRESS_RE.search(line)
             if not m:
                 continue
             ids = m.group(1)
-            if ids is None or not ids.strip():
-                out[i] = None
-            else:
-                out[i] = {s.strip() for s in ids.split(",") if s.strip()}
+            parsed = None if ids is None or not ids.strip() \
+                else {s.strip() for s in ids.split(",") if s.strip()}
+            until = m.group(2)
+            if until is not None:
+                if until < today:
+                    self.expired.append((i, until, parsed))
+                    continue
+                self.suppression_until[i] = until
+            out[i] = parsed
         return out
 
     def is_suppressed(self, line: int, rule_id: str) -> bool:
@@ -254,6 +285,15 @@ def analyze(modules: list[Module], rules: list[Rule],
             if mod is not None and mod.is_suppressed(f.line, f.rule_id):
                 continue
             findings.append(f)
+    for m in modules:
+        for line, until, ids in m.expired:
+            what = "all rules" if ids is None else ", ".join(sorted(ids))
+            findings.append(Finding(
+                m.path, line, 0, "expired-suppression",
+                f"suppression for [{what}] expired on {until}: the "
+                "waived finding (if still present) is reported again",
+                "fix the underlying finding and delete the directive, "
+                "or re-review and extend until= with a fresh rationale"))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule_id))
     return findings
 
@@ -262,9 +302,13 @@ def count_suppressions(modules: list[Module],
                        rule_ids: Iterable[str]) -> dict[str, int]:
     """Active suppression-directive counts per rule id across the module
     set (the ratchet input). Bracketless ``ignore``-everything directives
-    count under ``"*"``; ids that name no known rule are ignored."""
+    count under ``"*"``; ids that name no known rule are ignored. The
+    ``"expiring"`` key counts active directives carrying an ``until=``
+    date — the budget pins it so expiry dates can't be silently
+    dropped."""
     known = set(rule_ids)
     counts: dict[str, int] = {}
+    expiring = 0
     for m in modules:
         for ids in m.suppressions.values():
             if ids is None:
@@ -272,4 +316,7 @@ def count_suppressions(modules: list[Module],
                 continue
             for rid in ids & known:
                 counts[rid] = counts.get(rid, 0) + 1
+        expiring += len(m.suppression_until)
+    if expiring:
+        counts["expiring"] = expiring
     return dict(sorted(counts.items()))

@@ -1,0 +1,682 @@
+"""Flow-sensitive dataflow for dtpu-lint v3: who is traced, who is
+per-request, who is a compile-time constant.
+
+The v2 call graph answers *reachability* questions (is this function on
+the hot path? does it transitively block?). The compile/purity hazards
+that gate the ROADMAP speed rounds are *value* questions: does a
+per-request Python value reach a jit cache key? is this ``if`` branching
+on a traced array? Those need an abstract interpretation, not a walk.
+
+**Lattice** (one abstract value per expression)::
+
+            TOP           (conflicting: traced on one path, per-request
+             |             on another — rules treat it as "don't know")
+      REQ         TRACED  (REQ: unbounded per-request Python data;
+       |            |      TRACED: a jax array / tracer)
+     SCALAR  ------+      (host Python scalar with a *bounded* image —
+       |                   bools, comparisons, bucketed values)
+     SHAPE                (derived from `.shape`/`len` of arrays: static
+       |                   at trace time, a legitimate compile key)
+     CONST                (literals, config attrs — one value per process)
+       |
+      BOT                 (unknown / not yet computed)
+
+``REQ ⊔ TRACED = TOP`` instead of collapsing either way: merging "this
+is per-request host data" with "this is device data" loses exactly the
+distinction the rules exist to check, so the merge is marked
+conflicting and the rules stay quiet on it (precision over recall).
+
+**Abstract values** carry the lattice base plus the set of *parameter
+indices* the value depends on — that pair is what makes function
+summaries compose: ``def f(a, b): return (a, b)`` summarizes as
+``ret = BOT{0,1}``, so a caller passing a REQ argument in position 0
+sees REQ flow through the call without re-analyzing ``f``. REQ values
+also carry a short ``src`` provenance chain (``request.seed → seed``)
+so findings can render the taint path.
+
+**Taint sources and sinks** (repo-tuned, documented in docs/ANALYSIS.md):
+
+- parameters named ``request``/``req`` and any attribute chain rooted at
+  them are REQ — one distinct value per request;
+- ``self.config.*`` / ``self.spec.*`` / ``self.cfg.*`` are CONST — read
+  once per process, safe in compile keys;
+- ``jnp.*``/``jax.*``/``lax.*`` calls (and methods on traced values)
+  produce TRACED — lifting REQ into a traced argument is the sanctioned
+  "pass it as data" fix, so the call *kills* REQ taint;
+- comparisons, ``bool()``, ``is``/``is not`` produce SCALAR: their image
+  is finite, so branching/keying on them compiles a bounded program
+  family (the bucketing idiom);
+- ``.shape``/``.ndim``/``len()`` of traced values produce SHAPE: static
+  at trace time, the legitimate shape-bucket compile key.
+
+**Function summaries** (``Summary``): the return value's base + param
+dependence, plus ``jit_key_params`` — which parameters flow into the
+``key=`` of an ``instrumented_jit`` call inside the body. Summaries are
+computed in two passes over the whole graph (pass 2 sees every summary
+pass 1 produced) — enough for the repo's builder→helper call shapes
+without a full interprocedural fixpoint.
+
+Built once per :func:`run_analysis` via :func:`ensure_dataflow` and
+shared by every dataflow rule through ``graph.dataflow`` — same
+one-parse/one-graph discipline as the call graph itself.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dynamo_tpu.analysis.core import qualified_name
+
+__all__ = [
+    "BOT", "CONST", "SHAPE", "SCALAR", "REQ", "TRACED", "TOP",
+    "AV", "BOT_AV", "FuncFacts", "Summary", "ProjectDataflow",
+    "base_name", "ensure_dataflow", "join_base",
+]
+
+BOT, CONST, SHAPE, SCALAR, REQ, TRACED, TOP = range(7)
+
+_BASE_NAMES = {BOT: "bot", CONST: "const", SHAPE: "shape",
+               SCALAR: "py-scalar", REQ: "per-request", TRACED: "traced",
+               TOP: "top"}
+
+# Total order for the host chain BOT < CONST < SHAPE < SCALAR < REQ;
+# TRACED sits beside it, TOP above everything.
+_HOST_ORDER = {BOT: 0, CONST: 1, SHAPE: 2, SCALAR: 3, REQ: 4}
+
+_REQ_PARAMS = {"request", "req"}
+_CONST_SELF_PREFIXES = ("self.config", "self.spec", "self.cfg")
+_TRACED_ROOTS = {"jnp", "jax", "lax"}
+_SHAPE_ATTRS = {"shape", "ndim", "size"}
+
+_MAX_SRC = 4  # provenance chain cap — findings stay readable
+
+
+def base_name(base: int) -> str:
+    return _BASE_NAMES.get(base, "?")
+
+
+def join_base(a: int, b: int) -> int:
+    if a == b:
+        return a
+    if a == BOT:
+        return b
+    if b == BOT:
+        return a
+    if TOP in (a, b):
+        return TOP
+    if TRACED in (a, b):
+        other = b if a == TRACED else a
+        return TOP if other == REQ else TRACED
+    return a if _HOST_ORDER[a] >= _HOST_ORDER[b] else b
+
+
+class AV:
+    """One abstract value: lattice base + parameter dependence + (for
+    REQ) the provenance chain that findings render."""
+
+    __slots__ = ("base", "params", "src")
+
+    def __init__(self, base: int = BOT, params: frozenset = frozenset(),
+                 src: tuple = ()):
+        self.base = base
+        self.params = params
+        self.src = src[:_MAX_SRC]
+
+    def join(self, other: "AV") -> "AV":
+        base = join_base(self.base, other.base)
+        params = self.params | other.params
+        # keep the provenance of whichever side carries the taint
+        if self.base == REQ and self.src:
+            src = self.src
+        elif other.base == REQ and other.src:
+            src = other.src
+        else:
+            src = self.src or other.src
+        return AV(base, params, src)
+
+    def with_src(self, label: str) -> "AV":
+        if self.src and self.src[-1] == label:
+            return self
+        return AV(self.base, self.params, (*self.src, label))
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        dep = f"{{{','.join(map(str, sorted(self.params)))}}}" \
+            if self.params else ""
+        return f"AV({base_name(self.base)}{dep})"
+
+
+BOT_AV = AV()
+
+
+def join_env(a: dict, b: dict) -> dict:
+    out = dict(a)
+    for k, v in b.items():
+        prev = out.get(k)
+        out[k] = v if prev is None else prev.join(v)
+    return out
+
+
+class Summary:
+    """What a caller needs to know without re-analyzing the body."""
+
+    __slots__ = ("ret", "param_names", "jit_key_params")
+
+    def __init__(self, ret: AV, param_names: list,
+                 jit_key_params: dict):
+        self.ret = ret
+        self.param_names = param_names
+        # param index -> (param name, line of the instrumented_jit site
+        # whose key= the param reaches)
+        self.jit_key_params = jit_key_params
+
+
+class FuncFacts:
+    """Per-function analysis result: every evaluated expression's AV
+    (by node identity), the points rules care about, and the summary."""
+
+    __slots__ = ("fn", "env", "values", "returns", "key_sites", "tests",
+                 "joined", "summary", "traced_count")
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.env: dict = {}
+        self.values: dict = {}          # id(node) -> AV
+        self.returns: AV = BOT_AV
+        self.key_sites: list = []       # (call node, key expr node, AV)
+        self.tests: list = []           # (node, AV, kind) boolean contexts
+        self.joined: list = []          # (JoinedStr/% node, AV) formats
+        self.summary: Summary | None = None
+        self.traced_count = 0           # nodes that evaluated TRACED
+
+    def value(self, node: ast.AST) -> AV:
+        return self.values.get(id(node), BOT_AV)
+
+
+class _Evaluator:
+    """Flow-sensitive walk of one function body.
+
+    Loops run twice (join with the pre-loop env after) so loop-carried
+    rebinding reaches a post-fixpoint for this lattice's tiny height;
+    branches analyze both arms and join.
+    """
+
+    def __init__(self, df: "ProjectDataflow", fn, facts: FuncFacts,
+                 params_av: dict, closure_env: dict | None = None,
+                 trace_nested: bool = False):
+        self.df = df
+        self.fn = fn
+        self.facts = facts
+        self.trace_nested = trace_nested
+        self.sites = {id(s.node): s for s in fn.calls}
+        env: dict = dict(closure_env or {})
+        env.update(params_av)
+        self.env = env
+
+    # -- statements -----------------------------------------------------------
+
+    def run(self, body: list) -> dict:
+        self.exec_block(body, self.env)
+        self.facts.env = self.env
+        return self.env
+
+    def exec_block(self, stmts: list, env: dict) -> None:
+        for stmt in stmts:
+            self.exec_stmt(stmt, env)
+
+    def exec_stmt(self, stmt: ast.stmt, env: dict) -> None:
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            av = self.eval(value, env) if value is not None else BOT_AV
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            if isinstance(stmt, ast.AugAssign):
+                old = env.get(getattr(stmt.target, "id", ""), BOT_AV)
+                av = old.join(av)
+            for t in targets:
+                self.bind(t, av, env)
+        elif isinstance(stmt, ast.Return):
+            av = self.eval(stmt.value, env) if stmt.value is not None \
+                else AV(CONST)
+            self.facts.returns = self.facts.returns.join(av)
+        elif isinstance(stmt, ast.Expr):
+            self.eval(stmt.value, env)
+        elif isinstance(stmt, ast.If):
+            tv = self.eval(stmt.test, env)
+            self.facts.tests.append((stmt.test, tv, "if"))
+            then_env = dict(env)
+            self.exec_block(stmt.body, then_env)
+            else_env = dict(env)
+            self.exec_block(stmt.orelse, else_env)
+            env.clear()
+            env.update(join_env(then_env, else_env))
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            iv = self.eval(stmt.iter, env)
+            elem = self.element_of(iv, stmt.iter)
+            pre = dict(env)
+            for _ in range(2):
+                self.bind(stmt.target, elem, env)
+                self.exec_block(stmt.body, env)
+            self.exec_block(stmt.orelse, env)
+            merged = join_env(pre, env)
+            env.clear()
+            env.update(merged)
+        elif isinstance(stmt, ast.While):
+            pre = dict(env)
+            for _ in range(2):
+                tv = self.eval(stmt.test, env)
+                if _ == 0:
+                    self.facts.tests.append((stmt.test, tv, "while"))
+                self.exec_block(stmt.body, env)
+            self.exec_block(stmt.orelse, env)
+            merged = join_env(pre, env)
+            env.clear()
+            env.update(merged)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                cv = self.eval(item.context_expr, env)
+                if item.optional_vars is not None:
+                    self.bind(item.optional_vars, cv, env)
+            self.exec_block(stmt.body, env)
+        elif isinstance(stmt, ast.Try):
+            self.exec_block(stmt.body, env)
+            for handler in stmt.handlers:
+                if handler.name:
+                    env[handler.name] = BOT_AV
+                self.exec_block(handler.body, env)
+            self.exec_block(stmt.orelse, env)
+            self.exec_block(stmt.finalbody, env)
+        elif isinstance(stmt, ast.Assert):
+            tv = self.eval(stmt.test, env)
+            self.facts.tests.append((stmt.test, tv, "assert"))
+            if stmt.msg is not None:
+                self.eval(stmt.msg, env)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            env[stmt.name] = AV(CONST)
+            if self.trace_nested:
+                # program-body mode: nested defs (scan `step` closures)
+                # are traced inline with traced params and this env as
+                # their closure.
+                nested = self.fn.nested.get(stmt.name) \
+                    if hasattr(self.fn, "nested") else None
+                if nested is not None:
+                    self.df._analyze_into(
+                        nested, self.facts, closure_env=dict(env),
+                        params_base=TRACED, trace_nested=True)
+        elif isinstance(stmt, ast.ClassDef):
+            env[stmt.name] = AV(CONST)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    env.pop(t.id, None)
+        elif isinstance(stmt, ast.Raise):
+            if stmt.exc is not None:
+                self.eval(stmt.exc, env)
+        elif isinstance(stmt, (ast.Import, ast.ImportFrom)):
+            for alias in stmt.names:
+                env[(alias.asname or alias.name).split(".")[0]] = AV(CONST)
+        elif isinstance(stmt, ast.Match):
+            self.eval(stmt.subject, env)
+            pre = dict(env)
+            merged: dict | None = None
+            for case in stmt.cases:
+                case_env = dict(pre)
+                self.exec_block(case.body, case_env)
+                merged = case_env if merged is None \
+                    else join_env(merged, case_env)
+            if merged is not None:
+                env.clear()
+                env.update(join_env(pre, merged))
+        # Pass/Break/Continue/Global/Nonlocal: no dataflow effect.
+
+    def bind(self, target: ast.AST, av: AV, env: dict) -> None:
+        if isinstance(target, ast.Name):
+            env[target.id] = av
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self.bind(el, av, env)
+        elif isinstance(target, ast.Starred):
+            self.bind(target.value, av, env)
+        # Attribute/Subscript stores: not tracked (self state is out of
+        # scope for an intraprocedural pass).
+
+    # -- expressions ----------------------------------------------------------
+
+    def eval(self, node: ast.expr, env: dict) -> AV:
+        av = self._eval(node, env)
+        self.facts.values[id(node)] = av
+        if av.base == TRACED:
+            self.facts.traced_count += 1
+        return av
+
+    def _eval(self, node: ast.expr, env: dict) -> AV:
+        if isinstance(node, ast.Constant):
+            return AV(CONST)
+        if isinstance(node, ast.Name):
+            return env.get(node.id, BOT_AV)
+        if isinstance(node, ast.Attribute):
+            return self._eval_attribute(node, env)
+        if isinstance(node, ast.Call):
+            return self._eval_call(node, env)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            av = AV(CONST) if node.elts else AV(CONST)
+            for el in node.elts:
+                av = av.join(self.eval(el, env))
+            return av
+        if isinstance(node, ast.Dict):
+            av = AV(CONST)
+            for k, v in zip(node.keys, node.values):
+                if k is not None:
+                    av = av.join(self.eval(k, env))
+                av = av.join(self.eval(v, env))
+            return av
+        if isinstance(node, ast.Starred):
+            return self.eval(node.value, env)
+        if isinstance(node, ast.BinOp):
+            left = self.eval(node.left, env)
+            right = self.eval(node.right, env)
+            if TRACED in (left.base, right.base):
+                return AV(TRACED, left.params | right.params)
+            return left.join(right)
+        if isinstance(node, ast.UnaryOp):
+            ov = self.eval(node.operand, env)
+            if isinstance(node.op, ast.Not):
+                self.facts.tests.append((node.operand, ov, "not"))
+                if ov.base == TRACED:
+                    return AV(TRACED, ov.params)
+                return AV(SCALAR, ov.params)
+            return ov
+        if isinstance(node, ast.Compare):
+            av = self.eval(node.left, env)
+            for comp in node.comparators:
+                av = av.join(self.eval(comp, env))
+            if all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in node.ops):
+                # identity tests (`x is None`) are Python-level and
+                # static at trace time even on traced operands — jit
+                # keys on pytree structure, so this is the sanctioned
+                # optional-argument idiom
+                return AV(SCALAR, av.params)
+            if av.base == TRACED:
+                # jnp comparisons yield arrays, not Python bools
+                return AV(TRACED, av.params)
+            # host comparisons have a bounded image: bucketing kills REQ
+            return AV(SCALAR, av.params)
+        if isinstance(node, ast.BoolOp):
+            av = BOT_AV
+            for v in node.values:
+                vv = self.eval(v, env)
+                self.facts.tests.append((v, vv, "boolop"))
+                av = av.join(vv)
+            return av
+        if isinstance(node, ast.IfExp):
+            tv = self.eval(node.test, env)
+            self.facts.tests.append((node.test, tv, "ifexp"))
+            return self.eval(node.body, env).join(
+                self.eval(node.orelse, env))
+        if isinstance(node, ast.Subscript):
+            base = self.eval(node.value, env)
+            idx = self.eval(node.slice, env)
+            return AV(base.base, base.params | idx.params, base.src)
+        if isinstance(node, ast.Slice):
+            av = BOT_AV
+            for part in (node.lower, node.upper, node.step):
+                if part is not None:
+                    av = av.join(self.eval(part, env))
+            return av
+        if isinstance(node, ast.JoinedStr):
+            av = AV(CONST)
+            for v in node.values:
+                if isinstance(v, ast.FormattedValue):
+                    av = av.join(self.eval(v.value, env))
+            if av.base == REQ:
+                self.facts.joined.append((node, av))
+            return av
+        if isinstance(node, ast.NamedExpr):
+            av = self.eval(node.value, env)
+            self.bind(node.target, av, env)
+            return av
+        if isinstance(node, ast.Lambda):
+            return AV(CONST)
+        if isinstance(node, ast.Await):
+            return self.eval(node.value, env)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            return self._eval_comp(node, env)
+        if isinstance(node, (ast.Yield, ast.YieldFrom)):
+            if node.value is not None:
+                self.eval(node.value, env)
+            return BOT_AV
+        if isinstance(node, ast.FormattedValue):
+            return self.eval(node.value, env)
+        return BOT_AV
+
+    def _eval_attribute(self, node: ast.Attribute, env: dict) -> AV:
+        qn = qualified_name(node)
+        if qn:
+            root = qn.split(".", 1)[0]
+            if root in _REQ_PARAMS and env.get(root, BOT_AV).base == REQ:
+                return AV(REQ, env[root].params, (qn,))
+            if qn.startswith(_CONST_SELF_PREFIXES):
+                return AV(CONST)
+        base = self.eval(node.value, env)
+        if node.attr in _SHAPE_ATTRS and base.base in (TRACED, BOT,
+                                                       CONST, SHAPE):
+            return AV(SHAPE, base.params)
+        if base.base == REQ:
+            return base.with_src(f".{node.attr}")
+        if base.base == TRACED:
+            return AV(TRACED, base.params)
+        return AV(base.base if base.base != SCALAR else BOT,
+                  base.params, base.src)
+
+    def _eval_comp(self, node, env: dict) -> AV:
+        child = dict(env)
+        for gen in node.generators:
+            iv = self.eval(gen.iter, child)
+            self.bind(gen.target, self.element_of(iv, gen.iter), child)
+            for cond in gen.ifs:
+                self.eval(cond, child)
+        if isinstance(node, ast.DictComp):
+            return self.eval(node.key, child).join(
+                self.eval(node.value, child))
+        return self.eval(node.elt, child)
+
+    def element_of(self, av: AV, iter_node: ast.expr) -> AV:
+        """Abstract value of one element when iterating ``av``."""
+        if isinstance(iter_node, ast.Call):
+            raw = qualified_name(iter_node.func)
+            if raw in ("range", "enumerate", "zip", "sorted", "reversed"):
+                out = BOT_AV
+                for a in iter_node.args:
+                    out = out.join(self.facts.value(a))
+                return out
+        if av.base == REQ:
+            return av.with_src("[…]")
+        return AV(av.base if av.base in (REQ, TRACED) else BOT,
+                  av.params, av.src)
+
+    def _eval_call(self, node: ast.Call, env: dict) -> AV:
+        args = [self.eval(a, env) for a in node.args]
+        kwargs = {kw.arg: self.eval(kw.value, env) for kw in node.keywords}
+        raw = qualified_name(node.func)
+        site = self.sites.get(id(node))
+
+        # instrumented_jit: record what reaches the cache key
+        if raw.endswith("instrumented_jit"):
+            for kw in node.keywords:
+                if kw.arg == "key":
+                    self.facts.key_sites.append(
+                        (node, kw.value, kwargs.get("key", BOT_AV)))
+
+        # project callee with a summary: apply it
+        if site is not None and site.callee is not None:
+            summ = self.df.summaries.get(site.callee.qname)
+            if summ is not None:
+                return self._apply_summary(summ, args, kwargs)
+
+        root = raw.split(".", 1)[0] if raw else ""
+        if root in _TRACED_ROOTS:
+            params = frozenset().union(
+                *(a.params for a in args),
+                *(a.params for a in kwargs.values())) \
+                if (args or kwargs) else frozenset()
+            return AV(TRACED, params)
+
+        joined = BOT_AV
+        for a in (*args, *kwargs.values()):
+            joined = joined.join(a)
+
+        if raw == "len":
+            a0 = args[0] if args else BOT_AV
+            if a0.base == REQ:
+                return AV(REQ, a0.params, (*a0.src, "len(…)"))
+            return AV(SHAPE, a0.params)
+        if raw in ("bool", "isinstance", "hasattr", "callable", "issubclass"):
+            return AV(SCALAR, joined.params)
+        if raw in ("int", "float", "str", "repr", "hash"):
+            if joined.base == REQ:
+                return joined.with_src(f"{raw}(…)")
+            if joined.base == TRACED:
+                return AV(SCALAR, joined.params)
+            return AV(joined.base, joined.params, joined.src)
+        if raw in ("min", "max", "abs", "round", "sum", "sorted", "tuple",
+                   "list", "set", "frozenset", "dict", "next", "getattr",
+                   "range", "enumerate", "zip", "reversed", "divmod"):
+            return joined
+
+        # method call on a tainted / traced receiver
+        if isinstance(node.func, ast.Attribute):
+            recv = self.facts.value(node.func.value) \
+                if id(node.func.value) in self.facts.values \
+                else self.eval(node.func.value, env)
+            if recv.base == TRACED:
+                return AV(TRACED, recv.params | joined.params)
+            if recv.base == REQ:
+                return recv.with_src(f".{node.func.attr}(…)")
+            if recv.base == CONST and raw.endswith(".format") \
+                    and joined.base == REQ:
+                self.facts.joined.append((node, joined))
+                return joined
+        return BOT_AV
+
+    def _apply_summary(self, summ: Summary, args: list,
+                       kwargs: dict) -> AV:
+        base = summ.ret.base
+        params: frozenset = frozenset()
+        src: tuple = summ.ret.src
+        for i in summ.ret.params:
+            av = None
+            if i < len(args):
+                av = args[i]
+            elif i < len(summ.param_names):
+                av = kwargs.get(summ.param_names[i])
+            if av is not None:
+                base = join_base(base, av.base)
+                params = params | av.params
+                if av.base == REQ and av.src:
+                    src = av.src
+        return AV(base, params, src)
+
+
+class ProjectDataflow:
+    """Facts + summaries for every function in the graph, plus on-demand
+    traced-body analyses for jitted program functions."""
+
+    def __init__(self, graph):
+        self.graph = graph
+        self.summaries: dict = {}
+        self.facts: dict = {}
+        self._body_cache: dict = {}
+        order = list(graph.functions.values())
+        # two passes: pass 2 sees every summary pass 1 produced, which
+        # covers the repo's builder -> helper -> jit call shapes
+        for _ in range(2):
+            for fn in order:
+                self._analyze_function(fn)
+
+    # -- generic per-function pass -------------------------------------------
+
+    def _param_names(self, fn) -> list:
+        a = fn.node.args
+        names = [p.arg for p in (*a.posonlyargs, *a.args)]
+        if names and names[0] in ("self", "cls") and fn.is_method:
+            names = names[1:]
+        return names
+
+    def _analyze_function(self, fn) -> FuncFacts:
+        facts = FuncFacts(fn)
+        names = self._param_names(fn)
+        params_av = {}
+        for i, name in enumerate(names):
+            if name in _REQ_PARAMS:
+                params_av[name] = AV(REQ, frozenset({i}), (name,))
+            else:
+                params_av[name] = AV(BOT, frozenset({i}))
+        a = fn.node.args
+        for extra in (a.vararg, a.kwarg, *a.kwonlyargs):
+            if extra is not None:
+                pname = extra.arg
+                if pname not in params_av:
+                    params_av[pname] = AV(REQ, frozenset(), (pname,)) \
+                        if pname in _REQ_PARAMS else BOT_AV
+        if fn.is_method:
+            params_av.setdefault("self", BOT_AV)
+        ev = _Evaluator(self, fn, facts, params_av)
+        ev.run(fn.node.body)
+        key_params: dict = {}
+        for _node, _expr, av in facts.key_sites:
+            for p in av.params:
+                if p < len(names):
+                    key_params.setdefault(p, (names[p], _node.lineno))
+        facts.summary = Summary(
+            AV(facts.returns.base, facts.returns.params,
+               facts.returns.src),
+            names, key_params)
+        self.facts[fn.qname] = facts
+        self.summaries[fn.qname] = facts.summary
+        return facts
+
+    # -- traced program bodies ------------------------------------------------
+
+    def _analyze_into(self, fn, facts: FuncFacts, closure_env: dict,
+                      params_base: int, trace_nested: bool) -> None:
+        """Analyze ``fn`` merging results into an existing ``facts``
+        (used for nested scan-step closures traced inline)."""
+        params_av = {}
+        for i, name in enumerate(self._param_names(fn)):
+            params_av[name] = AV(params_base, frozenset({i}))
+        ev = _Evaluator(self, fn, facts, params_av,
+                        closure_env=closure_env, trace_nested=trace_nested)
+        ev.exec_block(fn.node.body, ev.env)
+
+    def body_facts(self, body_fn, builder_fn) -> FuncFacts:
+        """Facts for a jitted program body analyzed *as traced code*:
+        parameters are TRACED, free variables resolve through the
+        builder's final environment (its closure)."""
+        cache_key = (body_fn.qname, builder_fn.qname)
+        hit = self._body_cache.get(cache_key)
+        if hit is not None:
+            return hit
+        builder_facts = self.facts.get(builder_fn.qname)
+        closure = dict(builder_facts.env) if builder_facts is not None \
+            else {}
+        facts = FuncFacts(body_fn)
+        params_av = {name: AV(TRACED, frozenset({i}))
+                     for i, name in enumerate(self._param_names(body_fn))}
+        ev = _Evaluator(self, body_fn, facts, params_av,
+                        closure_env=closure, trace_nested=True)
+        ev.run(body_fn.node.body)
+        facts.summary = Summary(facts.returns, self._param_names(body_fn),
+                                {})
+        self._body_cache[cache_key] = facts
+        return facts
+
+
+def ensure_dataflow(graph) -> ProjectDataflow:
+    """Build (once) and cache the project dataflow on the shared call
+    graph — every dataflow rule in a run sees the same instance."""
+    df = getattr(graph, "dataflow", None)
+    if df is None:
+        df = ProjectDataflow(graph)
+        graph.dataflow = df
+    return df

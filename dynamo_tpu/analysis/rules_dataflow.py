@@ -1,0 +1,335 @@
+"""Dataflow-backed compile/purity rules: the static twins of the perf
+plane's runtime detectors.
+
+Three rules share the :mod:`dynamo_tpu.analysis.dataflow` substrate
+(built once per run via ``ensure_dataflow``):
+
+- ``recompile-on-value``: per-request Python data reaching a jit cache
+  key or a trace-time position (Python ``if``/format/shape argument)
+  inside an ``instrumented_jit`` program body. One compile per distinct
+  value — the static twin of ``perf_unexpected_recompiles_total``, and
+  the class both PR 9 runtime catches (the uncommitted rng key, the
+  per-request penalized window variants) belong to.
+- ``weak-type-promotion``: strongly-typed host scalars
+  (``np.float32(...)``, dtype-less ``jnp.array`` over Python floats)
+  mixed into arithmetic with traced values inside program bodies —
+  silently upcasting bf16/int8 paths to f32.
+- ``traced-bool-coercion``: ``if``/``while``/``assert``/``and``/``or``/
+  ``not`` over traced values inside program bodies —
+  ConcretizationTypeError at best, an implicit device→host sync at
+  worst (extends host-sync-in-hot-path from explicit transfer calls to
+  implicit coercions).
+
+Program bodies are resolved exactly like impure-jit-program resolves
+them: the function argument of every ``perf.instrumented_jit(program,
+fn, ...)`` call site, looked up through nested scopes then module
+functions. Bodies are analyzed *as traced code* (parameters TRACED,
+free variables through the builder's environment), nested ``step``
+closures included — so builder-time Python branching on config/bucket
+booleans stays legal while trace-time branching on traced or
+per-request values flags.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from dynamo_tpu.analysis.core import CallGraphRule, Finding, qualified_name
+from dynamo_tpu.analysis.dataflow import REQ, TRACED, ensure_dataflow
+
+_NP_ROOTS = {"np", "numpy"}
+_NP_SCALAR_CTORS = {"float16", "float32", "float64", "int8", "int16",
+                    "int32", "int64", "uint8", "uint16", "uint32",
+                    "uint64", "bfloat16"}
+_JNP_SHAPE_FNS = {"zeros", "ones", "full", "empty", "arange", "iota",
+                  "reshape", "broadcast_to", "tile"}
+_TEST_KINDS = {"if": "a Python `if`", "while": "a Python `while`",
+               "assert": "an `assert`", "boolop": "an `and`/`or`",
+               "not": "a `not`", "ifexp": "a conditional expression"}
+
+
+def _resolve_program(graph, caller, name: str):
+    """The function argument of an instrumented_jit site: a nested def
+    in the calling function (the repo idiom), an enclosing function's
+    nested def, or a module-level function of the same module."""
+    scope = caller
+    while scope is not None:
+        if name in scope.nested:
+            return scope.nested[name]
+        scope = scope.parent
+    for mi in graph.modules:
+        if mi.module is caller.module:
+            return mi.functions.get(name)
+    return None
+
+
+def _program_sites(graph):
+    """Yield (builder_fn, call_site, body_fn) for every resolvable
+    ``instrumented_jit(program, fn, ...)`` call in the project."""
+    for caller in graph.functions.values():
+        for site in caller.calls:
+            if not site.raw.endswith("instrumented_jit") \
+                    or len(site.node.args) < 2:
+                continue
+            arg = site.node.args[1]
+            if not isinstance(arg, ast.Name):
+                continue
+            body = _resolve_program(graph, caller, arg.id)
+            if body is not None:
+                yield caller, site, body
+
+
+def _label(node: ast.AST, limit: int = 48) -> str:
+    try:
+        text = ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse covers all exprs
+        text = qualified_name(node) or type(node).__name__
+    return text if len(text) <= limit else text[: limit - 1] + "…"
+
+
+class RecompileOnValue(CallGraphRule):
+    rule_id = "recompile-on-value"
+    description = ("per-request data flows into a jit cache key or a "
+                   "trace-time position (Python if/format/shape arg) of "
+                   "an instrumented_jit program: one compile per distinct "
+                   "value — the static twin of "
+                   "perf_unexpected_recompiles_total")
+
+    _HINT_KEY = ("bucket the value before keying (compare/round to a "
+                 "bounded set) or pass it into the program as traced "
+                 "data instead of baking it into the compile key")
+    _HINT_BODY = ("pass the value into the program as data (an argument "
+                  "the tracer sees) or hoist the branch to the builder "
+                  "over a bounded bucket")
+
+    def check_graph(self, graph) -> Iterable[Finding]:
+        df = ensure_dataflow(graph)
+        seen: set = set()
+
+        def emit(module, node, message, chain):
+            key = (module.path, node.lineno, node.col_offset)
+            if key in seen:
+                return None
+            seen.add(key)
+            return Finding(module.path, node.lineno, node.col_offset,
+                           self.rule_id, message,
+                           self._HINT_BODY if "trace-time" in message
+                           else self._HINT_KEY, chain=tuple(chain))
+
+        for fn in graph.functions.values():
+            facts = df.facts.get(fn.qname)
+            if facts is None:
+                continue
+            # (a) per-request value directly in a key= at this site
+            for call_node, key_expr, av in facts.key_sites:
+                if av.base != REQ:
+                    continue
+                f = emit(fn.module, key_expr,
+                         f"per-request value `{' → '.join(av.src)}` is "
+                         "part of this jit cache key: every distinct "
+                         "value compiles a new program",
+                         (fn.display, *av.src, "instrumented_jit(key=…)"))
+                if f:
+                    yield f
+            # (b) per-request actual passed to a param that a callee
+            #     summary says reaches a jit key
+            for site in fn.calls:
+                callee = site.callee
+                if callee is None:
+                    continue
+                summ = df.summaries.get(callee.qname)
+                if summ is None or not summ.jit_key_params:
+                    continue
+                for p, (pname, _line) in sorted(summ.jit_key_params.items()):
+                    arg_node = None
+                    if p < len(site.node.args):
+                        arg_node = site.node.args[p]
+                    else:
+                        for kw in site.node.keywords:
+                            if kw.arg == pname:
+                                arg_node = kw.value
+                    if arg_node is None:
+                        continue
+                    av = facts.value(arg_node)
+                    if av.base != REQ:
+                        continue
+                    f = emit(fn.module, arg_node,
+                             f"per-request value `{' → '.join(av.src)}` "
+                             f"flows into the jit cache key of "
+                             f"`{callee.display}` (param `{pname}`): "
+                             "every distinct value compiles a new program",
+                             (fn.display, *av.src,
+                              f"{callee.display}({pname}=…)",
+                              "instrumented_jit(key=…)"))
+                    if f:
+                        yield f
+
+        # (c) per-request closure values at trace-time positions inside
+        #     program bodies: Python branches, string formatting, shape
+        #     arguments
+        for builder, _site, body in _program_sites(graph):
+            bf = df.body_facts(body, builder)
+            for node, av, kind in bf.tests:
+                if av.base != REQ:
+                    continue
+                f = emit(body.module, node,
+                         f"per-request value `{' → '.join(av.src)}` in "
+                         f"{_TEST_KINDS.get(kind, 'a branch')} at "
+                         "trace-time inside a jitted program: program "
+                         "identity depends on the value",
+                         (builder.display, body.display, *av.src,
+                          f"{kind} {_label(node)}"))
+                if f:
+                    yield f
+            for node, av in bf.joined:
+                f = emit(body.module, node,
+                         f"per-request value `{' → '.join(av.src)}` "
+                         "formatted at trace-time inside a jitted "
+                         "program: the string is baked per-value",
+                         (builder.display, body.display, *av.src,
+                          _label(node)))
+                if f:
+                    yield f
+            for node in ast.walk(body.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                raw = qualified_name(node.func)
+                root, _, rest = raw.partition(".")
+                if root not in ("jnp", "jax", "lax") \
+                        or raw.rsplit(".", 1)[-1] not in _JNP_SHAPE_FNS:
+                    continue
+                for arg in (*node.args,
+                            *(kw.value for kw in node.keywords
+                              if kw.arg in ("shape", "newshape"))):
+                    av = bf.value(arg)
+                    if av.base != REQ:
+                        continue
+                    f = emit(body.module, arg,
+                             f"per-request value "
+                             f"`{' → '.join(av.src)}` used as a shape "
+                             f"argument of `{raw}` inside a jitted "
+                             "program: one compile per distinct shape",
+                             (builder.display, body.display, *av.src,
+                              f"{raw}(shape)"))
+                    if f:
+                        yield f
+
+
+class WeakTypePromotion(CallGraphRule):
+    rule_id = "weak-type-promotion"
+    description = ("strongly-typed host scalar (np.float32(...), "
+                   "dtype-less jnp.array over Python floats) mixed into "
+                   "arithmetic with traced values inside a jitted "
+                   "program: silently upcasts bf16/int8 paths to f32")
+
+    _HINT = ("use a bare Python literal (weakly typed — preserves the "
+             "array's dtype) or give the array an explicit "
+             "dtype=x.dtype")
+
+    def check_graph(self, graph) -> Iterable[Finding]:
+        df = ensure_dataflow(graph)
+        seen: set = set()
+        for builder, _site, body in _program_sites(graph):
+            bf = df.body_facts(body, builder)
+            module = body.module
+            for node in ast.walk(body.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                raw = qualified_name(node.func)
+                label = None
+                if self._np_scalar(raw):
+                    label = f"{raw}(…) is a strongly-typed host scalar"
+                elif self._dtypeless_float_array(node, raw):
+                    label = (f"dtype-less `{raw}` over Python floats "
+                             "defaults to strong float32")
+                if label is None:
+                    continue
+                if not self._mixes_with_traced(module, node, bf):
+                    continue
+                key = (module.path, node.lineno, node.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield Finding(
+                    module.path, node.lineno, node.col_offset,
+                    self.rule_id,
+                    f"{label}: mixing it into traced arithmetic "
+                    "promotes the bf16/int8 operand to f32",
+                    self._HINT,
+                    chain=(builder.display, body.display, _label(node)))
+
+    @staticmethod
+    def _np_scalar(raw: str) -> bool:
+        root, _, leaf = raw.rpartition(".")
+        return root in _NP_ROOTS and leaf in _NP_SCALAR_CTORS
+
+    @staticmethod
+    def _dtypeless_float_array(node: ast.Call, raw: str) -> bool:
+        root, _, leaf = raw.rpartition(".")
+        if root != "jnp" or leaf not in ("array", "asarray"):
+            return False
+        if any(kw.arg == "dtype" for kw in node.keywords) \
+                or len(node.args) != 1:  # 2nd positional arg is dtype
+            return False
+        return _has_float_literal(node.args[0])
+
+    @staticmethod
+    def _mixes_with_traced(module, node: ast.Call, bf) -> bool:
+        """The scalar participates in arithmetic with a traced operand,
+        or is passed straight into a jnp/jax call beside traced args."""
+        parent = module.parent(node)
+        if isinstance(parent, ast.BinOp):
+            other = parent.right if parent.left is node else parent.left
+            return bf.value(other).base == TRACED
+        if isinstance(parent, ast.Compare):
+            for other in (parent.left, *parent.comparators):
+                if other is not node and bf.value(other).base == TRACED:
+                    return True
+            return False
+        if isinstance(parent, ast.Call):
+            raw = qualified_name(parent.func)
+            if raw.split(".", 1)[0] in ("jnp", "jax", "lax"):
+                return any(bf.value(a).base == TRACED
+                           for a in parent.args if a is not node)
+        return False
+
+
+def _has_float_literal(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, float):
+            return True
+    return False
+
+
+class TracedBoolCoercion(CallGraphRule):
+    rule_id = "traced-bool-coercion"
+    description = ("if/while/assert/and/or/not over a traced value "
+                   "inside a jitted program: ConcretizationTypeError at "
+                   "best, an implicit device→host sync at worst")
+
+    _HINT = ("use jnp.where / lax.select for value choice, lax.cond / "
+             "lax.while_loop for control flow, or hoist the predicate "
+             "to the builder if it is static")
+
+    def check_graph(self, graph) -> Iterable[Finding]:
+        df = ensure_dataflow(graph)
+        seen: set = set()
+        for builder, _site, body in _program_sites(graph):
+            bf = df.body_facts(body, builder)
+            for node, av, kind in bf.tests:
+                if av.base != TRACED:
+                    continue
+                key = (body.module.path, node.lineno, node.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield Finding(
+                    body.module.path, node.lineno, node.col_offset,
+                    self.rule_id,
+                    f"traced value `{_label(node)}` is coerced to a "
+                    f"Python bool by {_TEST_KINDS.get(kind, kind)} "
+                    "inside a jitted program",
+                    self._HINT,
+                    chain=(builder.display, body.display,
+                           f"{kind} {_label(node)}"))

@@ -26,6 +26,14 @@ Scope is deliberately narrow to stay honest:
 A finding names the attribute and one write site from each side. Fix:
 guard both sides with one lock, or funnel the write through a
 single-owner side (e.g. the engine thread publishes, async only reads).
+
+``lock-order-inversion`` (v3) extends the same lock heuristics with a
+lockset analysis over the call graph: for every ``with <lock>:`` block,
+the locks acquired inside it — directly nested, or transitively through
+resolved project callees — define an acquisition order edge. Any pair
+of locks witnessed in BOTH orders is a deadlock window between the
+engine thread and the event loop (or any two threads), and the finding
+renders both witness chains.
 """
 
 from __future__ import annotations
@@ -178,3 +186,159 @@ class EngineThreadSharedState(CallGraphRule):
                         and isinstance(t.value, ast.Name) \
                         and t.value.id == "self":
                     yield node, t.attr
+
+
+def _lock_identity(fn, expr: ast.expr) -> str:
+    """Stable cross-function identity for a lock expression.
+
+    ``self.<attr>`` resolves through the owning class
+    (``Engine._queue_stats_lock``); ``self.<attr>.<leaf>`` resolves the
+    middle attribute's inferred class (``KvAllocator._lock``); plain
+    names stay as written (module-level locks). Call expressions
+    (``self._lock_for(k)``) keep their dotted text plus ``()`` so keyed
+    lock factories compare by factory, not by instance."""
+    suffix = ""
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+        suffix = "()"
+    qn = qualified_name(expr)
+    if not qn:
+        return ""
+    parts = qn.split(".")
+    if parts[0] in ("self", "cls") and fn.cls is not None:
+        if len(parts) >= 3:
+            attr_cls = fn.cls.attr_types.get(parts[1])
+            if attr_cls is not None:
+                return f"{attr_cls.name}.{'.'.join(parts[2:])}{suffix}"
+        return f"{fn.cls.name}.{'.'.join(parts[1:])}{suffix}"
+    return qn + suffix
+
+
+class LockOrderInversion(CallGraphRule):
+    rule_id = "lock-order-inversion"
+    description = ("two locks are acquired in both orders across the "
+                   "project (directly nested `with` blocks or "
+                   "transitively through callees): a deadlock window "
+                   "between the engine thread and the event loop")
+
+    _MAX_PATH = 4
+
+    def check_graph(self, graph) -> Iterable[Finding]:
+        own = self._own_acquires(graph)
+        trans = self._transitive_acquires(graph, own)
+        orders = self._order_edges(graph, own, trans)
+        for a, b in sorted(orders):
+            if a >= b or (b, a) not in orders:
+                continue
+            module, line, col, chain_ab = orders[(a, b)]
+            _m2, _l2, _c2, chain_ba = orders[(b, a)]
+            yield Finding(
+                module.path, line, col, self.rule_id,
+                f"locks `{a}` and `{b}` are acquired in both orders: "
+                "two threads taking them concurrently can deadlock",
+                "pick one global acquisition order (document it where "
+                "the locks are defined), or copy the data out under the "
+                "first lock and take the second one afterwards",
+                chain=(*chain_ab, "⇄", *chain_ba))
+
+    @classmethod
+    def _own_acquires(cls, graph) -> dict:
+        """qname -> {lock_id: (line, path)} acquired in the function's
+        own scope."""
+        out: dict = {}
+        for fn in graph.functions.values():
+            locks: dict = {}
+            for node in iter_scope(fn.node.body):
+                if not isinstance(node, (ast.With, ast.AsyncWith)):
+                    continue
+                for item in node.items:
+                    if not _looks_like_lock(item.context_expr):
+                        continue
+                    lock = _lock_identity(fn, item.context_expr)
+                    if lock:
+                        locks.setdefault(
+                            lock,
+                            (node.lineno,
+                             (f"{fn.display}:{node.lineno}",)))
+            out[fn.qname] = locks
+        return out
+
+    @classmethod
+    def _transitive_acquires(cls, graph, own: dict) -> dict:
+        """qname -> {lock_id: (line, path)}: locks acquired by the
+        function or anything it (transitively) calls."""
+        trans = {q: dict(locks) for q, locks in own.items()}
+        changed = True
+        passes = 0
+        while changed and passes < 20:
+            changed = False
+            passes += 1
+            for fn in graph.functions.values():
+                mine = trans[fn.qname]
+                for site in fn.calls:
+                    callee = site.callee
+                    if callee is None or callee.qname == fn.qname:
+                        continue
+                    for lock, (_line, path) in trans[callee.qname].items():
+                        if lock in mine:
+                            continue
+                        mine[lock] = (
+                            site.line,
+                            (f"{fn.display}:{site.line}",
+                             *path)[: cls._MAX_PATH])
+                        changed = True
+        return trans
+
+    @classmethod
+    def _order_edges(cls, graph, own: dict, trans: dict) -> dict:
+        """(held, acquired) -> (module, line, col, witness chain) for
+        every acquisition-order edge witnessed in the project."""
+        orders: dict = {}
+
+        def record(pair, module, line, col, chain):
+            orders.setdefault(pair, (module, line, col, tuple(chain)))
+
+        for fn in graph.functions.values():
+            sites = {id(s.node): s for s in fn.calls}
+            for node in iter_scope(fn.node.body):
+                if not isinstance(node, (ast.With, ast.AsyncWith)):
+                    continue
+                held = []
+                for item in node.items:
+                    if not _looks_like_lock(item.context_expr):
+                        continue
+                    lock = _lock_identity(fn, item.context_expr)
+                    if not lock:
+                        continue
+                    for prev in held:
+                        record((prev, lock), fn.module, node.lineno,
+                               node.col_offset,
+                               (f"{fn.display}:{node.lineno} holds "
+                                f"`{prev}`", f"acquires `{lock}`"))
+                    held.append(lock)
+                if not held:
+                    continue
+                for sub in iter_scope(node.body):
+                    inner: dict = {}
+                    if isinstance(sub, (ast.With, ast.AsyncWith)):
+                        for item in sub.items:
+                            if _looks_like_lock(item.context_expr):
+                                lk = _lock_identity(fn, item.context_expr)
+                                if lk:
+                                    inner[lk] = (
+                                        sub.lineno,
+                                        (f"{fn.display}:{sub.lineno}",))
+                    elif isinstance(sub, ast.Call) and id(sub) in sites:
+                        callee = sites[id(sub)].callee
+                        if callee is not None:
+                            inner = trans.get(callee.qname, {})
+                    for lock, (_line, path) in inner.items():
+                        for prev in held:
+                            if lock == prev:
+                                continue
+                            record((prev, lock), fn.module, sub.lineno,
+                                   getattr(sub, "col_offset", 0),
+                                   (f"{fn.display}:{node.lineno} holds "
+                                    f"`{prev}`", *path,
+                                    f"acquires `{lock}`"))
+        return orders

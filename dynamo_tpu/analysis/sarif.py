@@ -1,0 +1,90 @@
+"""SARIF 2.1.0 output for dtpu-lint findings.
+
+SARIF (Static Analysis Results Interchange Format, OASIS) is what CI
+and code-review surfaces ingest to annotate findings inline on diffs.
+This emitter produces a minimal, schema-valid document: one run, the
+tool driver with the full rule catalog (descriptions included), one
+``result`` per finding with a physical location and the propagation
+chain under ``properties.chain``.
+
+Byte-stability contract (same as ``--format json``): findings are
+already sorted by (path, line, col, rule), rule descriptors are sorted
+by id, and the document is serialized with ``sort_keys`` — two runs
+over the same tree produce byte-identical output, so gates can diff
+artifacts directly.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable
+
+__all__ = ["SARIF_VERSION", "to_sarif", "render_sarif"]
+
+SARIF_VERSION = "2.1.0"
+_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+           "master/Schemata/sarif-schema-2.1.0.json")
+
+# Engine-level diagnostics that are not Rule classes but can appear in
+# the findings stream; they need descriptors too.
+_SYNTHETIC_RULES = {
+    "parse-error": "file could not be parsed",
+    "expired-suppression": ("a suppression directive passed its "
+                            "until=YYYY-MM-DD expiry date"),
+}
+
+
+def to_sarif(findings: Iterable, rules: Iterable) -> dict:
+    catalog = {r.rule_id: r.description for r in rules}
+    catalog.update(_SYNTHETIC_RULES)
+    findings = list(findings)
+    for f in findings:  # never emit a result without a descriptor
+        catalog.setdefault(f.rule_id, "")
+    rule_ids = sorted(catalog)
+    index = {rid: i for i, rid in enumerate(rule_ids)}
+    results = []
+    for f in findings:
+        message = f.message
+        if f.hint:
+            message += f" — hint: {f.hint}"
+        result = {
+            "ruleId": f.rule_id,
+            "ruleIndex": index[f.rule_id],
+            "level": "error",
+            "message": {"text": message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path.replace("\\", "/"),
+                    },
+                    "region": {
+                        "startLine": max(f.line, 1),
+                        "startColumn": f.col + 1,
+                    },
+                },
+            }],
+        }
+        if f.chain:
+            result["properties"] = {"chain": list(f.chain)}
+        results.append(result)
+    return {
+        "$schema": _SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "dtpu-lint",
+                    "rules": [{
+                        "id": rid,
+                        "shortDescription": {"text": catalog[rid] or rid},
+                    } for rid in rule_ids],
+                },
+            },
+            "columnKind": "unicodeCodePoints",
+            "results": results,
+        }],
+    }
+
+
+def render_sarif(findings: Iterable, rules: Iterable) -> str:
+    return json.dumps(to_sarif(findings, rules), indent=2, sort_keys=True)

@@ -368,7 +368,7 @@ def instrumented_jit(program: str, fun, *, key=None, registry=None,
     recompile detector treats a second compile of the same key as
     unexpected). Extra kwargs go straight to ``jax.jit``."""
     reg = registry if registry is not None else _REGISTRY
-    # dtpu: ignore[jit-recompile-hazard] -- this IS the caching chokepoint: every caller memoizes the returned wrapper by its shape key
+    # dtpu: ignore[jit-recompile-hazard] until=2027-08-01 -- this IS the caching chokepoint: every caller memoizes the returned wrapper by its shape key
     return reg.wrap(program, jax.jit(fun, **jit_kwargs), key=key)
 
 

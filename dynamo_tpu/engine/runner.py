@@ -139,7 +139,7 @@ def _mh_zeros(shape, dtype, sharding):
     and HBM-friendly for multi-GB KV pools."""
     if jax.process_count() > 1:
         # jit is the only multi-host-legal way to get out_shardings placement.
-        # dtpu: ignore[jit-recompile-hazard, unregistered-jit] -- one-shot at pool creation, never dispatched from the serving loop
+        # dtpu: ignore[jit-recompile-hazard, unregistered-jit] until=2027-08-01 -- one-shot at pool creation, never dispatched from the serving loop
         return jax.jit(lambda: jnp.zeros(shape, dtype),
                        out_shardings=sharding)()
     return jax.device_put(jnp.zeros(shape, dtype), sharding)

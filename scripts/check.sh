@@ -9,10 +9,15 @@ cd "$(dirname "$0")/.."
 echo "== dtpu-lint (interprocedural analysis + suppression ratchet) =="
 # --stats prints the module/function/edge/rule counts so gate logs
 # record call-graph size drift; --budget is the suppression ratchet
-# (deploy/lint-budget.json counts may only go down; docs/ANALYSIS.md).
+# (deploy/lint-budget.json counts may only go down; docs/ANALYSIS.md);
+# --sarif-out emits the SARIF 2.1.0 artifact CI/code-review surfaces
+# ingest to annotate findings inline on diffs. Warm runs hit the
+# .dtpu-lint-cache content-hash cache and finish in milliseconds.
+DTPU_LINT_SARIF="${DTPU_LINT_SARIF:-/tmp/dtpu-lint.sarif}"
 python -m dynamo_tpu.analysis dynamo_tpu \
-    --budget deploy/lint-budget.json --stats || exit 1
-echo "clean."
+    --budget deploy/lint-budget.json --stats \
+    --sarif-out "$DTPU_LINT_SARIF" || exit 1
+echo "clean. (sarif artifact: $DTPU_LINT_SARIF)"
 
 echo "== chaos smoke (seeded fault injection, docs/RESILIENCE.md) =="
 # The fast scenario subset; the combined high-fault matrix is -m slow.

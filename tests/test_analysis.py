@@ -6,8 +6,10 @@ wire-error-taxonomy revert scenario from the acceptance criteria.
 """
 
 import json
+import os
 import subprocess
 import sys
+from pathlib import Path
 
 import pytest
 
@@ -608,7 +610,11 @@ def test_default_rules_catalog():
                    "host-sync-in-hot-path", "impure-jit-program",
                    "engine-thread-shared-state",
                    "wire-error-taxonomy", "direct-prometheus-import",
-                   "untyped-journal-event"}
+                   "untyped-journal-event",
+                   # v3 dataflow/lockset rules
+                   "recompile-on-value", "weak-type-promotion",
+                   "traced-bool-coercion", "lock-order-inversion"}
+    assert len(ids) == 18
 
 
 # -- direct-prometheus-import -------------------------------------------------
@@ -1127,10 +1133,10 @@ def test_count_suppressions(tmp_path):
     assert counts == {"*": 1, "blocking-call-in-async": 1}
 
 
-def run_cli(*argv):
+def run_cli(*argv, **kw):
     return subprocess.run(
         [sys.executable, "-m", "dynamo_tpu.analysis", *argv],
-        capture_output=True, text=True)
+        capture_output=True, text=True, **kw)
 
 
 def test_budget_gate_pass_and_fail(tmp_path):
@@ -1209,13 +1215,243 @@ def test_cli_stats_line(tmp_path):
 
 def test_full_repo_lint_under_budget():
     """Single-pass sharing keeps the full-repo interprocedural run fast
-    (parse once, one call graph for all 14 rules). Generous bound for
-    the 1-core CI box; locally this is ~3-4 s."""
+    (parse once, one call graph + one dataflow for all 18 rules).
+    Deflake contract: judge ``run.timings["analysis_cpu_s"]`` — the
+    analyzing thread's CPU seconds, measured inside run_analysis — not
+    wall time, so cache-cold imports, a saturated 1-core box, and
+    background threads left by earlier suites in the same pytest
+    process can't flake tier-1. Generous bound; locally the analysis
+    is ~4-6 s."""
     import dynamo_tpu
     from pathlib import Path
 
-    t0 = time.perf_counter()
     run = run_analysis([str(Path(dynamo_tpu.__file__).parent)])
-    elapsed = time.perf_counter() - t0
     assert run.graph is not None
-    assert elapsed < 10.0, f"full-repo lint took {elapsed:.1f}s"
+    assert set(run.timings) >= {"parse_s", "graph_s", "dataflow_s",
+                                "rules_s", "analysis_s",
+                                "analysis_cpu_s"}
+    assert run.timings["analysis_cpu_s"] < 10.0, \
+        f"full-repo analysis took {run.timings['analysis_cpu_s']:.1f}s CPU"
+
+
+# =============================================================================
+# dtpu-lint v3: SARIF output, suppression expiry, incremental run cache
+# =============================================================================
+
+# -- --format sarif / --sarif-out ---------------------------------------------
+
+def _sarif_fixture(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\nasync def f():\n    time.sleep(1)\n")
+    return bad
+
+
+def test_sarif_structure_valid(tmp_path):
+    """The SARIF document carries the 2.1.0 required shape: version,
+    runs[].tool.driver with the full rule catalog, results pointing at
+    physical locations with 1-based lines/columns, and ruleIndex wired
+    back into the catalog."""
+    bad = _sarif_fixture(tmp_path)
+    proc = run_cli(str(bad), "--format", "sarif")
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0"
+    assert doc["$schema"].endswith("sarif-schema-2.1.0.json")
+    (sarif_run,) = doc["runs"]
+    driver = sarif_run["tool"]["driver"]
+    assert driver["name"] == "dtpu-lint"
+    rule_ids = [r["id"] for r in driver["rules"]]
+    # full catalog + the two synthetic rules, sorted for stability
+    assert rule_ids == sorted(rule_ids)
+    for rid in ("blocking-call-in-async", "recompile-on-value",
+                "lock-order-inversion", "parse-error",
+                "expired-suppression"):
+        assert rid in rule_ids
+    (res,) = sarif_run["results"]
+    assert res["ruleId"] == "blocking-call-in-async"
+    assert rule_ids[res["ruleIndex"]] == res["ruleId"]
+    assert res["level"] == "error"  # findings fail the gate (exit 1)
+    assert res["message"]["text"]
+    (loc,) = res["locations"]
+    phys = loc["physicalLocation"]
+    assert phys["artifactLocation"]["uri"].endswith("bad.py")
+    assert phys["region"]["startLine"] == 3
+    assert phys["region"]["startColumn"] >= 1
+
+
+def test_sarif_byte_stable(tmp_path):
+    """Two runs (the second warm from cache) emit byte-identical SARIF."""
+    bad = _sarif_fixture(tmp_path)
+    a = run_cli(str(bad), "--format", "sarif")
+    b = run_cli(str(bad), "--format", "sarif")
+    assert a.stdout == b.stdout
+    c = run_cli(str(bad), "--format", "sarif", "--no-cache")
+    assert a.stdout == c.stdout
+
+
+def test_sarif_out_artifact_alongside_text(tmp_path):
+    """--sarif-out writes the artifact without changing the primary
+    format (check.sh uses this: human text to the console, SARIF file
+    for CI ingestion)."""
+    bad = _sarif_fixture(tmp_path)
+    out = tmp_path / "lint.sarif"
+    proc = run_cli(str(bad), "--sarif-out", str(out))
+    assert proc.returncode == 1
+    assert "blocking-call-in-async" in proc.stdout  # text format kept
+    doc = json.loads(out.read_text())
+    assert doc["version"] == "2.1.0"
+    assert doc["runs"][0]["results"][0]["ruleId"] == "blocking-call-in-async"
+
+
+def test_sarif_clean_run_has_empty_results(tmp_path):
+    ok = tmp_path / "ok.py"
+    ok.write_text("def a():\n    pass\n")
+    proc = run_cli(str(ok), "--format", "sarif")
+    assert proc.returncode == 0
+    doc = json.loads(proc.stdout)
+    assert doc["runs"][0]["results"] == []
+
+
+# -- suppression expiry (# dtpu: ignore[rule] until=YYYY-MM-DD) ---------------
+
+EXPIRY_SRC = """\
+import time
+async def f():
+    time.sleep(1)  # dtpu: ignore[blocking-call-in-async] until={date} -- why
+"""
+
+
+def _expiry_findings(tmp_path, monkeypatch, until, today="2026-08-06"):
+    monkeypatch.setenv("DTPU_LINT_TODAY", today)
+    p = tmp_path / "exp.py"
+    p.write_text(EXPIRY_SRC.format(date=until))
+    return analyze_paths([str(p)], select=["blocking-call-in-async"])
+
+
+def test_suppression_until_future_still_suppresses(tmp_path, monkeypatch):
+    assert _expiry_findings(tmp_path, monkeypatch, "2027-08-01") == []
+
+
+def test_suppression_until_today_still_active(tmp_path, monkeypatch):
+    # expiry is exclusive: the directive works through its until= date
+    assert _expiry_findings(tmp_path, monkeypatch, "2026-08-06") == []
+
+
+def test_expired_suppression_unmasks_finding(tmp_path, monkeypatch):
+    found = _expiry_findings(tmp_path, monkeypatch, "2026-08-05")
+    by_rule = {f.rule_id for f in found}
+    assert by_rule == {"blocking-call-in-async", "expired-suppression"}
+    exp = next(f for f in found if f.rule_id == "expired-suppression")
+    assert exp.line == 3
+    assert "2026-08-05" in exp.message
+    assert "blocking-call-in-async" in exp.message
+
+
+def test_expiring_count_in_budget(tmp_path, monkeypatch):
+    """Active until= directives are counted under `expiring` (ratcheted
+    like every other row); expired ones drop out of both counts."""
+    from dynamo_tpu.analysis import run_analysis as _run
+
+    monkeypatch.setenv("DTPU_LINT_TODAY", "2026-08-06")
+    live = tmp_path / "live.py"
+    live.write_text(EXPIRY_SRC.format(date="2027-08-01"))
+    run = _run([str(live)], select=["blocking-call-in-async"])
+    assert run.suppression_counts() == {"blocking-call-in-async": 1,
+                                        "expiring": 1}
+
+    dead = tmp_path / "dead.py"
+    dead.write_text(EXPIRY_SRC.format(date="2020-01-01"))
+    run = _run([str(dead)], select=["blocking-call-in-async"])
+    assert run.suppression_counts() == {}
+
+
+def test_repo_expiring_suppressions_carry_dates():
+    """The two jit-recompile-hazard suppressions in the engine carry
+    until= dates (the `expiring: 2` budget row); nothing in the repo
+    has already expired."""
+    import dynamo_tpu
+
+    pkg = Path(dynamo_tpu.__file__).parent
+    from dynamo_tpu.analysis import run_analysis as _run
+    run = _run([str(pkg)])
+    assert run.suppression_counts().get("expiring") == 2
+    assert not any(f.rule_id == "expired-suppression" for f in run.findings)
+
+
+# -- incremental run cache (.dtpu-lint-cache) ---------------------------------
+
+def _cli_env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(Path(__file__).parent.parent)
+    return env
+
+
+def test_cache_cold_warm_parity(tmp_path):
+    """API-level: a warm run reproduces the cold run's findings,
+    suppression counts and stats exactly, and marks itself cached."""
+    from dynamo_tpu.analysis import run_analysis as _run
+
+    p = tmp_path / "m.py"
+    p.write_text("import time\nasync def f():\n"
+                 "    time.sleep(1)\n"
+                 "    time.sleep(2)  # dtpu: ignore[blocking-call-in-async]"
+                 " -- x\n")
+    cache = tmp_path / "cache"
+    cold = _run([str(p)], cache_dir=str(cache))
+    warm = _run([str(p)], cache_dir=str(cache))
+    assert not cold.cached and warm.cached
+    assert [f.to_json() for f in warm.findings] == \
+        [f.to_json() for f in cold.findings]
+    assert warm.suppression_counts() == cold.suppression_counts()
+    assert warm.graph_stats() == cold.graph_stats()
+
+
+def test_cache_invalidated_by_edit(tmp_path):
+    from dynamo_tpu.analysis import run_analysis as _run
+
+    p = tmp_path / "m.py"
+    p.write_text("import time\nasync def f():\n    time.sleep(1)\n")
+    cache = tmp_path / "cache"
+    first = _run([str(p)], cache_dir=str(cache))
+    assert len(first.findings) == 1
+    p.write_text("import asyncio\nasync def f():\n"
+                 "    await asyncio.sleep(1)\n")
+    second = _run([str(p)], cache_dir=str(cache))
+    assert not second.cached and second.findings == []
+
+
+def test_cache_invalidated_by_date(tmp_path, monkeypatch):
+    # until= semantics depend on today's date, so the key includes it:
+    # a directive must not stay suppressed past expiry via a stale hit.
+    from dynamo_tpu.analysis import run_analysis as _run
+
+    p = tmp_path / "m.py"
+    p.write_text(EXPIRY_SRC.format(date="2026-08-06"))
+    cache = tmp_path / "cache"
+    monkeypatch.setenv("DTPU_LINT_TODAY", "2026-08-06")
+    assert _run([str(p)], cache_dir=str(cache)).findings == []
+    monkeypatch.setenv("DTPU_LINT_TODAY", "2026-08-07")
+    run = _run([str(p)], cache_dir=str(cache))
+    assert not run.cached
+    assert any(f.rule_id == "expired-suppression" for f in run.findings)
+
+
+def test_cli_cache_dir_and_no_cache(tmp_path):
+    """CLI default writes .dtpu-lint-cache under the cwd; the warm run
+    reports cached=1 on the --stats line (stderr only — stdout documents
+    stay byte-identical); --no-cache never touches the directory."""
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "m.py").write_text("def a():\n    pass\n")
+    kw = dict(cwd=str(proj), env=_cli_env())
+    cache = proj / ".dtpu-lint-cache"
+
+    a = run_cli("m.py", "--stats", "--no-cache", **kw)
+    assert a.returncode == 0 and not cache.exists()
+
+    b = run_cli("m.py", "--stats", **kw)
+    c = run_cli("m.py", "--stats", **kw)
+    assert cache.exists() and list(cache.glob("run-*.json"))
+    assert "cached=1" not in b.stderr
+    assert "cached=1" in c.stderr
+    assert b.stdout == c.stdout

@@ -1,0 +1,497 @@
+"""dtpu-lint v3 dataflow engine tests.
+
+Three layers, mirroring docs/ANALYSIS.md's v3 section:
+
+- lattice units: ``join_base``/``AV.join`` algebra (commutative,
+  associative, idempotent, BOT identity / TOP absorbing, the
+  REQ ⊔ TRACED = TOP precision choice) and loop/branch widening
+  through real function bodies;
+- rule fixtures: known-bad snippets that must fire with a rendered
+  taint chain and known-good twins that must stay quiet, including the
+  PR 9 uncommitted-rng-key shape for ``recompile-on-value``;
+- a non-vacuous acceptance test: the real engine's decode dispatch and
+  verify-window program bodies are actually analyzed (non-zero traced
+  facts) and clean — so "0 findings on the repo" cannot regress into
+  "0 bodies resolved".
+"""
+
+import pytest
+
+from dynamo_tpu.analysis import analyze_paths, build_callgraph, run_analysis
+from dynamo_tpu.analysis.core import load_paths
+from dynamo_tpu.analysis.dataflow import (
+    AV, BOT, CONST, REQ, SCALAR, SHAPE, TOP, TRACED, ensure_dataflow,
+    join_base, join_env)
+
+_ALL = (BOT, CONST, SHAPE, SCALAR, REQ, TRACED, TOP)
+
+
+def build_tree(tmp_path, files):
+    root = tmp_path / "pkgroot"
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    modules, failed = load_paths([str(root)])
+    assert failed == []
+    return str(root), modules, build_callgraph(modules)
+
+
+def fn_of(graph, suffix):
+    hits = [f for f in graph.functions.values()
+            if f.qname == suffix or f.qname.endswith(suffix)]
+    assert len(hits) == 1, f"{suffix}: {[f.qname for f in hits]}"
+    return hits[0]
+
+
+def run_rule(tmp_path, rule_id, source, name="snippet.py"):
+    p = tmp_path / name
+    p.write_text(source)
+    return analyze_paths([str(p)], select=[rule_id])
+
+
+# =============================================================================
+# lattice units
+# =============================================================================
+
+def test_join_base_is_a_join():
+    for a in _ALL:
+        assert join_base(a, a) == a                      # idempotent
+        assert join_base(BOT, a) == a == join_base(a, BOT)
+        assert join_base(TOP, a) == TOP == join_base(a, TOP)
+        for b in _ALL:
+            assert join_base(a, b) == join_base(b, a)    # commutative
+            for c in _ALL:
+                assert join_base(join_base(a, b), c) == \
+                    join_base(a, join_base(b, c))        # associative
+
+
+def test_join_base_pinned_values():
+    # the precision choice: mixing per-request data into traced values
+    # loses both properties — rules ignore TOP rather than guess
+    assert join_base(REQ, TRACED) == TOP
+    # traced absorbs every host value except REQ
+    for host in (CONST, SHAPE, SCALAR):
+        assert join_base(TRACED, host) == TRACED
+    # the host chain is totally ordered CONST < SHAPE < SCALAR < REQ
+    assert join_base(CONST, SHAPE) == SHAPE
+    assert join_base(SHAPE, SCALAR) == SCALAR
+    assert join_base(SCALAR, REQ) == REQ
+    assert join_base(CONST, REQ) == REQ
+
+
+def test_av_join_unions_params_and_keeps_taint_provenance():
+    tainted = AV(REQ, frozenset({0}), ("request.seed",))
+    clean = AV(SCALAR, frozenset({1}))
+    joined = tainted.join(clean)
+    assert joined.base == REQ
+    assert joined.params == frozenset({0, 1})
+    assert joined.src == ("request.seed",)     # taint side wins
+    assert clean.join(tainted).src == ("request.seed",)
+
+
+def test_av_src_chain_is_bounded_and_deduped():
+    av = AV(REQ, src=("request",))
+    for hop in ("a", "b", "c", "d", "e"):
+        av = av.with_src(hop)
+    assert len(av.src) <= 4                    # rendered chains stay short
+    assert av.with_src("e").src == av.src      # trailing label deduped
+
+
+def test_join_env_pointwise():
+    a = {"x": AV(CONST), "y": AV(REQ, src=("req",))}
+    b = {"x": AV(TRACED), "z": AV(SCALAR)}
+    out = join_env(a, b)
+    assert out["x"].base == TRACED
+    assert out["y"].base == REQ and out["z"].base == SCALAR
+
+
+def test_branch_join_widens_to_req(tmp_path):
+    _, _, graph = build_tree(tmp_path, {"app/m.py": (
+        "def pick(request, flag):\n"
+        "    if flag:\n"
+        "        x = 1\n"
+        "    else:\n"
+        "        x = request.seed\n"
+        "    return x\n")})
+    df = ensure_dataflow(graph)
+    summ = df.summaries[fn_of(graph, ":pick").qname]
+    assert summ.ret.base == REQ
+    assert 0 in summ.ret.params
+
+
+def test_loop_carried_taint_reaches_fixpoint(tmp_path):
+    # acc is CONST on loop entry; the second loop pass sees the tainted
+    # rebinding, so the post-loop join is REQ (the widening contract)
+    _, _, graph = build_tree(tmp_path, {"app/m.py": (
+        "def total(request):\n"
+        "    acc = 0\n"
+        "    for tok in request.tokens:\n"
+        "        acc = acc + tok\n"
+        "    return acc\n")})
+    df = ensure_dataflow(graph)
+    facts = df.facts[fn_of(graph, ":total").qname]
+    assert facts.env["acc"].base == REQ
+    assert facts.summary.ret.base == REQ
+
+
+def test_bucketing_comparison_kills_taint(tmp_path):
+    # comparisons have a bounded image — `request.n > 0` is a legal
+    # compile-key ingredient, so taint must not survive it
+    _, _, graph = build_tree(tmp_path, {"app/m.py": (
+        "def bucket(request):\n"
+        "    big = request.n > 128\n"
+        "    opt = request.emb is not None\n"
+        "    return big, opt\n")})
+    df = ensure_dataflow(graph)
+    facts = df.facts[fn_of(graph, ":bucket").qname]
+    assert facts.env["big"].base == SCALAR
+    assert facts.env["opt"].base == SCALAR
+
+
+def test_taint_propagates_through_call_summary(tmp_path):
+    _, _, graph = build_tree(tmp_path, {
+        "app/helpers.py": "def wrap(v):\n    return (v, 1)\n",
+        "app/main.py": (
+            "from app import helpers\n"
+            "def outer(request):\n"
+            "    x = helpers.wrap(request.seed)\n"
+            "    return x\n")})
+    df = ensure_dataflow(graph)
+    wrap = df.summaries[fn_of(graph, ":wrap").qname]
+    assert wrap.ret.params == frozenset({0})   # ret depends on param 0
+    outer = df.facts[fn_of(graph, ":outer").qname]
+    assert outer.env["x"].base == REQ          # substituted at the call
+    assert outer.summary.ret.base == REQ
+
+
+# =============================================================================
+# recompile-on-value
+# =============================================================================
+
+# The PR 9 bug shape: a per-request sampling seed baked into the jit
+# cache key — one compile per distinct seed, exactly what
+# perf_unexpected_recompiles_total caught at runtime.
+RNG_KEY_BAD = """\
+class Engine:
+    def _get_decode(self, request, bucket):
+        seed = request.sampling_seed
+        def step(params, x, rng):
+            return x
+        return perf.instrumented_jit("decode", step,
+                                     key=(bucket, seed))
+"""
+
+# The fix: key on the bounded *structure* (seeded or not), pass the
+# seed in as traced data.
+RNG_KEY_GOOD = """\
+class Engine:
+    def _get_decode(self, request, bucket):
+        seeded = request.sampling_seed is not None
+        def step(params, x, rng):
+            return x
+        return perf.instrumented_jit("decode", step,
+                                     key=(bucket, seeded))
+"""
+
+
+def test_recompile_on_value_fires_on_rng_key(tmp_path):
+    found = run_rule(tmp_path, "recompile-on-value", RNG_KEY_BAD)
+    assert len(found) == 1
+    f = found[0]
+    assert "request.sampling_seed" in f.message
+    assert "jit cache key" in f.message
+    # the rendered taint chain walks builder -> value -> key
+    assert f.chain
+    assert any("request.sampling_seed" in part for part in f.chain)
+    assert f.chain[-1] == "instrumented_jit(key=…)"
+
+
+def test_recompile_on_value_quiet_on_bucketed_key(tmp_path):
+    assert run_rule(tmp_path, "recompile-on-value", RNG_KEY_GOOD) == []
+
+
+def test_recompile_on_value_through_helper_summary(tmp_path):
+    # the key= lives in a helper; the per-request actual is flagged at
+    # the *call site*, via the helper's jit_key_params summary
+    _, _, graph = build_tree(tmp_path, {"app/runner.py": (
+        "class Runner:\n"
+        "    def _get_step(self, seed, bucket):\n"
+        "        def step(params, x):\n"
+        "            return x\n"
+        "        return perf.instrumented_jit('s', step,\n"
+        "                                     key=(bucket, seed))\n"
+        "    def dispatch(self, request):\n"
+        "        return self._get_step(request.seed, 128)\n")})
+    df = ensure_dataflow(graph)
+    summ = df.summaries[fn_of(graph, ":Runner._get_step").qname]
+    assert set(summ.jit_key_params) == {0, 1}
+    assert summ.jit_key_params[0][0] == "seed"
+
+    root = str(tmp_path / "pkgroot")
+    found = analyze_paths([root], select=["recompile-on-value"])
+    assert len(found) == 1
+    f = found[0]
+    assert f.line == 8                      # the dispatch call site
+    assert "request.seed" in f.message and "_get_step" in f.message
+    assert any("instrumented_jit" in part for part in f.chain)
+
+
+TRACE_TIME_BAD = """\
+class Engine:
+    def _get_window(self, request):
+        limit = request.max_tokens
+        tag = request.trace_id
+        def run(params, x):
+            if limit:
+                x = x + 1
+            name = f"win-{tag}"
+            y = jnp.zeros(limit)
+            return x, name, y
+        return perf.instrumented_jit("win", run, key=("win",))
+"""
+
+TRACE_TIME_GOOD = """\
+class Engine:
+    def _get_window(self, request, bucket):
+        long = request.max_tokens > 512
+        def run(params, x, limit):
+            return x * limit
+        return perf.instrumented_jit("win", run, key=(bucket, long))
+"""
+
+
+def test_recompile_on_value_trace_time_positions(tmp_path):
+    found = run_rule(tmp_path, "recompile-on-value", TRACE_TIME_BAD)
+    kinds = sorted(f.message for f in found)
+    assert len(found) == 3
+    assert any("Python `if`" in m for m in kinds)
+    assert any("formatted at trace-time" in m for m in kinds)
+    assert any("shape argument" in m for m in kinds)
+    for f in found:
+        assert f.chain and any("request." in part for part in f.chain)
+
+
+def test_recompile_on_value_quiet_on_data_args(tmp_path):
+    assert run_rule(tmp_path, "recompile-on-value", TRACE_TIME_GOOD) == []
+
+
+def test_recompile_on_value_suppression(tmp_path):
+    src = RNG_KEY_BAD.replace(
+        "key=(bucket, seed))",
+        "key=(bucket, seed))"
+        "  # dtpu: ignore[recompile-on-value] -- why")
+    assert run_rule(tmp_path, "recompile-on-value", src) == []
+
+
+# =============================================================================
+# weak-type-promotion
+# =============================================================================
+
+WEAK_BAD = """\
+import numpy as np
+
+class Engine:
+    def _get(self):
+        def step(params, x):
+            y = x * np.float32(0.5)
+            z = jnp.add(x, jnp.array([0.5, 1.0]))
+            return y + z
+        return perf.instrumented_jit("s", step, key=())
+"""
+
+WEAK_GOOD = """\
+import numpy as np
+
+class Engine:
+    def _get(self):
+        scale = np.float32(2.0)        # not mixed into traced math
+        def step(params, x):
+            y = x * 0.5                # weak literal keeps x.dtype
+            z = jnp.add(x, jnp.array([0.5, 1.0], dtype=x.dtype))
+            w = x + jnp.asarray(0.5, x.dtype)   # positional dtype
+            return y + z + w
+        return perf.instrumented_jit("s", step, key=())
+"""
+
+
+def test_weak_type_promotion_fires(tmp_path):
+    found = run_rule(tmp_path, "weak-type-promotion", WEAK_BAD)
+    assert len(found) == 2
+    msgs = "\n".join(f.message for f in found)
+    assert "np.float32" in msgs
+    assert "dtype-less" in msgs
+    assert all(f.chain for f in found)
+
+
+def test_weak_type_promotion_quiet_on_good(tmp_path):
+    assert run_rule(tmp_path, "weak-type-promotion", WEAK_GOOD) == []
+
+
+# =============================================================================
+# traced-bool-coercion
+# =============================================================================
+
+COERCION_BAD = """\
+class Engine:
+    def _get(self):
+        def step(params, x):
+            if x.sum() > 0:
+                return x
+            assert x.max() < 1e6
+            return -x
+        return perf.instrumented_jit("s", step, key=())
+"""
+
+COERCION_GOOD = """\
+class Engine:
+    def _get(self, penalized):
+        def step(params, x, emb):
+            if penalized:              # builder-time Python bool: legal
+                x = x * 2
+            if emb is None:            # structure test: static at trace
+                return jnp.where(x > 0, x, -x)
+            return x + emb
+        return perf.instrumented_jit("s", step, key=(penalized,))
+"""
+
+
+def test_traced_bool_coercion_fires(tmp_path):
+    found = run_rule(tmp_path, "traced-bool-coercion", COERCION_BAD)
+    assert len(found) == 2
+    msgs = "\n".join(f.message for f in found)
+    assert "Python `if`" in msgs and "assert" in msgs
+    assert all(f.chain for f in found)
+
+
+def test_traced_bool_coercion_quiet_on_good(tmp_path):
+    # builder-closure bools, `is None` structure tests, and traced
+    # comparisons feeding jnp.where (value position) all stay legal
+    assert run_rule(tmp_path, "traced-bool-coercion", COERCION_GOOD) == []
+
+
+# =============================================================================
+# lock-order-inversion
+# =============================================================================
+
+INVERSION_BAD = """\
+import threading
+
+class Pool:
+    def __init__(self):
+        self.alloc_lock = threading.Lock()
+        self.stats_lock = threading.Lock()
+
+    def grow(self):
+        with self.alloc_lock:
+            with self.stats_lock:
+                pass
+
+    def report(self):
+        with self.stats_lock:
+            with self.alloc_lock:
+                pass
+"""
+
+INVERSION_GOOD = INVERSION_BAD.replace(
+    "with self.stats_lock:\n            with self.alloc_lock:",
+    "with self.alloc_lock:\n            with self.stats_lock:")
+
+INVERSION_TRANSITIVE = """\
+import threading
+
+class Pool:
+    def __init__(self):
+        self.alloc_lock = threading.Lock()
+        self.stats_lock = threading.Lock()
+
+    def grow(self):
+        with self.alloc_lock:
+            self._bump()
+
+    def _bump(self):
+        with self.stats_lock:
+            pass
+
+    def report(self):
+        with self.stats_lock:
+            with self.alloc_lock:
+                pass
+"""
+
+
+def test_lock_order_inversion_fires(tmp_path):
+    found = run_rule(tmp_path, "lock-order-inversion", INVERSION_BAD)
+    assert len(found) == 1
+    f = found[0]
+    assert "Pool.alloc_lock" in f.message
+    assert "Pool.stats_lock" in f.message
+    assert "⇄" in f.chain                     # both witness chains shown
+
+
+def test_lock_order_inversion_quiet_on_consistent_order(tmp_path):
+    assert run_rule(tmp_path, "lock-order-inversion",
+                    INVERSION_GOOD) == []
+
+
+def test_lock_order_inversion_through_callee(tmp_path):
+    found = run_rule(tmp_path, "lock-order-inversion",
+                     INVERSION_TRANSITIVE)
+    assert len(found) == 1
+    assert any("_bump" in part or "grow" in part
+               for part in found[0].chain)
+
+
+def test_lock_order_inversion_suppression(tmp_path):
+    # the finding anchors at the inner (second-acquisition) with of
+    # whichever order was witnessed first; suppress both inner withs
+    src = INVERSION_BAD.replace(
+        "            with self.stats_lock:",
+        "            with self.stats_lock:  "
+        "# dtpu: ignore[lock-order-inversion] -- why").replace(
+        "            with self.alloc_lock:",
+        "            with self.alloc_lock:  "
+        "# dtpu: ignore[lock-order-inversion] -- why")
+    assert run_rule(tmp_path, "lock-order-inversion", src) == []
+
+
+# =============================================================================
+# acceptance: the real engine's program bodies are analyzed, not skipped
+# =============================================================================
+
+def test_real_program_bodies_analyzed_and_clean():
+    """Guards against vacuous cleanliness: the decode dispatch and the
+    speculative verify-window bodies must resolve through
+    ``_program_sites`` and produce substantial traced facts — and the
+    four dataflow rules must report nothing on them."""
+    import dynamo_tpu
+    from pathlib import Path
+
+    from dynamo_tpu.analysis.rules_dataflow import _program_sites
+
+    pkg = Path(dynamo_tpu.__file__).parent
+    run = run_analysis([str(pkg)],
+                       select=["recompile-on-value", "weak-type-promotion",
+                               "traced-bool-coercion",
+                               "lock-order-inversion"])
+    assert [f for f in run.findings if f.rule_id != "parse-error"] == []
+
+    df = ensure_dataflow(run.graph)
+    sites = list(_program_sites(run.graph))
+    assert len(sites) >= 8, [b.qname for _, _, b in sites]
+    traced = {}
+    for builder, _site, body in sites:
+        bf = df.body_facts(body, builder)
+        traced[builder.qname] = traced.get(builder.qname, 0) \
+            + bf.traced_count
+    hits = {q: n for q, n in traced.items()}
+
+    def count_for(fragment):
+        return sum(n for q, n in hits.items() if fragment in q)
+
+    # decode dispatch and both verify-window builders actually traced
+    assert count_for("_get_decode") > 0
+    assert count_for("_get_window") > 50
+    assert count_for("_get_spec_window") > 50
+    # and the prefill path, the deepest body in the engine
+    assert count_for("_get_prefill") > 50
