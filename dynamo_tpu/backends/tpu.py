@@ -147,8 +147,12 @@ def parse_args(argv=None) -> argparse.Namespace:
                              "with a larger rank are rejected)")
     parser.add_argument("--spec-decode", default=None, choices=["ngram"],
                         help="speculative decoding: 'ngram' = prompt-"
-                             "lookup self-drafting verified in-window "
-                             "(greedy-only serving)")
+                             "lookup self-drafting verified in-window; "
+                             "serves greedy and temperature/top-k/top-p/"
+                             "seeded sampling (on-device rejection "
+                             "sampling keeps the exact output "
+                             "distribution); logprobs and penalties "
+                             "are not supported under spec decode")
     parser.add_argument("--spec-k", type=int, default=3,
                         help="drafts verified per speculative step")
     parser.add_argument("--ttft-budget-ms", type=float, default=None,

@@ -244,6 +244,11 @@ class TPUEngine(AsyncEngine):
         self.spec_drafts = 0        # verify steps that had drafts
         self.spec_tokens = 0        # draft tokens proposed
         self.spec_accepted = 0      # draft tokens accepted
+        # Per-verify-step emitted-token histogram: index e = tokens the
+        # step emitted (1 = no draft accepted .. spec_k+1 = all
+        # accepted); index 0 counts dispatched-but-frozen steps.
+        self.spec_emit_hist = ([0] * (config.spec_k + 2)
+                               if config.spec_decode else [])
         # Engine-local brownout (see _update_brownout): 0..3 pressure
         # level from the TTFT projection; spec_brownout_windows counts
         # decode windows where drafting was suspended by it.
@@ -345,23 +350,26 @@ class TPUEngine(AsyncEngine):
         if not req.token_ids:
             raise ValueError("empty token_ids")
         if self.config.spec_decode:
+            # Spec decode serves the full sampling surface on-device
+            # (temperature/top-k/top-p/seed as data in the verify
+            # program; every emitted token is exactly target-distributed
+            # via rejection sampling). Still outside it: logprobs (the
+            # verify program has no per-step logprob taps) and OpenAI
+            # penalties (the [B,V] count state doesn't thread through
+            # the spec scan).
             s = req.sampling_options
             unsupported = []
-            if s.temperature:
-                unsupported.append("temperature > 0")
             if s.logprobs is not None:
                 unsupported.append("logprobs")
             if getattr(s, "frequency_penalty", None) or \
                     getattr(s, "presence_penalty", None):
-                unsupported.append("penalties")
-            if getattr(s, "seed", None) is not None:
-                unsupported.append("seed")
+                unsupported.append("frequency/presence penalties")
             if unsupported:
                 raise ValueError(
                     f"speculative decoding ({self.config.spec_decode}) "
-                    f"serves greedy only; unsupported here: "
-                    f"{', '.join(unsupported)}. Disable spec_decode or "
-                    f"drop these sampling options")
+                    f"does not support: {', '.join(unsupported)}. "
+                    f"Disable spec_decode or drop these options "
+                    f"(temperature/top_k/top_p/seed are supported)")
         if len(req.token_ids) >= self.config.max_model_len:
             raise ValueError(
                 f"prompt length {len(req.token_ids)} exceeds max model len "
@@ -973,9 +981,10 @@ class TPUEngine(AsyncEngine):
         raw = os.environ.get("DTPU_EXPECTED_ROOFLINE_FRAC")
         if raw:
             expected = float(raw)
-        return {
+        compiles = self._perf.snapshot()
+        status = {
             "role": "engine",
-            "compiles": self._perf.snapshot(),
+            "compiles": compiles,
             "window": self._perf.window_snapshot(),
             "roofline": {
                 "weight_read_step_ms": round(self._step_floor_ms, 4),
@@ -985,6 +994,34 @@ class TPUEngine(AsyncEngine):
             "hbm": self.runner.hbm_stats(),
             "memory": self.runner.memory_breakdown(),
         }
+        if self.config.spec_decode:
+            # Verify-of-k bandwidth: the spec program runs m_outer verify
+            # steps of S = spec_k + 1 positions each, so cost-registry
+            # bytes over m_outer * S is HBM bytes per VERIFIED position —
+            # the number the fused multi-token verify keeps near the
+            # single-token step's (one weight read covers S positions).
+            cost = (compiles["programs"].get("spec_window") or {}).get(
+                "cost") or {}
+            positions = self.spec_m_outer * (self.config.spec_k + 1)
+            vb = cost.get("bytes_accessed")
+            status["spec"] = {
+                "k": self.config.spec_k,
+                "m_outer": self.spec_m_outer,
+                "drafts": self.spec_drafts,
+                "draft_tokens": self.spec_tokens,
+                "accepted_tokens": self.spec_accepted,
+                "acceptance_rate": round(
+                    self.spec_accepted / self.spec_tokens, 4)
+                if self.spec_tokens else None,
+                # emit_hist[e] = verify steps that emitted e tokens
+                # (0 = dispatched frozen, spec_k+1 = all drafts landed).
+                "emit_hist": list(self.spec_emit_hist),
+                "brownout_windows": self.spec_brownout_windows,
+                "verify_bytes_per_token": round(vb / positions, 1)
+                if vb and positions else None,
+                "verify_cost_source": cost.get("source"),
+            }
+        return status
 
     def handler(self):
         async def handle(request, context):
@@ -1017,12 +1054,16 @@ class TPUEngine(AsyncEngine):
         packed = np.zeros((self.config.max_num_seqs,
                            PK_PREFIX + bucket_pages), np.int32)
         if self.config.spec_decode:
-            # Spec mode serves greedy only: one program to warm, none of
-            # the penalized/seeded variants (rejected at validation).
+            # ONE spec program covers greedy, sampled and seeded verify:
+            # temperature/top-k/top-p/seed are data (packed columns),
+            # not trace-time specializations, so warming it once also
+            # warms every sampling mix. (Penalties are rejected at
+            # validation — no penalized variant exists to warm.)
             outs = self.runner.decode_spec_window(
                 packed, self.spec_m_outer, self.config.spec_k)
             np.asarray(outs[0])
-            log.info("warmed spec window program m=%d k=%d in %.1fs",
+            log.info("warmed spec window program m=%d k=%d in %.1fs "
+                     "(covers greedy + sampled + seeded verify)",
                      self.spec_m_outer, self.config.spec_k,
                      time.monotonic() - t0)
             t0 = time.monotonic()
@@ -2380,6 +2421,7 @@ class TPUEngine(AsyncEngine):
             pos = start
             for m in range(steps):
                 e = int(emits[m, i])
+                self.spec_emit_hist[e] += 1
                 if e == 0:
                     if pos >= cap:
                         finish = FinishReason.LENGTH

@@ -108,6 +108,34 @@ def gather_pages(cache, idx_l, page_table):
     return cache[idx_l, :, page_table]
 
 
+def gather_pages_folded(cache, layer, page_table):
+    """History gather with the LAYER AND HEAD axes both folded into one
+    gather: ``[Nkv, B, maxP*page, D]`` — exactly the attention dot's
+    K/V operand layout. gather_pages' natural output puts the advanced
+    (batch, page) indices first, so every attention consumer paid a
+    ``transpose(2,0,1,3,4)`` relayout of the WHOLE gathered history —
+    a full extra HBM round-trip per step per cache. A gather is already
+    arbitrary data movement, so asking it for the permuted layout
+    directly is free; the reshape that follows is contiguous (no copy).
+    The layer index stays an ADVANCED index on purpose — a basic
+    ``cache[layer]`` scalar index is a dynamic-slice copy of cache/L
+    (the 50 ms-per-step failure mode gather_pages exists to avoid)."""
+    import jax.numpy as jnp
+
+    b, maxp = page_table.shape
+    data = cache.data if isinstance(cache, QuantKV) else cache
+    nkv, page, d = data.shape[1], data.shape[3], data.shape[4]
+    idx_l = jnp.broadcast_to(layer, (nkv, b, maxp))
+    idx_n = jnp.arange(nkv)[:, None, None]
+    pt = jnp.broadcast_to(page_table[None], (nkv, b, maxp))
+    if isinstance(cache, QuantKV):
+        out = kv_dequantize(cache.data[idx_l, idx_n, pt],
+                            cache.scale[idx_l, idx_n, pt])
+    else:
+        out = cache[idx_l, idx_n, pt]
+    return out.reshape(nkv, b, maxp * page, d)
+
+
 def scatter_pages(cache, blocks, flat_pages):
     """Whole-page commit ``cache.at[:, :, flat_pages].set(blocks)`` with
     quantization fused in for int8 pools. blocks [L, Nkv, n, page, D]."""

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from dynamo_tpu.engine.config import ModelSpec
-from dynamo_tpu.engine.kv_quant import (gather_pages, scatter_pages,
+from dynamo_tpu.engine.kv_quant import (gather_pages_folded, scatter_pages,
                                         scatter_tokens)
 from dynamo_tpu.engine.quant import QTensor
 
@@ -457,12 +457,11 @@ def paged_window_attention_xla(q: jax.Array, k_cache: jax.Array,
     nkv, page = k_cache.shape[1], k_cache.shape[3]
     maxp = page_table.shape[1]
     M = k_win.shape[2]
-    idx_l = jnp.broadcast_to(layer, page_table.shape)
-    # gather_pages dequantizes int8 pools inside the gather expression.
-    k_all = (gather_pages(k_cache, idx_l, page_table)
-             .transpose(2, 0, 1, 3, 4).reshape(nkv, b, maxp * page, d))
-    v_all = (gather_pages(v_cache, idx_l, page_table)
-             .transpose(2, 0, 1, 3, 4).reshape(nkv, b, maxp * page, d))
+    # Layer+head-folded gather straight into the dot's [Nkv,B,L,D]
+    # operand layout (no transposed relayout of the gathered history);
+    # dequantizes int8 pools inside the gather expression.
+    k_all = gather_pages_folded(k_cache, layer, page_table)
+    v_all = gather_pages_folded(v_cache, layer, page_table)
     qg = q.reshape(b, nkv, q_per_kv, d)
     scale = 1.0 / jnp.sqrt(jnp.float32(d))
     s_hist = jnp.einsum("bngd,nbld->bngl", qg, k_all,
@@ -876,12 +875,12 @@ def decode_window_multi_step(params: Params, spec: ModelSpec,
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         qg = q.reshape(b, s, nkv, spec.q_per_kv, d)
-        # Paged history (layer-folded gather, same as the window impl).
-        idx_l = jnp.broadcast_to(layer, page_table.shape)
-        k_all = (gather_pages(k_cache, idx_l, page_table)
-                 .transpose(2, 0, 1, 3, 4).reshape(nkv, b, maxp * page, d))
-        v_all = (gather_pages(v_cache, idx_l, page_table)
-                 .transpose(2, 0, 1, 3, 4).reshape(nkv, b, maxp * page, d))
+        # Paged history: the same layer+head-folded fused gather as the
+        # single-token step — the [B,S] verify reads the bucketed page
+        # table once per layer into the dot's [Nkv,B,L,D] layout, with
+        # no materialized per-position (or per-head-transpose) copies.
+        k_all = gather_pages_folded(k_cache, layer, page_table)
+        v_all = gather_pages_folded(v_cache, layer, page_table)
         s_hist = jnp.einsum("bsngd,nbld->bnsgl", qg, k_all,
                             preferred_element_type=jnp.float32) * scale
         lpos = jnp.arange(maxp * page)[None, :]

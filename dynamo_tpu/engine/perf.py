@@ -423,6 +423,25 @@ class PerfMetricsUpdater:
         self.g_hbm_limit = registry.gauge(
             "perf_hbm_limit_bytes", "device.memory_stats bytes_limit on "
             "this worker's first addressable device")
+        self.c_spec_draft_tokens = registry.counter(
+            "perf_spec_draft_tokens_total", "Speculative draft tokens "
+            "proposed by the on-device n-gram drafter")
+        self.c_spec_accepted_tokens = registry.counter(
+            "perf_spec_accepted_tokens_total", "Speculative draft tokens "
+            "accepted by the fused verify (rejection-sampled for "
+            "temperature > 0; exact-match under greedy)")
+        self.c_spec_verify_steps = registry.counter(
+            "perf_spec_verify_steps_total", "Speculative verify steps by "
+            "tokens emitted — the per-window emitted-token histogram "
+            "(emitted=1 means no draft accepted; emitted=spec_k+1 means "
+            "the whole draft block landed; emitted=0 a frozen slot)",
+            ["emitted"])
+        self.g_spec_acceptance = registry.gauge(
+            "perf_spec_acceptance_rate", "Lifetime accepted/proposed "
+            "draft-token ratio of the speculative verify")
+        self.c_spec_brownout = registry.counter(
+            "perf_spec_brownout_windows_total", "Decode windows where "
+            "brownout pressure suspended speculative drafting")
         for bound in (self.g_step_seconds, self.g_achieved, self.g_roofline,
                       self.g_hbm_in_use, self.g_hbm_peak, self.g_hbm_limit):
             bound.ensure()
@@ -460,3 +479,16 @@ class PerfMetricsUpdater:
             self.g_hbm_in_use.set(hbm.get("bytes_in_use", 0))
             self.g_hbm_peak.set(hbm.get("peak_bytes_in_use", 0))
             self.g_hbm_limit.set(hbm.get("bytes_limit", 0))
+        if getattr(engine, "spec_emit_hist", None):
+            self._delta(self.c_spec_draft_tokens, ("spec_dt",),
+                        engine.spec_tokens)
+            self._delta(self.c_spec_accepted_tokens, ("spec_at",),
+                        engine.spec_accepted)
+            self._delta(self.c_spec_brownout, ("spec_bw",),
+                        engine.spec_brownout_windows)
+            for e, n in enumerate(engine.spec_emit_hist):
+                self._delta(self.c_spec_verify_steps, ("spec_eh", e), n,
+                            emitted=str(e))
+            if engine.spec_tokens:
+                self.g_spec_acceptance.set(
+                    engine.spec_accepted / engine.spec_tokens)

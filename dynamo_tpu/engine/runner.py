@@ -719,8 +719,22 @@ class ModelRunner:
         (model.decode_window_multi_step). Sequence position is carried in
         positions_dev between windows — the advance is data-dependent
         (accepted drafts), so pipelined dispatches must chain on-device.
-        Greedy only (argmax); the engine rejects stochastic sampling
-        while spec decode is enabled."""
+
+        Sampling is on-device rejection sampling degenerated for the
+        point-mass (n-gram) drafter: accepting a draft w.p.
+        min(1, p_target/q_draft) and resampling the first rejection from
+        the normalized residual collapses, when q is a point mass at the
+        draft token, to "sample x ~ target at each position; accept iff
+        x == draft; emit x either way" — so each verify position draws
+        ONE per-row sample from the target distribution and the existing
+        prefix-acceptance compare is the accept rule. Every emitted
+        token is exactly target-distributed; greedy rows (temp <= 0)
+        degenerate to argmax, bit-identical to non-spec greedy decode.
+        Temperature/top-k/top-p/seed ride in as DATA (packed columns):
+        one program serves any mix, zero recompiles. Seeded rows fold
+        the request seed with the token's absolute landing position —
+        the same convention as the plain seeded window — so a seeded
+        stream is token-identical with spec on or off."""
         key = ("spec", m_outer, k, bucket_pages)
         fn = self._window_cache.get(key)
         if fn is not None:
@@ -731,7 +745,7 @@ class ModelRunner:
         W = m_outer * S  # in-window KV columns (worst case: all accepted)
 
         def run_spec(params, k_cache, v_cache, tokens_dev, hist_dev,
-                     positions_dev, packed, lora=None):
+                     positions_dev, packed, rng, lora=None):
             from dynamo_tpu.engine.model import decode_window_multi_step
             adapter_ids = packed[:, PK_ADAPTER]
             override = packed[:, PK_OVERRIDE] > 0
@@ -739,6 +753,13 @@ class ModelRunner:
             pos0 = jnp.where(override, packed[:, PK_POS], positions_dev)
             active = packed[:, PK_SEQLEN] > 0
             cap = packed[:, PK_CAP]
+            top_k = packed[:, PK_TOPK]
+            temp = jax.lax.bitcast_convert_type(packed[:, PK_TEMP],
+                                                jnp.float32)
+            top_p = jax.lax.bitcast_convert_type(packed[:, PK_TOPP],
+                                                 jnp.float32)
+            seed_flag = packed[:, PK_SEEDED] > 0
+            base_keys = jax.vmap(jax.random.key)(packed[:, PK_SEED])
             page_table = packed[:, PK_PREFIX:]
             B = tokens0.shape[0]
             H = hist_dev.shape[1]
@@ -746,9 +767,17 @@ class ModelRunner:
             b_idx = jnp.arange(B)
             kbuf0 = jnp.zeros((L, nkv, B, W, d), k_cache.dtype)
             vbuf0 = jnp.zeros((L, nkv, B, W, d), v_cache.dtype)
+            # Per-(row, verify-column) sampling params: column j of a
+            # row's block shares that row's temperature/top-k/top-p.
+            temp_s = jnp.repeat(temp, S)
+            top_k_s = jnp.repeat(top_k, S)
+            top_p_s = jnp.repeat(top_p, S)
+            seed_s = jnp.repeat(seed_flag, S)
+            base_s = jax.random.wrap_key_data(
+                jnp.repeat(jax.random.key_data(base_keys), S, axis=0))
 
             def step(carry, _):
-                tokens, pos, wlen, hist, kbuf, vbuf = carry
+                tokens, pos, wlen, hist, kbuf, vbuf, rng = carry
                 live = active & (pos < cap)
                 safe_pos = jnp.clip(pos, 0, H - 1)
                 # Invariant: hist[pos] = the token being fed this step.
@@ -784,7 +813,29 @@ class ModelRunner:
                     params, spec, k_cache, v_cache, kbuf, vbuf, wlen,
                     tok_blk, pos_blk, page_table, hist_lens=pos0,
                     lora=lora, adapter_ids=adapter_ids)
-                out = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B,S]
+                # One target-distributed draw per verify position ([B,S]
+                # flattened to [B*S] rows — the sampler's per-row core is
+                # shared with the plain decode window). Column j's token
+                # LANDS at pos + 1 + j: seeded rows fold the request seed
+                # with that absolute position (the plain seeded window's
+                # exact convention), unseeded rows draw fresh split keys.
+                rng, sub = jax.random.split(rng)
+                land = (pos[:, None] + 1
+                        + jnp.arange(S)[None, :]).reshape(-1)  # [B*S]
+                per_seed = jax.vmap(jax.random.fold_in)(base_s, land)
+                shared = jax.random.split(sub, B * S)
+                row_keys = jax.random.wrap_key_data(jnp.where(
+                    seed_s[:, None],
+                    jax.random.key_data(per_seed),
+                    jax.random.key_data(shared)))
+                out = sample_tokens_per_row(
+                    logits.reshape(B * S, -1), temp_s, top_k_s, top_p_s,
+                    row_keys).reshape(B, S)
+                # Prefix-acceptance IS the rejection-sampling accept rule
+                # for a point-mass drafter: out[:, j] ~ target, accepted
+                # iff it reproduced the draft; the first rejection's draw
+                # is the residual resample (emitted via out[b, a]); draws
+                # past it are conditioned on a dead prefix and dropped.
                 eq = (drafts == out[:, :k]) & dvalid
                 accflags = jnp.cumprod(
                     eq.astype(jnp.int32), axis=1).astype(bool)
@@ -812,12 +863,13 @@ class ModelRunner:
                 # Emit e (not a): e == 0 distinguishes a frozen/inactive
                 # slot from "zero drafts accepted" (e == 1) — the host
                 # walk needs that to mirror the in-graph freeze.
-                return (tokens, pos, wlen, hist, kbuf, vbuf), (
+                return (tokens, pos, wlen, hist, kbuf, vbuf, rng), (
                     out, e.astype(jnp.int32), ndraft.astype(jnp.int32))
 
             carry0 = (tokens0, pos0, jnp.zeros((B,), jnp.int32), hist_dev,
-                      kbuf0, vbuf0)
-            (tokens, pos, wlen, hist, kbuf, vbuf), (outs, emits, ndrafts) = \
+                      kbuf0, vbuf0, rng)
+            (tokens, pos, wlen, hist, kbuf, vbuf, rng), \
+                (outs, emits, ndrafts) = \
                 jax.lax.scan(step, carry0, jnp.arange(m_outer))
             # Commit the window buffer: col c holds the token at absolute
             # position pos0 + c; cols >= wlen land on scratch page 0.
@@ -831,7 +883,7 @@ class ModelRunner:
             k_cache = scatter_tokens(k_cache, kbuf, dest, off)
             v_cache = scatter_tokens(v_cache, vbuf, dest, off)
             return (outs, emits, ndrafts, tokens, pos, hist,
-                    k_cache, v_cache)
+                    k_cache, v_cache, rng)
 
         fn = perf.instrumented_jit("spec_window", run_spec, key=key,
                                    donate_argnums=(1, 2, 4))
@@ -848,10 +900,10 @@ class ModelRunner:
         kw = {} if self.lora is None else {"lora": self.lora}
         with self.mesh:
             (outs, accs, ndrafts, self.tokens_dev, self.positions_dev,
-             self.hist_dev, self.k_cache, self.v_cache) = fn(
+             self.hist_dev, self.k_cache, self.v_cache, self._rng) = fn(
                 self.params, self.k_cache, self.v_cache, self.tokens_dev,
                 self.hist_dev, self.positions_dev, jnp.asarray(packed),
-                **kw)
+                self._rng, **kw)
         return outs, accs, ndrafts
 
     def seed_history(self, entries: list[tuple]) -> None:
@@ -1527,15 +1579,13 @@ def _prefill_with_history(params, spec, k_cache, v_cache, tokens, positions,
                   >= positions[:, None, None, None, :])
         chunk_scores = jnp.where(causal & valid[:, None, None, None, :],
                                  chunk_scores, -1e30)
-        # History over prior pages: layer-folded gather from the stacked
-        # cache (hist pages are disjoint from this chunk's pages, whose
+        # History over prior pages: layer+head-folded gather from the
+        # stacked cache straight into the dot's [Nkv,B,L,D] layout
+        # (hist pages are disjoint from this chunk's pages, whose
         # writes are deferred out of the scan).
-        idx_l = jnp.broadcast_to(layer, hist_table.shape)
-        from dynamo_tpu.engine.kv_quant import gather_pages
-        k_hist = (gather_pages(k_cache, idx_l, hist_table)
-                  .transpose(2, 0, 1, 3, 4).reshape(nkv, b, maxp * page, d))
-        v_hist = (gather_pages(v_cache, idx_l, hist_table)
-                  .transpose(2, 0, 1, 3, 4).reshape(nkv, b, maxp * page, d))
+        from dynamo_tpu.engine.kv_quant import gather_pages_folded
+        k_hist = gather_pages_folded(k_cache, layer, hist_table)
+        v_hist = gather_pages_folded(v_cache, layer, hist_table)
         hist_scores = jnp.einsum("bqngd,nbld->bngql", qg, k_hist,
                                  preferred_element_type=jnp.float32)
         hist_valid = (jnp.arange(maxp * page)[None, :]

@@ -6,11 +6,22 @@ heterogeneous requests (no recompile per request — XLA static shapes).
 TPU-conscious design: no full-vocab sorts (a [B,152K] sort costs ~8 ms/step on
 v5e — more than the whole 0.5B forward pass). Instead:
 - greedy       = argmax                                  (exact)
-- plain sample = gumbel + argmax (jax.random.categorical) (exact)
-- top-k/top-p  = lax.top_k(64) prefilter, then categorical over 64 candidates
+- plain sample = gumbel-max with per-row noise            (exact)
+- top-k/top-p  = lax.top_k(64) prefilter, then gumbel-max over 64 candidates
   (top-k is capped at MAX_TOPK=64; the top-p nucleus is computed within those
   64 — beyond-top-64 tail mass is negligible for real LLM distributions, and
   the reference engines cap similarly for the same reason).
+
+ONE per-row implementation serves every caller: :func:`sample_tokens_per_row`
+is the core (an independent PRNG key per row — rows are the unit, so the
+[B,S] speculative verify reshapes to [B*S,V] and reuses it unchanged), and
+:func:`sample_tokens` is the shared-key wrapper that splits one key across
+the batch. Per-row noise is indexed by TOKEN ID (not candidate rank), which
+makes a draw depend only on (key, logits): batch composition, candidate
+ordering, and bf16 reduction-order jitter between compute paths cannot
+remap the noise — the property both seeded reproducibility and the
+spec-decode accept rule (sample-the-target, accept iff it equals the draft)
+are built on.
 """
 
 from __future__ import annotations
@@ -21,61 +32,16 @@ import jax.numpy as jnp
 MAX_TOPK = 64
 
 
-def sample_tokens(logits: jax.Array, temperature: jax.Array,
-                  top_k: jax.Array, top_p: jax.Array, key: jax.Array
-                  ) -> jax.Array:
-    """logits [B,V] fp32; temperature/top_k/top_p [B]; returns [B] int32.
-
-    temperature <= 0 means greedy for that slot. top_k <= 0 disables top-k;
-    top_p >= 1 disables top-p.
-    """
-    b, v = logits.shape
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    filtered = (top_k > 0) | (top_p < 1.0)
-    sampling = temperature > 0
-
-    def do_sample(_):
-        safe_t = jnp.where(sampling, temperature, 1.0)
-        scaled = logits / safe_t[:, None]
-        key_full, key_top = jax.random.split(key)
-        # Exact unrestricted sample (cheap: gumbel-max, no sort).
-        full_sample = jax.random.categorical(key_full, scaled, axis=-1)
-
-        def do_filtered(_):
-            # Sample among the top-64 candidates (sorted descending).
-            max_k = min(MAX_TOPK, v)
-            cand, cand_idx = jax.lax.top_k(scaled, max_k)  # [B,max_k]
-            pos = jnp.arange(max_k)[None, :]
-            k_eff = jnp.where(top_k > 0, jnp.minimum(top_k, max_k), max_k)
-            keep_k = pos < k_eff[:, None]
-            probs = jax.nn.softmax(jnp.where(keep_k, cand, -jnp.inf), axis=-1)
-            cum = jnp.cumsum(probs, axis=-1)
-            keep_p = (cum - probs) < top_p[:, None]  # prefix w/ cum >= p
-            masked = jnp.where(keep_k & keep_p, cand, -jnp.inf)
-            choice = jax.random.categorical(key_top, masked, axis=-1)
-            return jnp.take_along_axis(
-                cand_idx, choice[:, None], axis=1)[:, 0]
-
-        top_sample = jax.lax.cond(jnp.any(filtered & sampling), do_filtered,
-                                  lambda _: full_sample, None)
-        return jnp.where(filtered, top_sample,
-                         full_sample).astype(jnp.int32)
-
-    # Skip all sampling work when the whole batch is greedy (the common
-    # serving default): lax.cond executes one branch on TPU.
-    sampled = jax.lax.cond(jnp.any(sampling), do_sample, lambda _: greedy,
-                           None)
-    return jnp.where(sampling, sampled, greedy)
-
-
 def sample_tokens_per_row(logits: jax.Array, temperature: jax.Array,
                           top_k: jax.Array, top_p: jax.Array,
                           keys: jax.Array) -> jax.Array:
-    """Like :func:`sample_tokens` but with an independent PRNG key PER ROW
-    (``keys`` [B] key array) — the seeded-request path. Categorical
-    sampling becomes gumbel-max with per-row noise, which makes a seeded
-    row's draw depend only on its own key and logits: batch composition,
-    other slots' seeds, and preemption/replacement cannot perturb it."""
+    """logits [B,V] fp32; temperature/top_k/top_p [B]; keys [B] (one PRNG
+    key per row). Returns [B] int32.
+
+    temperature <= 0 means greedy for that slot. top_k <= 0 disables top-k;
+    top_p >= 1 disables top-p. A row's draw depends only on its own key and
+    logits: other slots' params, seeds, and preemption/replacement cannot
+    perturb it (the seeded-request and spec-verify invariant)."""
     b, v = logits.shape
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     filtered = (top_k > 0) | (top_p < 1.0)
@@ -95,14 +61,15 @@ def sample_tokens_per_row(logits: jax.Array, temperature: jax.Array,
         full_sample = jnp.argmax(scaled + noise_full, axis=-1)
 
         def do_filtered(_):
+            # Sample among the top-64 candidates (sorted descending).
             max_k = min(MAX_TOPK, v)
-            cand, cand_idx = jax.lax.top_k(scaled, max_k)
+            cand, cand_idx = jax.lax.top_k(scaled, max_k)  # [B,max_k]
             pos = jnp.arange(max_k)[None, :]
             k_eff = jnp.where(top_k > 0, jnp.minimum(top_k, max_k), max_k)
             keep_k = pos < k_eff[:, None]
             probs = jax.nn.softmax(jnp.where(keep_k, cand, -jnp.inf), axis=-1)
             cum = jnp.cumsum(probs, axis=-1)
-            keep_p = (cum - probs) < top_p[:, None]
+            keep_p = (cum - probs) < top_p[:, None]  # prefix w/ cum >= p
             masked = jnp.where(keep_k & keep_p, cand, -jnp.inf)
             noise = jnp.take_along_axis(noise_full, cand_idx, axis=1)
             choice = jnp.argmax(masked + noise, axis=-1)
@@ -114,6 +81,17 @@ def sample_tokens_per_row(logits: jax.Array, temperature: jax.Array,
         return jnp.where(filtered, top_sample,
                          full_sample).astype(jnp.int32)
 
+    # Skip all sampling work when the whole batch is greedy (the common
+    # serving default): lax.cond executes one branch on TPU.
     sampled = jax.lax.cond(jnp.any(sampling), do_sample, lambda _: greedy,
                            None)
     return jnp.where(sampling, sampled, greedy)
+
+
+def sample_tokens(logits: jax.Array, temperature: jax.Array,
+                  top_k: jax.Array, top_p: jax.Array, key: jax.Array
+                  ) -> jax.Array:
+    """Shared-key wrapper over :func:`sample_tokens_per_row`: one key
+    split across the batch (the unseeded decode path)."""
+    return sample_tokens_per_row(logits, temperature, top_k, top_p,
+                                 jax.random.split(key, logits.shape[0]))

@@ -2,13 +2,34 @@
 
 Workload: repetitive prompts (looping token patterns — the shape of
 summaries-with-quotes, code edits, RAG answers that restate context),
-greedy, BS concurrent streams. The HBM-bound decode reads all weights
-once per step; verifying k+1 positions per read is the entire win, so
-the headline is decode tok/s and mean ITL, plain vs spec, plus the
+BS concurrent streams. The HBM-bound decode reads all weights once per
+step; verifying k+1 positions per read is the entire win, so the
+headline is decode tok/s and mean ITL, plain vs spec, plus the
 measured acceptance rate. Prints one JSON line.
 
+Three endpoints:
+- repetitive (weight_scale ~0, greedy): the model loops on a constant
+  token — acceptance -> 1, the workload spec decode exists for;
+- nonrepetitive (weight_scale 1, greedy): adversarial — no repetition,
+  drafts rarely accepted, speedup must stay ~1 (brownout floor);
+- temperature sweep (peaked weights, t in SPEC_TEMPS): rejection
+  sampling under real sampled serving. Per-temperature acceptance and
+  speedup columns; the spec engine's perf-plane snapshot (compiles,
+  roofline window, spec.verify_bytes_per_token) lands in detail.perf
+  so scripts/perf_gate.py can gate it structurally and ratchet the
+  verify bandwidth.
+
+The sweep needs a model that is peaked-but-not-degenerate: with
+random_params_for_timing's 0.02-std leaves, scale <= 5 gives uniform
+logits (acceptance ~1/vocab — measures nothing) and scale >= 50 is
+deterministic (sampling never deviates). SPEC_SHARP_SCALE defaults to
+20: measured top-token mass ~0.85 at t=0.7 / ~0.5 at t=1.0 on
+tiny-test, so acceptance is high at low temperature and visibly decays
+as t rises — the curve the rejection sampler is supposed to produce.
+
 Env: SPEC_MODEL (default qwen2.5-0.5b), SPEC_BS (8), SPEC_ISL (256),
-SPEC_OSL (128), SPEC_K (3), SPEC_WINDOW (32), BENCH_QUANT (int8).
+SPEC_OSL (128), SPEC_K (3), SPEC_WINDOW (32), BENCH_QUANT (int8),
+SPEC_TEMPS ("0,0.7,1.0"), SPEC_SHARP_SCALE (20).
 
 Run: python scripts/bench_spec_decode.py        (real chip)
      JAX_PLATFORMS=cpu ... (smoke; conftest-free, set env yourself)
@@ -33,6 +54,9 @@ ISL = int(os.environ.get("SPEC_ISL", "256"))
 OSL = int(os.environ.get("SPEC_OSL", "128"))
 K = int(os.environ.get("SPEC_K", "3"))
 WINDOW = int(os.environ.get("SPEC_WINDOW", "32"))
+TEMPS = tuple(float(t) for t in
+              os.environ.get("SPEC_TEMPS", "0,0.7,1.0").split(","))
+SHARP_SCALE = float(os.environ.get("SPEC_SHARP_SCALE", "20"))
 
 
 def prompts(vocab: int) -> list[list[int]]:
@@ -45,7 +69,13 @@ def prompts(vocab: int) -> list[list[int]]:
     return out
 
 
-async def run(spec_decode: str | None, weight_scale: float = 1.0):
+async def run(spec_decode: str | None, weight_scale: float = 1.0,
+              temperatures: tuple[float, ...] = (0.0,),
+              capture_perf: bool = False):
+    """One engine build, one measured pass per temperature. Returns
+    {temperature: stats} plus the perf-plane snapshot under "perf" when
+    asked (taken once, after all passes — compile counts then cover the
+    whole heterogeneous mix, which is the zero-recompile claim)."""
     from dynamo_tpu.engine.config import EngineConfig, PRESETS
     from dynamo_tpu.engine.engine import TPUEngine
     from dynamo_tpu.llm.protocols import PreprocessedRequest
@@ -83,10 +113,13 @@ async def run(spec_decode: str | None, weight_scale: float = 1.0):
         runner_mod.init_params = orig_init
     engine.start()
 
-    async def one(prompt):
+    async def one(prompt, temperature, seed):
         req = PreprocessedRequest(model="b", token_ids=list(prompt))
         req.stop_conditions.max_tokens = OSL
         req.stop_conditions.ignore_eos = True
+        if temperature > 0:
+            req.sampling_options.temperature = temperature
+            req.sampling_options.seed = seed
         t0 = time.monotonic()
         t_first = None
         n = 0
@@ -100,23 +133,34 @@ async def run(spec_decode: str | None, weight_scale: float = 1.0):
         return t_first - t0, time.monotonic() - t_first, n
 
     ps = prompts(spec.vocab_size)
-    await asyncio.gather(*[one(p) for p in ps])  # warmup/compile
-    t0 = time.monotonic()
-    results = await asyncio.gather(*[one(p) for p in ps])
-    elapsed = time.monotonic() - t0
-    decode_tokens = sum(max(0, n - 1) for _, _, n in results)
-    decode_span = max(span for _, span, _ in results)
-    out = {
-        "decode_tok_s": decode_tokens / decode_span if decode_span else 0.0,
-        "itl_mean_ms": 1e3 * decode_span / (decode_tokens / BS)
-        if decode_tokens else 0.0,
-        "elapsed_s": elapsed,
-        "spec_drafts": engine.spec_drafts,
-        "spec_tokens": engine.spec_tokens,
-        "spec_accepted": engine.spec_accepted,
-        "acceptance": (engine.spec_accepted / engine.spec_tokens
-                       if engine.spec_tokens else None),
-    }
+    by_temp: dict[str, dict] = {}
+    # Warmup at the max temperature: ONE spec program covers greedy +
+    # sampled + seeded, so any single pass compiles everything.
+    await asyncio.gather(*[one(p, max(temperatures), 1) for p in ps])
+    for temp in temperatures:
+        dt0, at0 = engine.spec_tokens, engine.spec_accepted
+        t0 = time.monotonic()
+        results = await asyncio.gather(
+            *[one(p, temp, 100 + i) for i, p in enumerate(ps)])
+        elapsed = time.monotonic() - t0
+        decode_tokens = sum(max(0, n - 1) for _, _, n in results)
+        decode_span = max(span for _, span, _ in results)
+        drafted = engine.spec_tokens - dt0
+        accepted = engine.spec_accepted - at0
+        by_temp[str(temp)] = {
+            "decode_tok_s": decode_tokens / decode_span
+            if decode_span else 0.0,
+            "itl_mean_ms": 1e3 * decode_span / (decode_tokens / BS)
+            if decode_tokens else 0.0,
+            "elapsed_s": elapsed,
+            "spec_draft_tokens": drafted,
+            "spec_accepted": accepted,
+            "acceptance": accepted / drafted if drafted else None,
+        }
+    out = by_temp
+    out["spec_drafts"] = engine.spec_drafts
+    if capture_perf:
+        out["perf"] = engine.perf_status()
     engine.stop()
     # Sequential engines at 8B: the previous engine's ~8 GB of HBM must
     # actually be released before the next build, or run 2+ OOMs.
@@ -130,36 +174,54 @@ async def run(spec_decode: str | None, weight_scale: float = 1.0):
 
 
 async def main_async():
-    # Repetitive endpoint (weight_scale ~0: the model loops, acceptance
-    # -> 1 — the workload spec decode exists for) and the adversarial
-    # endpoint (random weights: no repetition, drafts rarely accepted).
     plain_rep = await run(None, weight_scale=1e-4)
     spec_rep = await run("ngram", weight_scale=1e-4)
     plain_rnd = await run(None, weight_scale=1.0)
     spec_rnd = await run("ngram", weight_scale=1.0)
+    plain_sweep = await run(None, weight_scale=SHARP_SCALE,
+                            temperatures=TEMPS)
+    spec_sweep = await run("ngram", weight_scale=SHARP_SCALE,
+                           temperatures=TEMPS, capture_perf=True)
 
-    def ratio(a, b):
-        return round(a["decode_tok_s"] / b["decode_tok_s"], 3) \
-            if b["decode_tok_s"] else 0.0
+    def ratio(a, b, t="0.0"):
+        return round(a[t]["decode_tok_s"] / b[t]["decode_tok_s"], 3) \
+            if b[t]["decode_tok_s"] else 0.0
 
+    g = "0.0"
+    sweep = {
+        str(t): {
+            "speedup": ratio(spec_sweep, plain_sweep, str(t)),
+            "acceptance": spec_sweep[str(t)]["acceptance"],
+            "plain_decode_tok_s": round(
+                plain_sweep[str(t)]["decode_tok_s"], 1),
+            "spec_decode_tok_s": round(
+                spec_sweep[str(t)]["decode_tok_s"], 1),
+            "spec_itl_ms": round(spec_sweep[str(t)]["itl_mean_ms"], 3),
+        }
+        for t in TEMPS
+    }
     print(json.dumps({
         "metric": f"spec_decode_{MODEL}_bs{BS}_k{K}",
         "value": ratio(spec_rep, plain_rep),
         "unit": "speedup_x_repetitive",
         "detail": {
             "repetitive": {
-                "plain_decode_tok_s": round(plain_rep["decode_tok_s"], 1),
-                "spec_decode_tok_s": round(spec_rep["decode_tok_s"], 1),
-                "plain_itl_ms": round(plain_rep["itl_mean_ms"], 3),
-                "spec_itl_ms": round(spec_rep["itl_mean_ms"], 3),
-                "acceptance": spec_rep["acceptance"],
+                "plain_decode_tok_s": round(plain_rep[g]["decode_tok_s"], 1),
+                "spec_decode_tok_s": round(spec_rep[g]["decode_tok_s"], 1),
+                "plain_itl_ms": round(plain_rep[g]["itl_mean_ms"], 3),
+                "spec_itl_ms": round(spec_rep[g]["itl_mean_ms"], 3),
+                "acceptance": spec_rep[g]["acceptance"],
             },
             "nonrepetitive": {
                 "speedup": ratio(spec_rnd, plain_rnd),
-                "acceptance": spec_rnd["acceptance"],
-                "plain_decode_tok_s": round(plain_rnd["decode_tok_s"], 1),
-                "spec_decode_tok_s": round(spec_rnd["decode_tok_s"], 1),
+                "acceptance": spec_rnd[g]["acceptance"],
+                "plain_decode_tok_s": round(plain_rnd[g]["decode_tok_s"], 1),
+                "spec_decode_tok_s": round(spec_rnd[g]["decode_tok_s"], 1),
             },
+            "temperature_sweep": sweep,
+            "sweep_weight_scale": SHARP_SCALE,
+            "perf": spec_sweep["perf"],
+            "platform": __import__("jax").default_backend(),
             "workload": f"isl{ISL} osl{OSL} bs{BS} window{WINDOW} k{K}",
         },
     }))

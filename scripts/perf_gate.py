@@ -21,7 +21,12 @@ TPU baseline):
   (bench's ``vs_baseline`` IS the roofline fraction for serve mode);
 - compile budget: no program may compile more than
   ``baseline compiles + compile-slack`` times (a new shape bucket or two
-  is legitimate growth; tripling is a bucketing regression).
+  is legitimate growth; tripling is a bucketing regression);
+- spec verify bandwidth: ``detail.perf.spec.verify_bytes_per_token``
+  (HBM bytes per verified position from the cost registry — see
+  bench_spec_decode.py) must not exceed ``baseline * (1 + tolerance)``.
+  Skipped with a note when either side lacks the key (a bench.py run
+  has no spec section; an old baseline predates the ratchet).
 
 Record a fresh baseline from a run: ``--record`` copies the run JSON to
 the baseline path (committed baselines live at deploy/perf-baseline.json).
@@ -103,6 +108,29 @@ def value_failures(run: dict, baseline: dict, tolerance: float,
                          f"{floor:.3f} (baseline {bfrac} - {tolerance:.0%})")
         else:
             notes.append(f"roofline frac {rfrac} vs baseline {bfrac} (ok)")
+    def spec_bytes(doc):
+        spec = (((doc.get("detail") or {}).get("perf") or {})
+                .get("spec") or {})
+        v = spec.get("verify_bytes_per_token")
+        return v if isinstance(v, (int, float)) else None
+
+    bspec, rspec = spec_bytes(baseline), spec_bytes(run)
+    if bspec is None or rspec is None:
+        notes.append("spec verify_bytes_per_token absent from "
+                     f"{'baseline' if bspec is None else 'run'}: verify "
+                     "bandwidth ratchet skipped")
+    else:
+        ceiling = bspec * (1.0 + tolerance)
+        if rspec > ceiling:
+            fails.append(
+                f"spec verify bytes/token regressed: {rspec} > "
+                f"{ceiling:.1f} (baseline {bspec} + {tolerance:.0%}) — "
+                "the multi-token verify lost its fused gather (see "
+                "tests/test_spec_decode.py::"
+                "test_spec_verify_bytes_per_token_ratio)")
+        else:
+            notes.append(f"spec verify bytes/token {rspec} vs baseline "
+                         f"{bspec} (ok)")
     base_progs = (((baseline.get("detail") or {}).get("perf") or {})
                   .get("compiles") or {}).get("programs") or {}
     run_progs = (((run.get("detail") or {}).get("perf") or {})
